@@ -1,0 +1,13 @@
+"""distribuuuu_tpu — a TPU-native distributed image-classification framework.
+
+Built from scratch on JAX/XLA (jit + sharding over a device Mesh, Pallas
+kernels for hot ops), with the capabilities of the PyTorch-DDP reference
+framework ``isZXY/distribuuuu``: YAML-configured multi-host data-parallel
+ImageNet training/eval, a model zoo, SyncBN, cosine/step LR schedules with
+warmup, cross-replica metrics, epoch-granular checkpoint/auto-resume, and
+Slurm/env launch discovery.
+"""
+
+__version__ = "0.1.0"
+
+from distribuuuu_tpu.config import cfg  # noqa: F401

@@ -1,0 +1,30 @@
+"""Test fixtures: run everything on a virtual 8-device CPU mesh.
+
+This is the JAX analogue of the reference's "multi-node without a cluster"
+trick (ref: /root/reference/README.md:119-144 — oversubscribing one node with
+CUDA_VISIBLE_DEVICES partitions): XLA's host platform is told to expose 8
+virtual CPU devices, so every sharding/collective path compiles and runs
+without TPU hardware.
+"""
+
+import os
+
+# Must be set before jax is imported anywhere.
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture(autouse=True)
+def _reset_global_cfg():
+    """Each test sees pristine config defaults."""
+    from distribuuuu_tpu import config
+
+    config.reset_cfg()
+    yield
+    config.reset_cfg()
