@@ -1,0 +1,85 @@
+"""Benchmark: ResNet-50 training throughput (images/sec/chip) on real hardware.
+
+Runs the framework's actual jitted train step (fwd + CE + bwd + SGD-nesterov
+update + in-graph metrics, bf16 compute / fp32 params) on synthetic ImageNet
+shapes, steady-state, on however many chips are attached, and prints ONE JSON
+line.
+
+``vs_baseline``: the reference publishes no throughput numbers
+(SURVEY.md §6), so the denominator is the widely-reproduced ~400 img/s/GPU
+that torch DDP ResNet-50 fp32 achieves on the reference's A100-class hardware
+(README.md:183) — the setup its published baselines were trained with.
+"""
+
+from __future__ import annotations
+
+import json
+import time
+
+BASELINE_IMG_PER_SEC_PER_CHIP = 400.0  # A100 fp32 DDP resnet50 (see docstring)
+
+
+def main():
+    import jax
+    import numpy as np
+
+    import distribuuuu_tpu.config as config
+    from distribuuuu_tpu import trainer
+    from distribuuuu_tpu.config import cfg
+    from distribuuuu_tpu.parallel import mesh as mesh_lib, sharding as sharding_lib
+    from distribuuuu_tpu.utils.optim import construct_optimizer
+
+    config.reset_cfg()
+    cfg.MODEL.ARCH = "resnet50"
+    cfg.MODEL.NUM_CLASSES = 1000
+    n_chips = len(jax.devices())
+    per_chip_batch = 128
+    batch = per_chip_batch * n_chips
+
+    mesh = mesh_lib.build_mesh()
+    model = trainer.build_model_from_cfg()
+    state = trainer.create_train_state(model, jax.random.key(0), mesh, 224)
+    optimizer = construct_optimizer()
+    train_step = trainer.make_train_step(model, optimizer, topk=5)
+
+    rng = np.random.default_rng(0)
+    host_batch = {
+        "image": rng.standard_normal((batch, 224, 224, 3)).astype(np.float32),
+        "label": rng.integers(0, 1000, size=(batch,)).astype(np.int32),
+        "mask": np.ones((batch,), np.float32),
+    }
+    gbatch = sharding_lib.shard_batch(mesh, host_batch)
+
+    # compile + warmup
+    state, metrics = train_step(state, gbatch)
+    jax.block_until_ready(metrics["loss"])
+    for _ in range(3):
+        state, metrics = train_step(state, gbatch)
+    jax.block_until_ready(metrics["loss"])
+
+    # timed steady state
+    iters = 20
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        state, metrics = train_step(state, gbatch)
+    jax.block_until_ready(metrics["loss"])
+    dt = time.perf_counter() - t0
+
+    img_per_sec = batch * iters / dt
+    img_per_sec_per_chip = img_per_sec / n_chips
+    print(
+        json.dumps(
+            {
+                "metric": "resnet50_train_images_per_sec_per_chip",
+                "value": round(img_per_sec_per_chip, 2),
+                "unit": "images/sec/chip",
+                "vs_baseline": round(
+                    img_per_sec_per_chip / BASELINE_IMG_PER_SEC_PER_CHIP, 3
+                ),
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    main()

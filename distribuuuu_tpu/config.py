@@ -268,8 +268,6 @@ _CFG_DEFAULT.freeze()
 
 def merge_from_file(cfg_file):
     """Merge a YAML file into the global cfg (ref: config.py:69-72)."""
-    with open(cfg_file, "r"):
-        pass  # fail fast with a clear error if unreadable
     _C.merge_from_file(cfg_file)
 
 
@@ -302,10 +300,11 @@ def load_cfg_fom_args(description="Config file options.", argv=None):
     parser.add_argument("--local_rank", default=0, type=int)
     help_s = "See distribuuuu_tpu/config.py for all options"
     parser.add_argument("opts", help=help_s, default=None, nargs=argparse.REMAINDER)
-    if len(sys.argv if argv is None else argv) == 0:
+    args_list = sys.argv[1:] if argv is None else argv
+    if not args_list:
         parser.print_help()
         sys.exit(1)
-    args = parser.parse_args(argv)
+    args = parser.parse_args(args_list)
     merge_from_file(args.cfg_file)
     _C.merge_from_list(args.opts)
     return _C

@@ -1,0 +1,15 @@
+"""Data layer: input pipelines feeding the device mesh.
+
+Reference surface (ref: /root/reference/distribuuuu/utils.py:109-184):
+``construct_train_loader`` / ``construct_val_loader`` building
+ImageFolder-or-dummy pipelines with DistributedSampler sharding. Here each
+*host process* loads only its shard (images/sec scale with hosts) and the
+trainer assembles global sharded arrays on the data mesh axis.
+"""
+
+from distribuuuu_tpu.data.dummy import DummyDataset  # noqa: F401
+from distribuuuu_tpu.data.loader import (  # noqa: F401
+    Loader,
+    construct_train_loader,
+    construct_val_loader,
+)

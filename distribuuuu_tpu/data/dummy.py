@@ -1,0 +1,27 @@
+"""Fake-data backend (ref: /root/reference/distribuuuu/utils.py:109-118).
+
+Random images with label 0, behind ``cfg.MODEL.DUMMY_INPUT`` — the mechanism
+that lets the full training path run with no dataset on disk. Samples are
+generated on the fly from a per-epoch seed so the pipeline shape (including
+per-epoch reshuffling effects) matches the real one.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+
+class DummyDataset:
+    """length random NHWC images of ``size``×``size``, label 0."""
+
+    def __init__(self, length: int = 6400, size: int = 224):
+        self.length = length
+        self.size = size
+
+    def __len__(self):
+        return self.length
+
+    def __getitem__(self, idx: int):
+        rng = np.random.default_rng(idx)
+        img = rng.standard_normal((self.size, self.size, 3), dtype=np.float32)
+        return img, 0

@@ -1,0 +1,161 @@
+"""Batch loader: per-host sharded iteration with background prefetch.
+
+Mirror of the reference's DataLoader construction (ref:
+/root/reference/distribuuuu/utils.py:121-184): train = shuffled sampler +
+``drop_last=True``; val = unshuffled + ``drop_last=False``. The torch worker
+pool becomes a thread pool assembling numpy batches ahead of the consumer;
+device placement (the ``pin_memory``/``non_blocking`` analogue) happens in
+the trainer via ``shard_batch`` with double-buffered async dispatch.
+
+Each batch is a dict: ``image`` [B,H,W,C] float32 (NHWC — TPU-native),
+``label`` [B] int32, ``mask`` [B] float32 (0 marks padding in the final
+ragged eval batch, so metrics can ignore it in-graph; the reference instead
+silently double-counts DistributedSampler's padded duplicates).
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+
+import jax
+import numpy as np
+
+from distribuuuu_tpu.config import cfg
+from distribuuuu_tpu.data.dummy import DummyDataset
+from distribuuuu_tpu.data.sampler import DistributedSampler
+
+
+class Loader:
+    """Iterates a dataset as per-host batches using sampler shards."""
+
+    def __init__(
+        self,
+        dataset,
+        batch_size: int,
+        shuffle: bool,
+        drop_last: bool,
+        workers: int = 4,
+        seed: int = 0,
+    ):
+        self.dataset = dataset
+        self.batch_size = batch_size
+        self.drop_last = drop_last
+        self.workers = max(1, workers)
+        self.sampler = DistributedSampler(
+            len(dataset),
+            num_replicas=jax.process_count(),
+            rank=jax.process_index(),
+            shuffle=shuffle,
+            seed=seed,
+            drop_last=False,  # torch pads in the sampler; drop happens per-batch
+        )
+
+    def set_epoch(self, epoch: int) -> None:
+        self.sampler.set_epoch(epoch)
+
+    def __len__(self):
+        n = self.sampler.num_samples
+        return n // self.batch_size if self.drop_last else -(-n // self.batch_size)
+
+    def _assemble(self, idxs: np.ndarray) -> dict:
+        images, labels = [], []
+        for i in idxs:
+            img, lab = self.dataset[int(i)]
+            images.append(img)
+            labels.append(lab)
+        n = len(images)
+        batch = {
+            "image": np.stack(images).astype(np.float32),
+            "label": np.asarray(labels, np.int32),
+            "mask": np.ones((n,), np.float32),
+        }
+        if n < self.batch_size:  # pad ragged final eval batch, mask it out
+            pad = self.batch_size - n
+            batch["image"] = np.concatenate(
+                [batch["image"], np.zeros((pad,) + batch["image"].shape[1:], np.float32)]
+            )
+            batch["label"] = np.concatenate([batch["label"], np.zeros(pad, np.int32)])
+            batch["mask"] = np.concatenate([batch["mask"], np.zeros(pad, np.float32)])
+        return batch
+
+    def __iter__(self):
+        idxs = self.sampler.indices()
+        n_batches = len(self)
+        chunks = [
+            idxs[b * self.batch_size : (b + 1) * self.batch_size]
+            for b in range(n_batches)
+        ]
+        # Background assembly: a small bounded queue keeps `workers` batches
+        # in flight ahead of the consumer (the torch worker-pool analogue).
+        q: queue.Queue = queue.Queue(maxsize=self.workers)
+        stop = threading.Event()
+
+        def _producer():
+            try:
+                for chunk in chunks:
+                    if stop.is_set():
+                        return
+                    q.put(self._assemble(chunk))
+            finally:
+                q.put(None)
+
+        t = threading.Thread(target=_producer, daemon=True)
+        t.start()
+        try:
+            while True:
+                item = q.get()
+                if item is None:
+                    break
+                yield item
+        finally:
+            stop.set()
+            # drain so the producer can exit
+            while t.is_alive():
+                try:
+                    q.get_nowait()
+                except queue.Empty:
+                    break
+
+
+def _build_dataset(split: str, im_size: int, train: bool):
+    if cfg.MODEL.DUMMY_INPUT:
+        # small but non-trivial epoch (ref DummyDataset defaults are caller-set)
+        return DummyDataset(length=cfg.TRAIN.BATCH_SIZE * 64, size=im_size)
+    from distribuuuu_tpu.data.imagefolder import ImageFolderDataset
+
+    root = cfg.TRAIN.DATASET if train else cfg.TEST.DATASET
+    return ImageFolderDataset(root, split, im_size=im_size, train=train)
+
+
+def construct_train_loader() -> Loader:
+    """Train pipeline (ref: utils.py:121-152): shuffled, drop_last."""
+    dataset = _build_dataset(cfg.TRAIN.SPLIT, cfg.TRAIN.IM_SIZE, train=True)
+    return Loader(
+        dataset,
+        batch_size=_per_host_batch(cfg.TRAIN.BATCH_SIZE),
+        shuffle=True,
+        drop_last=True,
+        workers=cfg.TRAIN.WORKERS,
+        seed=cfg.RNG_SEED or 0,
+    )
+
+
+def construct_val_loader() -> Loader:
+    """Val pipeline (ref: utils.py:155-184): unshuffled, keep ragged tail."""
+    dataset = _build_dataset(cfg.TEST.SPLIT, cfg.TEST.IM_SIZE, train=False)
+    return Loader(
+        dataset,
+        batch_size=_per_host_batch(cfg.TEST.BATCH_SIZE),
+        shuffle=False,
+        drop_last=False,
+        workers=cfg.TRAIN.WORKERS,
+        seed=cfg.RNG_SEED or 0,
+    )
+
+
+def _per_host_batch(per_chip_batch: int) -> int:
+    """BATCH_SIZE is per-chip (the reference's per-GPU meaning,
+    README.md:197); each host feeds all its local chips."""
+    n_local = jax.local_device_count()
+    return per_chip_batch * n_local

@@ -1,0 +1,61 @@
+"""Distributed sampling with torch-DistributedSampler semantics.
+
+The reference shards every dataset across ranks with ``DistributedSampler``
+(ref: /root/reference/distribuuuu/utils.py:141-143,174): per-epoch seeded
+global shuffle, round-robin rank assignment, padding (repeating head samples)
+so every rank sees the same number of items, and ``set_epoch`` to reshuffle
+(ref: trainer.py:33). Reproduced here at *host process* granularity — each
+host feeds all of its local chips.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+
+class DistributedSampler:
+    def __init__(
+        self,
+        dataset_len: int,
+        num_replicas: int,
+        rank: int,
+        shuffle: bool = True,
+        seed: int = 0,
+        drop_last: bool = False,
+    ):
+        if rank >= num_replicas:
+            raise ValueError(f"rank {rank} >= num_replicas {num_replicas}")
+        self.dataset_len = dataset_len
+        self.num_replicas = num_replicas
+        self.rank = rank
+        self.shuffle = shuffle
+        self.seed = seed
+        self.drop_last = drop_last
+        self.epoch = 0
+        if drop_last and dataset_len % num_replicas != 0:
+            self.num_samples = dataset_len // num_replicas
+        else:
+            self.num_samples = -(-dataset_len // num_replicas)  # ceil
+        self.total_size = self.num_samples * num_replicas
+
+    def set_epoch(self, epoch: int) -> None:
+        """Reshuffle for a new epoch (≙ sampler.set_epoch, trainer.py:33)."""
+        self.epoch = epoch
+
+    def indices(self) -> np.ndarray:
+        if self.shuffle:
+            rng = np.random.default_rng(self.seed + self.epoch)
+            order = rng.permutation(self.dataset_len)
+        else:
+            order = np.arange(self.dataset_len)
+        if not self.drop_last and len(order) < self.total_size:
+            # pad by wrapping (torch repeats the head of the permutation)
+            pad = self.total_size - len(order)
+            order = np.concatenate([order, order[:pad]])
+        else:
+            order = order[: self.total_size]
+        # interleaved rank assignment: rank r takes order[r::num_replicas]
+        return order[self.rank :: self.num_replicas]
+
+    def __len__(self):
+        return self.num_samples

@@ -1,0 +1,56 @@
+"""Model registry (ref: /root/reference/distribuuuu/models/__init__.py:1-7).
+
+The reference dispatches ``build_model(arch)`` through module globals with a
+timm fallback at the call site (ref: trainer.py:123-128). timm does not exist
+here; every baseline arch — including RegNet-X/Y and EfficientNet-B0, which
+the reference outsources to timm — is implemented natively, so the registry
+is closed and errors are explicit.
+"""
+
+from __future__ import annotations
+
+from distribuuuu_tpu.models.resnet import (  # noqa: F401
+    resnet18,
+    resnet34,
+    resnet50,
+    resnet101,
+    resnet152,
+    resnext50_32x4d,
+    resnext101_32x8d,
+    wide_resnet50_2,
+    wide_resnet101_2,
+)
+
+_REGISTRY = {}
+
+
+def register_model(fn):
+    _REGISTRY[fn.__name__] = fn
+    return fn
+
+
+for _fn in (
+    resnet18,
+    resnet34,
+    resnet50,
+    resnet101,
+    resnet152,
+    resnext50_32x4d,
+    resnext101_32x8d,
+    wide_resnet50_2,
+    wide_resnet101_2,
+):
+    register_model(_fn)
+
+
+def available_models():
+    return sorted(_REGISTRY)
+
+
+def build_model(arch: str, **kwargs):
+    """Construct a model by name (≙ models.build_model + timm fallback)."""
+    if arch not in _REGISTRY:
+        raise KeyError(
+            f"Unknown arch '{arch}'. Available: {', '.join(available_models())}"
+        )
+    return _REGISTRY[arch](**kwargs)

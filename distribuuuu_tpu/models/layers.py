@@ -1,0 +1,121 @@
+"""Shared layers and initializers for the model zoo.
+
+TPU-first conventions used throughout the zoo:
+  - NHWC layout (XLA:TPU's native conv layout; torch reference is NCHW).
+  - Params in fp32, compute in ``cfg.DEVICE.COMPUTE_DTYPE`` (bfloat16 by
+    default) so matmuls/convs hit the MXU at full rate.
+  - BatchNorm statistics are computed over the *global* batch under jit:
+    with the batch sharded over the ``data`` mesh axis XLA inserts the
+    cross-replica reductions automatically, which makes BN behave as
+    SyncBatchNorm (ref: trainer.py:131) by construction. ``MODEL.SYNCBN``
+    therefore changes nothing on TPU; the flag is honored for config
+    compatibility.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable
+
+import flax.linen as nn
+import jax.numpy as jnp
+from jax.nn.initializers import variance_scaling
+
+# torch nn.Conv2d's companion init is kaiming; the reference ResNet explicitly
+# uses kaiming_normal(fan_out, relu) (ref: resnet.py:213-218).
+kaiming_normal_fan_out = variance_scaling(2.0, "fan_out", "normal")
+# torch nn.Linear default: kaiming_uniform(a=sqrt(5)) == U(±1/sqrt(fan_in)).
+torch_linear_init = variance_scaling(1.0 / 3.0, "fan_in", "uniform")
+
+
+def resolve_dtype(name: str):
+    return {"bfloat16": jnp.bfloat16, "float32": jnp.float32, "float16": jnp.float16}[
+        name
+    ]
+
+
+class ConvBN(nn.Module):
+    """Conv2D (no bias) + BatchNorm, the zoo's basic unit."""
+
+    features: int
+    kernel_size: tuple[int, int] = (3, 3)
+    strides: int | tuple[int, int] = 1
+    padding: Any = None
+    groups: int = 1
+    dtype: Any = jnp.bfloat16
+    use_bn: bool = True
+    bn_scale_init: Callable = nn.initializers.ones
+    act: Callable | None = None
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        k = self.kernel_size
+        pad = self.padding
+        if pad is None:
+            # torch-style symmetric "same" padding for odd kernels
+            pad = [(k[0] // 2, k[0] // 2), (k[1] // 2, k[1] // 2)]
+        x = nn.Conv(
+            self.features,
+            k,
+            strides=self.strides,
+            padding=pad,
+            feature_group_count=self.groups,
+            use_bias=False,
+            dtype=self.dtype,
+            param_dtype=jnp.float32,
+            kernel_init=kaiming_normal_fan_out,
+        )(x)
+        if self.use_bn:
+            x = BatchNorm(dtype=self.dtype, scale_init=self.bn_scale_init)(
+                x, train=train
+            )
+        if self.act is not None:
+            x = self.act(x)
+        return x
+
+
+class BatchNorm(nn.Module):
+    """BatchNorm with torch-matching hyperparams (torch momentum 0.1 == flax
+    momentum 0.9, eps 1e-5). Stats/params are fp32 regardless of compute
+    dtype; `train` selects batch stats vs running averages."""
+
+    dtype: Any = jnp.bfloat16
+    scale_init: Callable = nn.initializers.ones
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        return nn.BatchNorm(
+            use_running_average=not train,
+            momentum=0.9,
+            epsilon=1e-5,
+            dtype=self.dtype,
+            param_dtype=jnp.float32,
+            scale_init=self.scale_init,
+        )(x)
+
+
+class Dense(nn.Module):
+    """Linear head with torch-default init."""
+
+    features: int
+    dtype: Any = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x):
+        return nn.Dense(
+            self.features,
+            dtype=self.dtype,
+            param_dtype=jnp.float32,
+            kernel_init=torch_linear_init,
+        )(x)
+
+
+def global_avg_pool(x):
+    """NHWC global average pooling (≙ AdaptiveAvgPool2d(1) + flatten)."""
+    return jnp.mean(x, axis=(1, 2))
+
+
+def max_pool_3x3_s2(x):
+    """torch MaxPool2d(kernel=3, stride=2, padding=1) in NHWC."""
+    return nn.max_pool(
+        x, window_shape=(3, 3), strides=(2, 2), padding=[(1, 1), (1, 1)]
+    )

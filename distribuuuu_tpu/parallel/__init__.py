@@ -1,0 +1,21 @@
+"""Parallelism: device mesh bootstrap, collectives, sharding helpers."""
+
+from distribuuuu_tpu.parallel.mesh import (  # noqa: F401
+    build_mesh,
+    get_local_rank,
+    get_rank,
+    get_world_size,
+    is_primary,
+    setup_distributed,
+)
+from distribuuuu_tpu.parallel.collectives import (  # noqa: F401
+    barrier,
+    broadcast_from_primary,
+    host_all_reduce_mean,
+    scaled_all_reduce,
+)
+from distribuuuu_tpu.parallel.sharding import (  # noqa: F401
+    batch_sharding,
+    replicate,
+    shard_batch,
+)

@@ -1,0 +1,70 @@
+"""Collectives: host-level and in-graph cross-replica reductions.
+
+The reference's complete collective surface is: async summed ``all_reduce``
+scaled by 1/world (ref: /root/reference/distribuuuu/utils.py:85-106), DDP's
+implicit gradient allreduce + init-time param broadcast, and ``dist.barrier``
+(ref: tutorial/imagenet.py:159). On TPU the gradient reduction disappears
+into the compiled step (XLA inserts psums from sharding annotations); what
+remains for user code is metric reduction, broadcast, and barrier — provided
+here at host level — plus in-graph helpers for shard_map code paths.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import multihost_utils
+
+
+def scaled_all_reduce(values):
+    """Cross-replica mean of a list of scalar metrics.
+
+    API mirror of the reference's ``scaled_all_reduce`` (utils.py:85-106):
+    sum across replicas then scale by ``1/world``. Under global-array jit the
+    metrics computed in-graph are already global means, so this is only
+    needed for host-side (out-of-graph) values. No-op at world size 1
+    (ref: utils.py:92-94).
+    """
+    if jax.process_count() == 1:
+        return list(values)
+    arr = jnp.asarray([jnp.asarray(v, jnp.float32) for v in values])
+    summed = multihost_utils.process_allgather(arr).sum(axis=0)
+    return list(summed / jax.process_count())
+
+
+def host_all_reduce_mean(tree):
+    """Mean-reduce an arbitrary pytree of host values across processes."""
+    if jax.process_count() == 1:
+        return tree
+    gathered = multihost_utils.process_allgather(tree)
+    return jax.tree.map(lambda x: x.mean(axis=0), gathered)
+
+
+def barrier(name: str = "barrier") -> None:
+    """Block until all processes arrive (≙ dist.barrier, imagenet.py:159)."""
+    if jax.process_count() > 1:
+        multihost_utils.sync_global_devices(name)
+
+
+def broadcast_from_primary(tree):
+    """Broadcast a pytree from process 0 to all (≙ DDP's init param sync).
+
+    Under jit with replicated shardings XLA keeps params consistent by
+    construction, so this is only needed for host-side objects (e.g. the
+    epoch index read from a checkpoint, or data-pipeline state).
+    """
+    if jax.process_count() == 1:
+        return tree
+    return multihost_utils.broadcast_one_to_all(tree)
+
+
+# -- in-graph helpers (shard_map / pmap code paths) --------------------------
+
+def pmean(x, axis_name: str = "data"):
+    """In-graph cross-replica mean over a mesh axis."""
+    return jax.lax.pmean(x, axis_name)
+
+
+def psum(x, axis_name: str = "data"):
+    """In-graph cross-replica sum over a mesh axis."""
+    return jax.lax.psum(x, axis_name)

@@ -1,0 +1,167 @@
+"""Device-mesh bootstrap: the TPU-native replacement for process groups.
+
+The reference initializes an NCCL process group from one of three bootstrap
+modes — launcher env vars, Slurm derivation, or explicit TCP rendezvous
+(ref: /root/reference/distribuuuu/utils.py:19-51, tutorial/mnmc_ddp_mp.py:41-66).
+Here the same discovery logic feeds ``jax.distributed.initialize`` (one
+process per *host*, all local chips attached), and the "process group" is a
+``jax.sharding.Mesh`` over every chip in the slice. Collectives are not
+called by user code: they are compiled into the step function by XLA from
+sharding annotations and ride ICI within a slice / DCN across slices.
+
+Mesh axes (configured by ``cfg.MESH``):
+  - ``data``  — data parallelism (batch sharding; DDP equivalent)
+  - ``model`` — tensor/model parallelism (params/heads sharding)
+  - ``seq``   — sequence/context parallelism (ring attention)
+The reference only exercises data parallelism; the extra axes are
+first-class so larger workloads shard without restructuring.
+"""
+
+from __future__ import annotations
+
+import os
+import subprocess
+
+import jax
+import numpy as np
+from jax.sharding import Mesh
+
+_initialized = False
+_DEFAULT_COORD_PORT = 29566  # matches the reference's default port (utils.py:35)
+
+MESH_AXES = ("data", "model", "seq")
+
+
+def _slurm_env():
+    """Derive process topology from Slurm env (ref: utils.py:26-40)."""
+    proc_id = int(os.environ["SLURM_PROCID"])
+    n_procs = int(os.environ["SLURM_NTASKS"])
+    node_list = os.environ["SLURM_NODELIST"]
+    # First hostname in the allocation is the coordinator.
+    addr = subprocess.getoutput(
+        f"scontrol show hostname {node_list} | head -n1"
+    ).strip()
+    return addr, n_procs, proc_id
+
+
+def apply_backend_flags(deterministic: bool = False) -> None:
+    """Append backend flags to XLA_FLAGS before backend initialization.
+
+    The reference's cuDNN determinism toggle (ref: utils.py:64-68) maps here:
+    XLA:TPU compilation is deterministic by default; the GPU-only flag is
+    appended for completeness when running this framework on GPU. Must be
+    called before any jax API touches the backend.
+    """
+    if deterministic:
+        flags = os.environ.get("XLA_FLAGS", "")
+        if "--xla_gpu_deterministic_ops" not in flags:
+            os.environ["XLA_FLAGS"] = (
+                flags + " --xla_gpu_deterministic_ops=true"
+            ).strip()
+
+
+def apply_platform(platform: str) -> None:
+    """Honor ``cfg.DEVICE.PLATFORM`` ("auto" keeps the ambient platform).
+
+    Must run before any jax backend use. The env var alone is not enough:
+    environment sitecustomize hooks may pin ``jax_platforms`` via
+    jax.config, which beats ``JAX_PLATFORMS``.
+    """
+    if platform and platform != "auto":
+        jax.config.update("jax_platforms", platform)
+
+
+def setup_distributed(port: int | None = None) -> None:
+    """Initialize multi-host JAX if a multi-process launch is detected.
+
+    Bootstrap modes, mirroring the reference's three paths (ref:
+    utils.py:19-51):
+      (a) explicit env: ``COORDINATOR_ADDRESS``/``NUM_PROCESSES``/``PROCESS_ID``
+          (JAX-native) or torch-launcher-style ``MASTER_ADDR``/``WORLD_SIZE``/
+          ``RANK``;
+      (b) Slurm: derived from ``SLURM_PROCID``/``SLURM_NTASKS``/
+          ``SLURM_NODELIST`` via scontrol;
+      (c) single-process (the default): no-op — every local chip is already
+          visible, which is JAX's analogue of single-node DataParallel.
+    Safe to call multiple times; only the first call initializes.
+    """
+    global _initialized
+    if _initialized:
+        return
+    # Multi-process detection uses env vars ONLY: jax.distributed.initialize
+    # must run before anything initializes the XLA backend, so no jax API
+    # (even jax.process_count()) may be touched on the way in.
+    coord_port = port or int(os.environ.get("COORDINATOR_PORT", _DEFAULT_COORD_PORT))
+    if "COORDINATOR_ADDRESS" in os.environ:
+        jax.distributed.initialize()  # JAX reads its own env contract
+    elif "SLURM_PROCID" in os.environ and int(os.environ.get("SLURM_NTASKS", "1")) > 1:
+        addr, n_procs, proc_id = _slurm_env()
+        jax.distributed.initialize(
+            coordinator_address=f"{addr}:{coord_port}",
+            num_processes=n_procs,
+            process_id=proc_id,
+        )
+    elif "MASTER_ADDR" in os.environ and int(os.environ.get("WORLD_SIZE", "1")) > 1:
+        jax.distributed.initialize(
+            coordinator_address=f"{os.environ['MASTER_ADDR']}:{coord_port}",
+            num_processes=int(os.environ["WORLD_SIZE"]),
+            process_id=int(os.environ["RANK"]),
+        )
+    _initialized = True
+
+
+def get_rank() -> int:
+    """Global process index (≙ dist.get_rank() at host granularity)."""
+    return jax.process_index()
+
+
+def get_world_size() -> int:
+    """Number of host processes (≙ dist.get_world_size() over hosts)."""
+    return jax.process_count()
+
+
+def get_local_rank() -> int:
+    """Index of this process among processes on the same node."""
+    return int(os.environ.get("LOCAL_RANK", 0))
+
+
+def is_primary() -> bool:
+    """True on the logging/checkpointing process (≙ rank == 0 gates)."""
+    return jax.process_index() == 0
+
+
+def build_mesh(
+    data: int = -1, model: int = 1, seq: int = 1, devices=None
+) -> Mesh:
+    """Build the global device mesh with axes ``(data, model, seq)``.
+
+    ``-1`` on exactly one axis means "all remaining devices". The total must
+    divide the device count evenly. With defaults this is pure data
+    parallelism over every chip — the reference's DDP topology.
+    """
+    devices = jax.devices() if devices is None else devices
+    n = len(devices)
+    sizes = [data, model, seq]
+    n_auto = sum(1 for s in sizes if s == -1)
+    if n_auto > 1:
+        raise ValueError(f"At most one mesh axis may be -1, got {sizes}")
+    fixed = int(np.prod([s for s in sizes if s != -1]))
+    if n % fixed != 0:
+        raise ValueError(
+            f"Mesh axes {sizes} do not divide device count {n}"
+        )
+    sizes = [n // fixed if s == -1 else s for s in sizes]
+    if int(np.prod(sizes)) != n:
+        raise ValueError(
+            f"Mesh {dict(zip(MESH_AXES, sizes))} uses {int(np.prod(sizes))} "
+            f"devices but {n} are available"
+        )
+    dev_array = np.asarray(devices).reshape(sizes)
+    return Mesh(dev_array, MESH_AXES)
+
+
+def mesh_from_cfg(cfg, devices=None) -> Mesh:
+    """Build the mesh described by ``cfg.MESH``."""
+    return build_mesh(
+        data=cfg.MESH.DATA, model=cfg.MESH.MODEL, seq=cfg.MESH.SEQ, devices=devices
+    )

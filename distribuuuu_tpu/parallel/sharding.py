@@ -1,0 +1,44 @@
+"""Sharding specs and host→device placement for the training step.
+
+The reference moves per-GPU batches with ``.cuda(non_blocking=True)``
+(ref: /root/reference/distribuuuu/trainer.py:40) and relies on DDP to keep
+replicated params in sync. Here placement is declarative: the global batch is
+sharded over the ``data`` mesh axis, params are replicated (or sharded over
+``model`` when tensor parallelism is on), and XLA compiles the collectives.
+"""
+
+from __future__ import annotations
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+
+def batch_sharding(mesh: Mesh) -> NamedSharding:
+    """Sharding for a batch tensor: leading dim split over the data axis."""
+    return NamedSharding(mesh, P("data"))
+
+
+def replicate(mesh: Mesh) -> NamedSharding:
+    """Fully-replicated sharding (params, scalars)."""
+    return NamedSharding(mesh, P())
+
+
+def shard_batch(mesh: Mesh, batch):
+    """Place a host-local batch pytree as global device arrays sharded on
+    ``data``.
+
+    In multi-host runs each process holds its own shard (DistributedSampler
+    semantics, ref: utils.py:141-143) and this assembles the global array
+    from per-host shards; single-host it is a plain sharded device_put.
+    """
+    sharding = batch_sharding(mesh)
+
+    def _put(x):
+        x = np.asarray(x)
+        if jax.process_count() == 1:
+            return jax.device_put(x, sharding)
+        global_shape = (x.shape[0] * jax.process_count(),) + x.shape[1:]
+        return jax.make_array_from_process_local_data(sharding, x, global_shape)
+
+    return jax.tree.map(_put, batch)

@@ -1,0 +1,11 @@
+"""Utilities: seeding, logging, meters, metrics, schedules, checkpointing."""
+
+from distribuuuu_tpu.utils.seed import setup_env, setup_seed  # noqa: F401
+from distribuuuu_tpu.utils.logger import get_logger, setup_logger  # noqa: F401
+from distribuuuu_tpu.utils.meters import (  # noqa: F401
+    AverageMeter,
+    ProgressMeter,
+    construct_meters,
+)
+from distribuuuu_tpu.utils.metrics import accuracy  # noqa: F401
+from distribuuuu_tpu.utils.schedules import get_epoch_lr, lr_fun_cos, lr_fun_steps  # noqa: F401

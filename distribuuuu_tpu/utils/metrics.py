@@ -1,0 +1,41 @@
+"""Classification metrics, computed in-graph.
+
+The reference computes top-k accuracy on device then immediately ``.item()``s
+and all-reduces every step (ref: /root/reference/distribuuuu/trainer.py:50-55,
+utils.py:265-277) — a per-step host sync. Here ``accuracy`` is a pure jax
+function meant to be called *inside* the jitted step over the global batch,
+so cross-replica reduction is free (the batch is already global) and the
+host only fetches at PRINT_FREQ.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def accuracy(logits, targets, topk=(1,)):
+    """Top-k accuracy percentages over the (global) batch
+    (semantics: utils.py:265-277).
+
+    Args:
+        logits: [batch, classes] float array.
+        targets: [batch] int class labels.
+        topk: tuple of k values.
+    Returns:
+        list of scalar percentages, one per k.
+    """
+    maxk = max(topk)
+    _, pred = jax.lax.top_k(logits, maxk)  # [batch, maxk], ordered
+    hits = pred == targets[:, None]
+    return [
+        hits[:, :k].any(axis=1).mean(dtype=jnp.float32) * 100.0 for k in topk
+    ]
+
+
+def cross_entropy(logits, targets):
+    """Mean softmax cross-entropy with integer labels (≙ nn.CrossEntropyLoss,
+    ref: trainer.py:139). Loss math in fp32 regardless of compute dtype."""
+    logp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
+    nll = -jnp.take_along_axis(logp, targets[:, None], axis=-1)[:, 0]
+    return nll.mean()

@@ -1,0 +1,41 @@
+"""Optimizer construction (ref: /root/reference/distribuuuu/utils.py:187-196).
+
+The reference builds torch SGD with momentum/dampening/nesterov and L2 weight
+decay applied to **all** params including BN (utils.py:187-196,
+config.py:43-56). The optax chain below reproduces torch-SGD update order
+exactly: decay is added to the gradient *before* the momentum buffer update.
+
+LR is epoch-granular (set once per epoch, ref: trainer.py:25-26), so the
+learning rate rides through ``optax.inject_hyperparams`` and the trainer
+mutates it between epochs without rebuilding state — jit sees it as a traced
+scalar, so no recompilation.
+"""
+
+from __future__ import annotations
+
+import optax
+
+from distribuuuu_tpu.config import cfg
+
+
+def construct_optimizer() -> optax.GradientTransformation:
+    """SGD + momentum + nesterov + uniform weight decay, torch-ordered."""
+
+    @optax.inject_hyperparams
+    def _make(learning_rate):
+        return optax.chain(
+            optax.add_decayed_weights(cfg.OPTIM.WEIGHT_DECAY),
+            optax.sgd(
+                learning_rate=learning_rate,
+                momentum=cfg.OPTIM.MOMENTUM or None,
+                nesterov=cfg.OPTIM.NESTEROV,
+            ),
+        )
+
+    return _make(learning_rate=cfg.OPTIM.BASE_LR)
+
+
+def set_lr(opt_state, lr: float):
+    """Mutate the injected learning rate (≙ set_lr, ref: utils.py:313-316)."""
+    opt_state.hyperparams["learning_rate"] = lr
+    return opt_state

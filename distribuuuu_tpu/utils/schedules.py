@@ -1,0 +1,48 @@
+"""Learning-rate schedules (ref: /root/reference/distribuuuu/utils.py:280-316).
+
+Semantics mirrored exactly: epoch-granular LR (set once per epoch,
+ref: trainer.py:25-26), step policy ``LR_MULT ** idx`` over ``STEPS``,
+half-period cosine with relative ``MIN_LR`` floor, linear warmup ramp from
+``WARMUP_FACTOR`` to 1 over ``WARMUP_EPOCHS``, all scaled by ``BASE_LR``
+(which configs set with the linear batch-size scaling rule, BASELINE.md).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from distribuuuu_tpu.config import cfg
+
+
+def lr_fun_steps(cur_epoch: float) -> float:
+    """Piecewise-constant decay: LR_MULT ** (index of current step band)."""
+    steps = list(cfg.OPTIM.STEPS)
+    if not steps or steps[0] != 0:
+        steps = [0] + steps
+    ind = [i for i, s in enumerate(steps) if cur_epoch >= s][-1]
+    return float(cfg.OPTIM.LR_MULT) ** ind
+
+
+def lr_fun_cos(cur_epoch: float) -> float:
+    """Half-period cosine, floored at relative MIN_LR."""
+    base = 0.5 * (1.0 + np.cos(np.pi * cur_epoch / cfg.OPTIM.MAX_EPOCH))
+    return (1.0 - cfg.OPTIM.MIN_LR) * base + cfg.OPTIM.MIN_LR
+
+
+def get_lr_fun():
+    """Dispatch on OPTIM.LR_POLICY (ref: utils.py:292-298)."""
+    name = "lr_fun_" + cfg.OPTIM.LR_POLICY
+    if name not in globals():
+        raise NotImplementedError(f"Unknown LR policy: {cfg.OPTIM.LR_POLICY}")
+    return globals()[name]
+
+
+def get_epoch_lr(cur_epoch: float) -> float:
+    """Absolute LR for an epoch: policy × BASE_LR, with linear warmup
+    (ref: utils.py:301-310)."""
+    lr = get_lr_fun()(cur_epoch) * cfg.OPTIM.BASE_LR
+    if cur_epoch < cfg.OPTIM.WARMUP_EPOCHS:
+        alpha = cur_epoch / cfg.OPTIM.WARMUP_EPOCHS
+        warmup_factor = cfg.OPTIM.WARMUP_FACTOR * (1.0 - alpha) + alpha
+        lr *= warmup_factor
+    return float(lr)

@@ -1,0 +1,53 @@
+"""Seeding and environment setup (ref: /root/reference/distribuuuu/utils.py:54-68).
+
+The reference seeds numpy/torch/random with ``RNG_SEED + rank`` so each rank
+draws distinct augmentations, and toggles cuDNN determinism. Here: numpy and
+Python ``random`` get the rank-offset seed (they drive host-side data
+augmentation), and the returned ``jax.random`` key is folded from the *base*
+seed only — in-graph randomness under global-array jit must be identical on
+every process, XLA derives per-shard streams itself.
+"""
+
+from __future__ import annotations
+
+import os
+import random
+
+import jax
+import numpy as np
+
+from distribuuuu_tpu.config import cfg
+
+
+def setup_seed() -> jax.Array:
+    """Seed host RNGs rank-offset; return the in-graph base PRNG key.
+
+    Mirrors setup_seed's semantics (utils.py:54-68): if ``cfg.RNG_SEED`` is
+    None a random seed is drawn (and broadcast so all processes agree on the
+    in-graph key).
+    """
+    seed = cfg.RNG_SEED
+    if seed is None:
+        seed = int.from_bytes(os.urandom(4), "little")
+        if jax.process_count() > 1:
+            from distribuuuu_tpu.parallel.collectives import broadcast_from_primary
+
+            seed = int(broadcast_from_primary(np.int64(seed)))
+    rank = jax.process_index()
+    np.random.seed(seed + rank)
+    random.seed(seed + rank)
+    return jax.random.key(seed)
+
+
+def setup_env() -> None:
+    """Rank-0 output-dir creation + config dump (ref: utils.py:56-58).
+
+    Determinism knobs (the cuDNN-toggle analogue, ref: utils.py:64-68) are
+    applied by ``parallel.mesh.apply_backend_flags`` *before* backend init —
+    by the time this runs the backend is live and XLA_FLAGS edits are moot.
+    """
+    if jax.process_index() == 0:
+        os.makedirs(cfg.OUT_DIR, exist_ok=True)
+        from distribuuuu_tpu import config
+
+        config.dump_cfg()

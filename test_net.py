@@ -1,0 +1,19 @@
+"""Evaluate a classification model (≙ /root/reference/test_net.py).
+
+Usage:
+    python test_net.py --cfg config/resnet50.yaml MODEL.WEIGHTS path/to/ckpt
+"""
+
+import distribuuuu_tpu.config as config
+import distribuuuu_tpu.trainer as trainer
+from distribuuuu_tpu.config import cfg
+
+
+def main():
+    config.load_cfg_fom_args("Evaluate a classification model.")
+    cfg.freeze()
+    trainer.test_model()
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,79 @@
+"""Model zoo tests: param-count oracles and forward shapes.
+
+Param counts are checked against the published torch numbers — the survey's
+checkable oracle (SURVEY.md §7 hard part 4; reference table README.md:206-217
+for the archs the baselines cover).
+"""
+
+import jax
+import jax.numpy as jnp
+import pytest
+
+from distribuuuu_tpu.models import available_models, build_model
+
+# arch -> M params (torch/torchvision + reference README published values)
+PARAM_ORACLE = {
+    "resnet18": 11.690,
+    "resnet34": 21.798,
+    "resnet50": 25.557,
+    "resnet101": 44.549,
+    "resnet152": 60.193,
+    "resnext50_32x4d": 25.029,
+    "resnext101_32x8d": 88.791,
+    "wide_resnet50_2": 68.883,
+    "wide_resnet101_2": 126.887,
+}
+
+
+def _count_params(model, im_size=224):
+    shapes = jax.eval_shape(
+        lambda k: model.init(k, jnp.ones((1, im_size, im_size, 3)), train=False),
+        jax.random.key(0),
+    )
+    return sum(
+        int(jnp.prod(jnp.asarray(x.shape))) for x in jax.tree.leaves(shapes["params"])
+    )
+
+
+@pytest.mark.parametrize("arch", sorted(PARAM_ORACLE))
+def test_param_count_matches_torch(arch):
+    n = _count_params(build_model(arch)) / 1e6
+    assert n == pytest.approx(PARAM_ORACLE[arch], abs=5e-4), f"{arch}: {n:.3f}M"
+
+
+def test_unknown_arch_raises_with_listing():
+    with pytest.raises(KeyError, match="resnet18"):
+        build_model("not_a_model")
+
+
+def test_resnet18_forward_shapes_and_stats():
+    model = build_model("resnet18", num_classes=10)
+    x = jnp.ones((2, 64, 64, 3))
+    variables = model.init(jax.random.key(0), x, train=False)
+    assert "params" in variables and "batch_stats" in variables
+    # eval path: running stats, no mutation
+    logits = model.apply(variables, x, train=False)
+    assert logits.shape == (2, 10)
+    assert logits.dtype == jnp.float32
+    # train path mutates batch_stats
+    logits, mutated = model.apply(
+        variables, x, train=True, mutable=["batch_stats"]
+    )
+    assert logits.shape == (2, 10)
+    leaves_before = jax.tree.leaves(variables["batch_stats"])
+    leaves_after = jax.tree.leaves(mutated["batch_stats"])
+    assert any(
+        not jnp.allclose(a, b) for a, b in zip(leaves_before, leaves_after)
+    ), "train=True must update running stats"
+
+
+def test_num_classes_plumbs_through():
+    model = build_model("resnet18", num_classes=7)
+    x = jnp.ones((1, 32, 32, 3))
+    variables = model.init(jax.random.key(0), x, train=False)
+    assert model.apply(variables, x, train=False).shape == (1, 7)
+
+
+def test_registry_covers_reference_resnets():
+    for arch in PARAM_ORACLE:
+        assert arch in available_models()

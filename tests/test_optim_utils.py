@@ -1,0 +1,177 @@
+"""LR schedules, meters, accuracy, and SGD semantics vs the torch oracle."""
+
+import math
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+import torch
+
+from distribuuuu_tpu.config import cfg
+from distribuuuu_tpu.utils.meters import AverageMeter, ProgressMeter, construct_meters
+from distribuuuu_tpu.utils.metrics import accuracy, cross_entropy
+from distribuuuu_tpu.utils import schedules
+from distribuuuu_tpu.utils.optim import construct_optimizer, set_lr
+
+
+# ---------------------------------------------------------------- schedules
+def test_cos_schedule_endpoints():
+    cfg.OPTIM.LR_POLICY = "cos"
+    cfg.OPTIM.BASE_LR = 0.2
+    cfg.OPTIM.MAX_EPOCH = 100
+    cfg.OPTIM.WARMUP_EPOCHS = 0
+    assert schedules.get_epoch_lr(0) == pytest.approx(0.2)
+    # half-period cosine: at MAX_EPOCH/2 LR is half of base
+    assert schedules.get_epoch_lr(50) == pytest.approx(0.1)
+    assert schedules.get_epoch_lr(100) == pytest.approx(0.0, abs=1e-12)
+
+
+def test_cos_schedule_min_lr_floor():
+    cfg.OPTIM.LR_POLICY = "cos"
+    cfg.OPTIM.BASE_LR = 1.0
+    cfg.OPTIM.MIN_LR = 0.01
+    cfg.OPTIM.MAX_EPOCH = 10
+    cfg.OPTIM.WARMUP_EPOCHS = 0
+    assert schedules.get_epoch_lr(10) == pytest.approx(0.01)
+
+
+def test_warmup_ramp():
+    """Linear ramp from WARMUP_FACTOR to 1 over WARMUP_EPOCHS (utils.py:306-309)."""
+    cfg.OPTIM.LR_POLICY = "cos"
+    cfg.OPTIM.BASE_LR = 0.2
+    cfg.OPTIM.MAX_EPOCH = 100
+    cfg.OPTIM.WARMUP_EPOCHS = 5
+    cfg.OPTIM.WARMUP_FACTOR = 0.1
+    lr0 = schedules.get_epoch_lr(0)
+    assert lr0 == pytest.approx(0.2 * 0.1)
+    # strictly increasing through warmup
+    lrs = [schedules.get_epoch_lr(e) for e in range(6)]
+    assert all(b > a for a, b in zip(lrs, lrs[1:]))
+    # at the warmup boundary the ramp factor is gone
+    cos5 = 0.5 * (1 + math.cos(math.pi * 5 / 100)) * 0.2
+    assert schedules.get_epoch_lr(5) == pytest.approx(cos5)
+
+
+def test_steps_schedule():
+    cfg.OPTIM.LR_POLICY = "steps"
+    cfg.OPTIM.BASE_LR = 1.0
+    cfg.OPTIM.LR_MULT = 0.1
+    cfg.OPTIM.STEPS = [30, 60, 90]
+    cfg.OPTIM.WARMUP_EPOCHS = 0
+    assert schedules.get_epoch_lr(0) == pytest.approx(1.0)
+    assert schedules.get_epoch_lr(29) == pytest.approx(1.0)
+    assert schedules.get_epoch_lr(30) == pytest.approx(0.1)
+    assert schedules.get_epoch_lr(59) == pytest.approx(0.1)
+    assert schedules.get_epoch_lr(60) == pytest.approx(0.01)
+    assert schedules.get_epoch_lr(95) == pytest.approx(0.001)
+
+
+def test_unknown_policy_raises():
+    cfg.OPTIM.LR_POLICY = "nope"
+    with pytest.raises(NotImplementedError):
+        schedules.get_epoch_lr(0)
+
+
+# ------------------------------------------------------------------- meters
+def test_average_meter():
+    m = AverageMeter("Loss", ":.4e")
+    m.update(2.0, n=4)
+    m.update(4.0, n=4)
+    assert m.val == 4.0
+    assert m.avg == pytest.approx(3.0)
+    assert m.count == 8
+    assert "Loss" in str(m)
+
+
+def test_progress_meter_display_and_eta():
+    bt, dt, losses, top1, topk, progress = construct_meters(100, "Epoch[1]", topk=5)
+    bt.update(0.5)
+    losses.update(1.234)
+    line = progress.display(10)
+    assert "Epoch[1]" in line and "[ 10/100]" in line
+    eta = progress.get_eta(10)
+    assert eta != "N/A"  # 90 iters * 0.5s = 45s
+    assert "0:00:45" in eta
+
+
+# ------------------------------------------------------------------ metrics
+def test_accuracy_against_torch():
+    rng = np.random.default_rng(0)
+    logits = rng.normal(size=(64, 10)).astype(np.float32)
+    targets = rng.integers(0, 10, size=(64,)).astype(np.int32)
+    acc1, acc5 = accuracy(jnp.asarray(logits), jnp.asarray(targets), topk=(1, 5))
+
+    # torch oracle mirroring the reference implementation (utils.py:265-277)
+    t_out = torch.from_numpy(logits)
+    t_tgt = torch.from_numpy(targets.astype(np.int64))
+    _, pred = t_out.topk(5, 1, True, True)
+    correct = pred.t().eq(t_tgt.view(1, -1).expand_as(pred.t()))
+    ref1 = correct[:1].reshape(-1).float().sum().item() * 100.0 / 64
+    ref5 = correct[:5].reshape(-1).float().sum().item() * 100.0 / 64
+    assert float(acc1) == pytest.approx(ref1, abs=1e-4)
+    assert float(acc5) == pytest.approx(ref5, abs=1e-4)
+
+
+def test_cross_entropy_against_torch():
+    rng = np.random.default_rng(1)
+    logits = rng.normal(size=(32, 7)).astype(np.float32)
+    targets = rng.integers(0, 7, size=(32,)).astype(np.int32)
+    ours = float(cross_entropy(jnp.asarray(logits), jnp.asarray(targets)))
+    ref = float(
+        torch.nn.functional.cross_entropy(
+            torch.from_numpy(logits), torch.from_numpy(targets.astype(np.int64))
+        )
+    )
+    assert ours == pytest.approx(ref, abs=1e-5)
+
+
+# ---------------------------------------------------------------- optimizer
+def test_sgd_matches_torch_semantics():
+    """Our optax chain must reproduce torch SGD (momentum+nesterov+wd) exactly
+    (ref recipe: utils.py:187-196)."""
+    cfg.OPTIM.BASE_LR = 0.1
+    cfg.OPTIM.MOMENTUM = 0.9
+    cfg.OPTIM.NESTEROV = True
+    cfg.OPTIM.WEIGHT_DECAY = 5e-4
+
+    w0 = np.array([1.0, -2.0, 3.0], np.float32)
+    steps = 5
+    rng = np.random.default_rng(2)
+    grads = [rng.normal(size=3).astype(np.float32) for _ in range(steps)]
+
+    # torch reference
+    tw = torch.nn.Parameter(torch.from_numpy(w0.copy()))
+    topt = torch.optim.SGD(
+        [tw], lr=0.1, momentum=0.9, nesterov=True, weight_decay=5e-4, dampening=0
+    )
+    for g in grads:
+        topt.zero_grad()
+        tw.grad = torch.from_numpy(g.copy())
+        topt.step()
+
+    # ours
+    opt = construct_optimizer()
+    params = {"w": jnp.asarray(w0)}
+    state = opt.init(params)
+    for g in grads:
+        updates, state = opt.update({"w": jnp.asarray(g)}, state, params)
+        params = jax.tree.map(lambda p, u: p + u, params, updates)
+
+    np.testing.assert_allclose(np.asarray(params["w"]), tw.detach().numpy(), rtol=1e-5)
+
+
+def test_set_lr_changes_updates():
+    cfg.OPTIM.BASE_LR = 0.1
+    cfg.OPTIM.MOMENTUM = 0.0
+    cfg.OPTIM.NESTEROV = False
+    cfg.OPTIM.WEIGHT_DECAY = 0.0
+    opt = construct_optimizer()
+    params = {"w": jnp.ones(2)}
+    state = opt.init(params)
+    g = {"w": jnp.ones(2)}
+    upd, state = opt.update(g, state, params)
+    np.testing.assert_allclose(np.asarray(upd["w"]), -0.1 * np.ones(2), rtol=1e-6)
+    state = set_lr(state, 0.5)
+    upd, state = opt.update(g, state, params)
+    np.testing.assert_allclose(np.asarray(upd["w"]), -0.5 * np.ones(2), rtol=1e-6)

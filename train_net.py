@@ -1,0 +1,19 @@
+"""Train a classification model (≙ /root/reference/train_net.py).
+
+Usage:
+    python train_net.py --cfg config/resnet50.yaml [KEY VALUE ...]
+"""
+
+import distribuuuu_tpu.config as config
+import distribuuuu_tpu.trainer as trainer
+from distribuuuu_tpu.config import cfg
+
+
+def main():
+    config.load_cfg_fom_args("Train a classification model.")
+    cfg.freeze()
+    trainer.train_model()
+
+
+if __name__ == "__main__":
+    main()
