@@ -1,0 +1,81 @@
+"""ImageFolder dataset: ``root/split/class_name/*.jpg`` directory layout.
+
+Semantics mirror torchvision.datasets.ImageFolder as the reference uses it
+(ref: /root/reference/distribuuuu/utils.py:127,166): classes are the sorted
+subdirectory names, labels their indices; every file with an image extension
+counts. Decode is PIL; transforms are data/transforms.py.
+"""
+
+from __future__ import annotations
+
+import os
+
+import numpy as np
+from PIL import Image
+
+from distribuuuu_tpu.data.transforms import train_transform, val_transform
+
+IMG_EXTENSIONS = (
+    ".jpg", ".jpeg", ".png", ".ppm", ".bmp", ".pgm", ".tif", ".tiff", ".webp",
+)
+
+
+def scan_image_folder(root: str):
+    """Return (samples, classes): samples = [(path, class_idx)], classes sorted."""
+    if not os.path.isdir(root):
+        raise FileNotFoundError(
+            f"Dataset directory not found: {root} "
+            f"(expected ImageFolder layout root/class_name/*.jpg; "
+            f"set MODEL.DUMMY_INPUT True to train without data)"
+        )
+    classes = sorted(
+        d for d in os.listdir(root) if os.path.isdir(os.path.join(root, d))
+    )
+    if not classes:
+        raise FileNotFoundError(f"No class subdirectories under {root}")
+    samples = []
+    for idx, cls in enumerate(classes):
+        cdir = os.path.join(root, cls)
+        for dirpath, _, filenames in sorted(os.walk(cdir)):
+            for fname in sorted(filenames):
+                if fname.lower().endswith(IMG_EXTENSIONS):
+                    samples.append((os.path.join(dirpath, fname), idx))
+    if not samples:
+        raise FileNotFoundError(f"No images found under {root}")
+    return samples, classes
+
+
+class ImageFolderDataset:
+    def __init__(
+        self, root: str, split: str, im_size: int, train: bool, base_seed: int = 0
+    ):
+        self.dir = os.path.join(root, split)
+        self.samples, self.classes = scan_image_folder(self.dir)
+        self.im_size = im_size
+        self.train = train
+        self.base_seed = base_seed
+        self._epoch_seed = 0
+
+    def set_epoch_seed(self, seed: int) -> None:
+        """Augmentation randomness folds in the epoch (reference semantics:
+        worker RNG reseeded per epoch via the sampler reshuffle)."""
+        self._epoch_seed = seed
+
+    def __len__(self):
+        return len(self.samples)
+
+    def __getitem__(self, idx: int):
+        path, label = self.samples[idx]
+        with Image.open(path) as img:
+            img = img.convert("RGB")
+            if self.train:
+                # RNG_SEED participates so different seeds draw different
+                # augmentation streams (≙ rank-offset host seeding intent,
+                # ref: utils.py:61-63)
+                rng = np.random.default_rng(
+                    np.random.SeedSequence([self.base_seed, self._epoch_seed, idx])
+                )
+                arr = train_transform(img, self.im_size, rng)
+            else:
+                arr = val_transform(img, self.im_size)
+        return arr, label

@@ -53,6 +53,8 @@ class Loader:
 
     def set_epoch(self, epoch: int) -> None:
         self.sampler.set_epoch(epoch)
+        if hasattr(self.dataset, "set_epoch_seed"):
+            self.dataset.set_epoch_seed(epoch)
 
     def __len__(self):
         n = self.sampler.num_samples
@@ -118,19 +120,27 @@ class Loader:
                     break
 
 
-def _build_dataset(split: str, im_size: int, train: bool):
+def _build_dataset(split: str, train: bool):
     if cfg.MODEL.DUMMY_INPUT:
-        # small but non-trivial epoch (ref DummyDataset defaults are caller-set)
-        return DummyDataset(length=cfg.TRAIN.BATCH_SIZE * 64, size=im_size)
+        # dummy images are model-input-sized for both splits (the reference
+        # likewise uses 224² dummies everywhere, utils.py:125,159)
+        return DummyDataset(
+            length=cfg.TRAIN.BATCH_SIZE * 64, size=cfg.TRAIN.IM_SIZE
+        )
     from distribuuuu_tpu.data.imagefolder import ImageFolderDataset
 
     root = cfg.TRAIN.DATASET if train else cfg.TEST.DATASET
-    return ImageFolderDataset(root, split, im_size=im_size, train=train)
+    # train: RandomResizedCrop target; val: shorter-side resize before the
+    # fixed 224 center crop (ref: utils.py:131,169-170)
+    im_size = cfg.TRAIN.IM_SIZE if train else cfg.TEST.IM_SIZE
+    return ImageFolderDataset(
+        root, split, im_size=im_size, train=train, base_seed=cfg.RNG_SEED or 0
+    )
 
 
 def construct_train_loader() -> Loader:
     """Train pipeline (ref: utils.py:121-152): shuffled, drop_last."""
-    dataset = _build_dataset(cfg.TRAIN.SPLIT, cfg.TRAIN.IM_SIZE, train=True)
+    dataset = _build_dataset(cfg.TRAIN.SPLIT, train=True)
     return Loader(
         dataset,
         batch_size=_per_host_batch(cfg.TRAIN.BATCH_SIZE),
@@ -143,7 +153,7 @@ def construct_train_loader() -> Loader:
 
 def construct_val_loader() -> Loader:
     """Val pipeline (ref: utils.py:155-184): unshuffled, keep ragged tail."""
-    dataset = _build_dataset(cfg.TEST.SPLIT, cfg.TEST.IM_SIZE, train=False)
+    dataset = _build_dataset(cfg.TEST.SPLIT, train=False)
     return Loader(
         dataset,
         batch_size=_per_host_batch(cfg.TEST.BATCH_SIZE),

@@ -1,0 +1,93 @@
+"""Image transforms with torchvision-matching semantics, on PIL + numpy.
+
+Train: RandomResizedCrop(IM_SIZE) + RandomHorizontalFlip + Normalize
+(ref: /root/reference/distribuuuu/utils.py:127-139).
+Val: Resize(shorter side = TEST.IM_SIZE) + CenterCrop(224) + Normalize
+(ref: utils.py:163-172). Mean/std are the standard ImageNet constants.
+
+Output is NHWC float32 (TPU-native layout); normalization can be delegated
+to the optional C++ kernel (native/) when built.
+"""
+
+from __future__ import annotations
+
+import math
+
+import numpy as np
+from PIL import Image
+
+IMAGENET_MEAN = np.asarray([0.485, 0.456, 0.406], np.float32)
+IMAGENET_STD = np.asarray([0.229, 0.224, 0.225], np.float32)
+
+
+def random_resized_crop(
+    img: Image.Image,
+    size: int,
+    rng: np.random.Generator,
+    scale=(0.08, 1.0),
+    ratio=(3 / 4, 4 / 3),
+) -> Image.Image:
+    """torchvision RandomResizedCrop: 10 attempts at area/ratio jitter, then
+    a center-crop fallback."""
+    width, height = img.size
+    area = width * height
+    log_ratio = (math.log(ratio[0]), math.log(ratio[1]))
+    for _ in range(10):
+        target_area = area * rng.uniform(scale[0], scale[1])
+        aspect = math.exp(rng.uniform(log_ratio[0], log_ratio[1]))
+        w = int(round(math.sqrt(target_area * aspect)))
+        h = int(round(math.sqrt(target_area / aspect)))
+        if 0 < w <= width and 0 < h <= height:
+            i = int(rng.integers(0, height - h + 1))
+            j = int(rng.integers(0, width - w + 1))
+            return img.resize(
+                (size, size), Image.BILINEAR, box=(j, i, j + w, i + h)
+            )
+    # fallback: center crop at the closest valid ratio
+    in_ratio = width / height
+    if in_ratio < ratio[0]:
+        w, h = width, int(round(width / ratio[0]))
+    elif in_ratio > ratio[1]:
+        h, w = height, int(round(height * ratio[1]))
+    else:
+        w, h = width, height
+    i, j = (height - h) // 2, (width - w) // 2
+    return img.resize((size, size), Image.BILINEAR, box=(j, i, j + w, i + h))
+
+
+def resize_shorter(img: Image.Image, size: int) -> Image.Image:
+    """torchvision Resize(int): shorter side to ``size``, keep aspect."""
+    width, height = img.size
+    if width <= height:
+        new_w, new_h = size, int(round(size * height / width))
+    else:
+        new_w, new_h = int(round(size * width / height)), size
+    return img.resize((new_w, new_h), Image.BILINEAR)
+
+
+def center_crop(img: Image.Image, size: int) -> Image.Image:
+    width, height = img.size
+    left = (width - size) // 2
+    top = (height - size) // 2
+    return img.crop((left, top, left + size, top + size))
+
+
+def to_normalized_array(img: Image.Image) -> np.ndarray:
+    """ToTensor + Normalize, NHWC float32."""
+    arr = np.asarray(img, np.float32) / 255.0
+    if arr.ndim == 2:  # grayscale
+        arr = np.stack([arr] * 3, axis=-1)
+    return (arr - IMAGENET_MEAN) / IMAGENET_STD
+
+
+def train_transform(img: Image.Image, im_size: int, rng: np.random.Generator):
+    img = random_resized_crop(img, im_size, rng)
+    if rng.random() < 0.5:
+        img = img.transpose(Image.FLIP_LEFT_RIGHT)
+    return to_normalized_array(img)
+
+
+def val_transform(img: Image.Image, resize_size: int, crop_size: int = 224):
+    img = resize_shorter(img, resize_size)
+    img = center_crop(img, crop_size)
+    return to_normalized_array(img)

@@ -20,6 +20,19 @@ from distribuuuu_tpu.models.resnet import (  # noqa: F401
     wide_resnet50_2,
     wide_resnet101_2,
 )
+from distribuuuu_tpu.models.densenet import (  # noqa: F401
+    densenet121,
+    densenet161,
+    densenet169,
+    densenet201,
+)
+from distribuuuu_tpu.models.botnet import botnet50  # noqa: F401
+from distribuuuu_tpu.models.regnet import (  # noqa: F401
+    regnetx_160,
+    regnety_160,
+    regnety_320,
+)
+from distribuuuu_tpu.models.efficientnet import efficientnet_b0  # noqa: F401
 
 _REGISTRY = {}
 
@@ -39,6 +52,15 @@ for _fn in (
     resnext101_32x8d,
     wide_resnet50_2,
     wide_resnet101_2,
+    densenet121,
+    densenet161,
+    densenet169,
+    densenet201,
+    botnet50,
+    regnetx_160,
+    regnety_160,
+    regnety_320,
+    efficientnet_b0,
 ):
     register_model(_fn)
 

@@ -1,0 +1,128 @@
+"""DenseNet family (arXiv:1608.06993), TPU-native flax implementation.
+
+Capability parity with the reference (ref: /root/reference/distribuuuu/models/
+densenet.py): dense layers (BN→relu→1x1 bottleneck→BN→relu→3x3) with
+concatenative growth, transitions halving channels + 2x2 avgpool, and the
+``memory_efficient`` option — the reference's torch.utils.checkpoint
+(ref: densenet.py:81-86,104-110) maps to ``flax.linen.remat``
+(jax.checkpoint): activations inside each dense layer are rematerialized in
+the backward pass, trading FLOPs for HBM exactly like the torch version.
+
+Constructors: densenet121/161/169/201 (ref: densenet.py:300-365).
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+from distribuuuu_tpu.models.layers import (
+    BatchNorm,
+    Dense,
+    global_avg_pool,
+    kaiming_normal_fan_out,
+    max_pool_3x3_s2,
+)
+
+
+class DenseLayer(nn.Module):
+    """BN→relu→conv1x1(bn_size·k)→BN→relu→conv3x3(k) (ref: densenet.py:23-117)."""
+
+    growth_rate: int
+    bn_size: int = 4
+    dtype: Any = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        out = BatchNorm(dtype=self.dtype)(x, train=train)
+        out = nn.relu(out)
+        out = nn.Conv(
+            self.bn_size * self.growth_rate, (1, 1), use_bias=False,
+            dtype=self.dtype, param_dtype=jnp.float32,
+            kernel_init=kaiming_normal_fan_out,
+        )(out)
+        out = BatchNorm(dtype=self.dtype)(out, train=train)
+        out = nn.relu(out)
+        out = nn.Conv(
+            self.growth_rate, (3, 3), padding=[(1, 1), (1, 1)], use_bias=False,
+            dtype=self.dtype, param_dtype=jnp.float32,
+            kernel_init=kaiming_normal_fan_out,
+        )(out)
+        return out
+
+
+class DenseNet(nn.Module):
+    """Stem + 4 dense blocks with transitions + BN head (ref: densenet.py:169-263)."""
+
+    growth_rate: int = 32
+    block_config: tuple = (6, 12, 24, 16)
+    num_init_features: int = 64
+    bn_size: int = 4
+    num_classes: int = 1000
+    memory_efficient: bool = False
+    dtype: Any = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        x = x.astype(self.dtype)
+        x = nn.Conv(
+            self.num_init_features, (7, 7), strides=2, padding=[(3, 3), (3, 3)],
+            use_bias=False, dtype=self.dtype, param_dtype=jnp.float32,
+            kernel_init=kaiming_normal_fan_out,
+        )(x)
+        x = BatchNorm(dtype=self.dtype)(x, train=train)
+        x = nn.relu(x)
+        x = max_pool_3x3_s2(x)
+
+        layer_cls = DenseLayer
+        if self.memory_efficient:
+            # ≙ torch.utils.checkpoint on the bottleneck (densenet.py:81-86):
+            # recompute the layer's activations during backprop.
+            layer_cls = nn.remat(DenseLayer, static_argnums=(2,))
+
+        num_features = self.num_init_features
+        for i, num_layers in enumerate(self.block_config):
+            for j in range(num_layers):
+                # explicit names keep the param tree identical whether or not
+                # memory_efficient wraps the class (checkpoints interchange)
+                new = layer_cls(
+                    growth_rate=self.growth_rate,
+                    bn_size=self.bn_size,
+                    dtype=self.dtype,
+                    name=f"block{i}_layer{j}",
+                )(x, train)
+                x = jnp.concatenate([x, new], axis=-1)
+                num_features += self.growth_rate
+            if i != len(self.block_config) - 1:
+                # transition: BN→relu→1x1(half)→avgpool2 (ref: densenet.py:151-166)
+                x = BatchNorm(dtype=self.dtype)(x, train=train)
+                x = nn.relu(x)
+                num_features = num_features // 2
+                x = nn.Conv(
+                    num_features, (1, 1), use_bias=False, dtype=self.dtype,
+                    param_dtype=jnp.float32, kernel_init=kaiming_normal_fan_out,
+                )(x)
+                x = nn.avg_pool(x, (2, 2), strides=(2, 2))
+
+        x = BatchNorm(dtype=self.dtype)(x, train=train)
+        x = nn.relu(x)
+        x = global_avg_pool(x)
+        return Dense(self.num_classes, dtype=jnp.float32)(x.astype(jnp.float32))
+
+
+def densenet121(num_classes=1000, **kw):
+    return DenseNet(32, (6, 12, 24, 16), 64, num_classes=num_classes, **kw)
+
+
+def densenet161(num_classes=1000, **kw):
+    return DenseNet(48, (6, 12, 36, 24), 96, num_classes=num_classes, **kw)
+
+
+def densenet169(num_classes=1000, **kw):
+    return DenseNet(32, (6, 12, 32, 32), 64, num_classes=num_classes, **kw)
+
+
+def densenet201(num_classes=1000, **kw):
+    return DenseNet(32, (6, 12, 48, 32), 64, num_classes=num_classes, **kw)

@@ -1,0 +1,129 @@
+"""EfficientNet-B0 (arXiv:1905.11946), implemented from scratch in flax.
+
+The reference reaches this arch through timm (ref: /root/reference/
+distribuuuu/trainer.py:123-128; config/efficientnet_b0.yaml). Param-count
+oracle from the baseline table: 5.289M (ref: README.md:212).
+
+MBConv: 1x1 expand → depthwise k×k → SE (ratio 0.25 of block input) →
+1x1 project, residual when stride 1 and channels match. SiLU activations,
+BN eps 1e-3 (torch momentum 0.01 ⇒ flax momentum 0.99).
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+from distribuuuu_tpu.models.layers import (
+    Dense,
+    global_avg_pool,
+    kaiming_normal_fan_out,
+)
+
+# (expand_ratio, channels, repeats, stride, kernel)
+_B0_BLOCKS = (
+    (1, 16, 1, 1, 3),
+    (6, 24, 2, 2, 3),
+    (6, 40, 2, 2, 5),
+    (6, 80, 3, 2, 3),
+    (6, 112, 3, 1, 5),
+    (6, 192, 4, 2, 5),
+    (6, 320, 1, 1, 3),
+)
+
+
+class _BN(nn.Module):
+    dtype: Any = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        return nn.BatchNorm(
+            use_running_average=not train,
+            momentum=0.99,
+            epsilon=1e-3,
+            dtype=self.dtype,
+            param_dtype=jnp.float32,
+        )(x)
+
+
+def _conv(features, kernel, strides=1, groups=1, dtype=jnp.bfloat16):
+    k = (kernel, kernel)
+    pad = [(kernel // 2, kernel // 2)] * 2
+    return nn.Conv(
+        features, k, strides=strides, padding=pad, feature_group_count=groups,
+        use_bias=False, dtype=dtype, param_dtype=jnp.float32,
+        kernel_init=kaiming_normal_fan_out,
+    )
+
+
+class MBConv(nn.Module):
+    in_ch: int
+    out_ch: int
+    expand_ratio: int
+    strides: int
+    kernel: int
+    dtype: Any = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        inp = x
+        ch = self.in_ch * self.expand_ratio
+        if self.expand_ratio != 1:
+            x = _conv(ch, 1, dtype=self.dtype)(x)
+            x = _BN(self.dtype)(x, train=train)
+            x = nn.silu(x)
+        x = _conv(ch, self.kernel, self.strides, groups=ch, dtype=self.dtype)(x)
+        x = _BN(self.dtype)(x, train=train)
+        x = nn.silu(x)
+        # SE, reduction relative to block input channels
+        se_ch = max(1, self.in_ch // 4)
+        s = jnp.mean(x, axis=(1, 2), keepdims=True)
+        s = nn.Conv(se_ch, (1, 1), dtype=self.dtype, param_dtype=jnp.float32)(s)
+        s = nn.silu(s)
+        s = nn.Conv(ch, (1, 1), dtype=self.dtype, param_dtype=jnp.float32)(s)
+        x = x * nn.sigmoid(s)
+        x = _conv(self.out_ch, 1, dtype=self.dtype)(x)
+        x = _BN(self.dtype)(x, train=train)
+        if self.strides == 1 and self.in_ch == self.out_ch:
+            x = x + inp
+        return x
+
+
+class EfficientNet(nn.Module):
+    blocks: tuple = _B0_BLOCKS
+    stem_ch: int = 32
+    head_ch: int = 1280
+    num_classes: int = 1000
+    dropout_rate: float = 0.2
+    dtype: Any = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        x = x.astype(self.dtype)
+        x = _conv(self.stem_ch, 3, 2, dtype=self.dtype)(x)
+        x = _BN(self.dtype)(x, train=train)
+        x = nn.silu(x)
+        in_ch = self.stem_ch
+        for t, c, n, s, k in self.blocks:
+            for i in range(n):
+                x = MBConv(
+                    in_ch=in_ch,
+                    out_ch=c,
+                    expand_ratio=t,
+                    strides=s if i == 0 else 1,
+                    kernel=k,
+                    dtype=self.dtype,
+                )(x, train=train)
+                in_ch = c
+        x = _conv(self.head_ch, 1, dtype=self.dtype)(x)
+        x = _BN(self.dtype)(x, train=train)
+        x = nn.silu(x)
+        x = global_avg_pool(x)
+        x = nn.Dropout(self.dropout_rate, deterministic=not train)(x)
+        return Dense(self.num_classes, dtype=jnp.float32)(x.astype(jnp.float32))
+
+
+def efficientnet_b0(num_classes=1000, **kw):
+    return EfficientNet(num_classes=num_classes, **kw)

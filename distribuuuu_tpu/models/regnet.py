@@ -1,0 +1,147 @@
+"""RegNet-X/Y (arXiv:2003.13678 "Designing Network Design Spaces"),
+implemented from scratch in flax.
+
+The reference reaches these archs through timm (ref: /root/reference/
+distribuuuu/trainer.py:123-128 fallback; configs config/regnet*_*.yaml), so
+this is a native re-derivation from the paper's quantized-linear width rule.
+Baseline param-count oracles (ref: README.md:215-217): regnetx_160 54.279M,
+regnety_160 83.590M, regnety_320 145.047M.
+
+Structure: simple 3x3/s2 stem (32ch) → 4 stages of bottleneck-1 X/Y blocks
+(1x1 → 3x3 grouped /s2 → [SE for Y] → 1x1, residual) → head. SE ratio is
+relative to the block's *input* width.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import flax.linen as nn
+import jax.numpy as jnp
+import numpy as np
+
+from distribuuuu_tpu.models.layers import ConvBN, Dense, global_avg_pool
+
+
+def generate_widths(w_a: float, w_0: int, w_m: float, depth: int, q: int = 8):
+    """Quantized-linear per-block widths → per-stage (width, depth) lists."""
+    ws_cont = np.arange(depth) * w_a + w_0
+    ks = np.round(np.log(ws_cont / w_0) / np.log(w_m))
+    ws = w_0 * np.power(w_m, ks)
+    ws = (np.round(ws / q) * q).astype(int)
+    stage_ws, stage_ds = np.unique(ws, return_counts=True)
+    order = np.argsort(stage_ws)
+    return stage_ws[order].tolist(), stage_ds[order].tolist()
+
+
+def adjust_groups(widths, group_w: int):
+    """Clamp group width to the block width and round widths to multiples."""
+    gs = [min(group_w, w) for w in widths]
+    ws = [int(round(w / g) * g) for w, g in zip(widths, gs)]
+    return ws, gs
+
+
+class SqueezeExcite(nn.Module):
+    """SE with reduction relative to a caller-chosen width."""
+
+    se_width: int
+    dtype: Any = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x):
+        s = jnp.mean(x, axis=(1, 2), keepdims=True)
+        s = nn.Conv(self.se_width, (1, 1), dtype=self.dtype, param_dtype=jnp.float32)(s)
+        s = nn.relu(s)
+        s = nn.Conv(x.shape[-1], (1, 1), dtype=self.dtype, param_dtype=jnp.float32)(s)
+        return x * nn.sigmoid(s)
+
+
+class RegNetBlock(nn.Module):
+    """X/Y bottleneck block, bottleneck ratio 1."""
+
+    width: int
+    strides: int
+    group_width: int
+    se_width: int = 0  # 0 = X block (no SE)
+    downsample: bool = False
+    dtype: Any = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        shortcut = x
+        if self.downsample:
+            shortcut = ConvBN(self.width, (1, 1), self.strides, dtype=self.dtype)(
+                x, train=train
+            )
+        out = ConvBN(self.width, (1, 1), 1, dtype=self.dtype, act=nn.relu)(
+            x, train=train
+        )
+        out = ConvBN(
+            self.width, (3, 3), self.strides,
+            groups=self.width // self.group_width, dtype=self.dtype, act=nn.relu,
+        )(out, train=train)
+        if self.se_width > 0:
+            out = SqueezeExcite(self.se_width, dtype=self.dtype)(out)
+        out = ConvBN(
+            self.width, (1, 1), 1, dtype=self.dtype,
+            bn_scale_init=nn.initializers.zeros,
+        )(out, train=train)
+        return nn.relu(out + shortcut)
+
+
+class RegNet(nn.Module):
+    w_a: float
+    w_0: int
+    w_m: float
+    depth: int
+    group_w: int
+    se_ratio: float = 0.0
+    num_classes: int = 1000
+    stem_w: int = 32
+    dtype: Any = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        x = x.astype(self.dtype)
+        x = ConvBN(self.stem_w, (3, 3), 2, dtype=self.dtype, act=nn.relu)(
+            x, train=train
+        )
+        widths, depths = generate_widths(self.w_a, self.w_0, self.w_m, self.depth)
+        widths, groups = adjust_groups(widths, self.group_w)
+        in_w = self.stem_w
+        for w, d, g in zip(widths, depths, groups):
+            for i in range(d):
+                se_w = int(round(in_w * self.se_ratio)) if self.se_ratio else 0
+                x = RegNetBlock(
+                    width=w,
+                    strides=2 if i == 0 else 1,
+                    group_width=g,
+                    se_width=se_w,
+                    downsample=(i == 0),
+                    dtype=self.dtype,
+                )(x, train=train)
+                in_w = w
+        x = global_avg_pool(x)
+        return Dense(self.num_classes, dtype=jnp.float32)(x.astype(jnp.float32))
+
+
+# ---------------------------------------------------------------------------
+# Constructors for the baseline archs (16GF / 32GF design-space params).
+# ---------------------------------------------------------------------------
+
+def regnetx_160(num_classes=1000, **kw):
+    """RegNetX-16GF (timm name regnetx_160; ref baseline README.md:215)."""
+    return RegNet(w_a=55.59, w_0=216, w_m=2.1, depth=22, group_w=128,
+                  num_classes=num_classes, **kw)
+
+
+def regnety_160(num_classes=1000, **kw):
+    """RegNetY-16GF (ref baseline README.md:216)."""
+    return RegNet(w_a=106.23, w_0=200, w_m=2.48, depth=18, group_w=112,
+                  se_ratio=0.25, num_classes=num_classes, **kw)
+
+
+def regnety_320(num_classes=1000, **kw):
+    """RegNetY-32GF (ref baseline README.md:217)."""
+    return RegNet(w_a=115.89, w_0=232, w_m=2.53, depth=20, group_w=232,
+                  se_ratio=0.25, num_classes=num_classes, **kw)

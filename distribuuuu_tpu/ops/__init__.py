@@ -1,0 +1,7 @@
+"""Custom ops: attention kernels and their pure-jax references."""
+
+from distribuuuu_tpu.ops.attention import (  # noqa: F401
+    mhsa_2d,
+    rel_to_abs,
+    relative_logits_1d,
+)

@@ -53,6 +53,8 @@ class TrainState:
     params: Any
     batch_stats: Any
     opt_state: Any
+    step: Any  # scalar int32 — drives per-step RNG folding (dropout etc.)
+    key: Any  # base PRNG key (not checkpointed; re-derived from RNG_SEED)
 
 
 def build_model_from_cfg():
@@ -79,6 +81,8 @@ def create_train_state(model, key, mesh, im_size: int) -> TrainState:
         params=variables["params"],
         batch_stats=variables["batch_stats"],
         opt_state=opt_state,
+        step=jnp.int32(0),
+        key=key,
     )
     return jax.device_put(state, sharding_lib.replicate(mesh))
 
@@ -88,12 +92,15 @@ def make_train_step(model, optimizer, topk: int):
     (≙ the hot loop body, ref: trainer.py:37-58)."""
 
     def train_step(state: TrainState, batch):
+        step_key = jax.random.fold_in(state.key, state.step)
+
         def loss_fn(params):
             logits, mutated = model.apply(
                 {"params": params, "batch_stats": state.batch_stats},
                 batch["image"],
                 train=True,
                 mutable=["batch_stats"],
+                rngs={"dropout": step_key},
             )
             loss = cross_entropy(logits, batch["label"])
             return loss, (logits, mutated["batch_stats"])
@@ -108,7 +115,11 @@ def make_train_step(model, optimizer, topk: int):
         acc1, acck = accuracy(logits, batch["label"], topk=(1, topk))
         metrics = {"loss": loss, "top1": acc1, "topk": acck}
         new_state = TrainState(
-            params=new_params, batch_stats=new_stats, opt_state=new_opt_state
+            params=new_params,
+            batch_stats=new_stats,
+            opt_state=new_opt_state,
+            step=state.step + 1,
+            key=state.key,
         )
         return new_state, metrics
 
@@ -128,7 +139,7 @@ def make_eval_step(model, topk: int):
         mask = batch["mask"]
         logp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
         nll = -jnp.take_along_axis(logp, batch["label"][:, None], axis=-1)[:, 0]
-        _, pred = jax.lax.top_k(logits, topk)
+        _, pred = jax.lax.top_k(logits, min(topk, logits.shape[-1]))
         hits = pred == batch["label"][:, None]
         c1 = (hits[:, :1].any(axis=1) * mask).sum()
         ck = (hits.any(axis=1) * mask).sum()
@@ -142,6 +153,11 @@ def make_eval_step(model, topk: int):
     return jax.jit(eval_step)
 
 
+def effective_topk() -> int:
+    """TOPK clamped to the class count, so 'Acc@k' labels match the math."""
+    return min(cfg.TRAIN.TOPK, cfg.MODEL.NUM_CLASSES)
+
+
 def train_epoch(loader, mesh, state, train_step, epoch: int, logger):
     """One epoch of the hot loop (ref: trainer.py:14-64)."""
     lr = get_epoch_lr(epoch)
@@ -149,7 +165,7 @@ def train_epoch(loader, mesh, state, train_step, epoch: int, logger):
     loader.set_epoch(epoch)  # reshuffle shards (ref: trainer.py:33)
     num_batches = len(loader)
     batch_time, data_time, losses, top1, topk_m, progress = construct_meters(
-        num_batches, f"Epoch[{epoch + 1}/{cfg.OPTIM.MAX_EPOCH}]", cfg.TRAIN.TOPK
+        num_batches, f"Epoch[{epoch + 1}/{cfg.OPTIM.MAX_EPOCH}]", effective_topk()
     )
     pending = []  # (step_idx, device metrics) awaiting async fetch
     end = time.perf_counter()
@@ -197,16 +213,18 @@ def validate(loader, mesh, state, eval_step, epoch: int, logger):
     if mesh_lib.is_primary():
         logger.info(
             "Eval[%d]  Loss %.4f  Acc@1 %.3f  Acc@%d %.3f  (%d samples)",
-            epoch + 1, loss, top1, cfg.TRAIN.TOPK, topk, int(n),
+            epoch + 1, loss, top1, effective_topk(), topk, int(n),
         )
     return top1, topk
 
 
 def _state_tree(state: TrainState) -> dict:
+    # key is intentionally excluded: it is re-derived from RNG_SEED at startup
     return {
         "params": state.params,
         "batch_stats": state.batch_stats,
         "opt_state": state.opt_state,
+        "step": state.step,
     }
 
 
@@ -241,7 +259,13 @@ def _resume(state: TrainState, mesh) -> tuple[TrainState, int, float]:
     best_acc1 = float(restored.get("best_acc1", 0.0))
     logger.info("resumed from %s (epoch %d)", path, start_epoch)
     return (
-        TrainState(params=params, batch_stats=stats, opt_state=opt_state),
+        TrainState(
+            params=params,
+            batch_stats=stats,
+            opt_state=opt_state,
+            step=jnp.int32(int(restored.get("step", 0))),
+            key=state.key,
+        ),
         start_epoch,
         best_acc1,
     )
@@ -268,8 +292,8 @@ def train_model():
     optimizer = construct_optimizer()
     train_loader = construct_train_loader()
     val_loader = construct_val_loader()
-    train_step = make_train_step(model, optimizer, cfg.TRAIN.TOPK)
-    eval_step = make_eval_step(model, cfg.TRAIN.TOPK)
+    train_step = make_train_step(model, optimizer, effective_topk())
+    eval_step = make_eval_step(model, effective_topk())
 
     start_epoch, best_acc1 = 0, 0.0
     if cfg.TRAIN.AUTO_RESUME and ckpt.has_checkpoint():
@@ -310,11 +334,13 @@ def test_model():
                 jax.tree.map(lambda t, n: np.asarray(n, t.dtype), state.batch_stats,
                              restored["batch_stats"]), repl),
             opt_state=state.opt_state,
+            step=state.step,
+            key=state.key,
         )
         logger.info("loaded weights from %s", cfg.MODEL.WEIGHTS)
     val_loader = construct_val_loader()
-    eval_step = make_eval_step(model, cfg.TRAIN.TOPK)
+    eval_step = make_eval_step(model, effective_topk())
     top1, topk = validate(val_loader, mesh, state, eval_step, 0, logger)
     if mesh_lib.is_primary():
-        logger.info("TEST  Acc@1 %.3f  Acc@%d %.3f", top1, cfg.TRAIN.TOPK, topk)
+        logger.info("TEST  Acc@1 %.3f  Acc@%d %.3f", top1, effective_topk(), topk)
     return top1, topk

@@ -25,11 +25,13 @@ def accuracy(logits, targets, topk=(1,)):
     Returns:
         list of scalar percentages, one per k.
     """
-    maxk = max(topk)
+    # clamp k to the class count (TOPK=5 must not crash a 4-class head)
+    maxk = min(max(topk), logits.shape[-1])
     _, pred = jax.lax.top_k(logits, maxk)  # [batch, maxk], ordered
     hits = pred == targets[:, None]
     return [
-        hits[:, :k].any(axis=1).mean(dtype=jnp.float32) * 100.0 for k in topk
+        hits[:, : min(k, maxk)].any(axis=1).mean(dtype=jnp.float32) * 100.0
+        for k in topk
     ]
 
 

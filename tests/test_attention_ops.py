@@ -1,0 +1,115 @@
+"""Relative-position attention op tests against brute-force index oracles.
+
+The pad-reshape rel→abs trick (ref math: /root/reference/distribuuuu/models/
+botnet.py:25-57) is checked against direct gather indexing, which is an
+independent derivation: abs[i, j] = rel[i, (j - i) + (L-1)].
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from distribuuuu_tpu.ops.attention import (
+    abs_pos_logits,
+    mhsa_2d,
+    rel_pos_logits,
+    rel_to_abs,
+    relative_logits_1d,
+)
+
+
+def test_rel_to_abs_against_gather():
+    rng = np.random.default_rng(0)
+    B, N, L = 2, 3, 5
+    rel = rng.normal(size=(B, N, L, 2 * L - 1)).astype(np.float32)
+    out = np.asarray(rel_to_abs(jnp.asarray(rel)))
+    expected = np.zeros((B, N, L, L), np.float32)
+    for i in range(L):
+        for j in range(L):
+            expected[:, :, i, j] = rel[:, :, i, (j - i) + (L - 1)]
+    np.testing.assert_allclose(out, expected, rtol=1e-6)
+
+
+def test_relative_logits_1d_shapes_and_broadcast():
+    rng = np.random.default_rng(1)
+    B, N, H, W, d = 2, 2, 3, 4, 6
+    q = rng.normal(size=(B, N, H, W, d)).astype(np.float32)
+    rel_k = rng.normal(size=(2 * W - 1, d)).astype(np.float32)
+    out = np.asarray(relative_logits_1d(jnp.asarray(q), jnp.asarray(rel_k)))
+    assert out.shape == (B, N, H, H, W, W)
+    # broadcast over the expanded (key-row) axis: identical for all key rows
+    np.testing.assert_allclose(out[:, :, :, 0], out[:, :, :, 1], rtol=1e-6)
+    # and each (query row, query col, key col) value = q · rel_k[rel index]
+    for y in range(W):
+        for j in range(W):
+            expected = q[:, :, :, y, :] @ rel_k[(j - y) + (W - 1)]
+            np.testing.assert_allclose(
+                out[:, :, :, 0, y, j], expected, rtol=1e-5
+            )
+
+
+def test_rel_pos_logits_decomposes_into_row_and_col_terms():
+    """Full 2D logits must equal width-term + height-term computed by brute
+    force over absolute positions."""
+    rng = np.random.default_rng(2)
+    B, N, H, W, d = 1, 2, 3, 3, 4
+    q = rng.normal(size=(B, N, H * W, d)).astype(np.float32)
+    rel_h = rng.normal(size=(2 * H - 1, d)).astype(np.float32)
+    rel_w = rng.normal(size=(2 * W - 1, d)).astype(np.float32)
+    out = np.asarray(
+        rel_pos_logits(jnp.asarray(q), jnp.asarray(rel_h), jnp.asarray(rel_w), H, W)
+    )
+    q4 = q.reshape(B, N, H, W, d)
+    expected = np.zeros((B, N, H * W, H * W), np.float32)
+    for qx in range(H):
+        for qy in range(W):
+            for kx in range(H):
+                for ky in range(W):
+                    qi, ki = qx * W + qy, kx * W + ky
+                    expected[:, :, qi, ki] = (
+                        q4[:, :, qx, qy, :] @ rel_w[(ky - qy) + (W - 1)]
+                        + q4[:, :, qx, qy, :] @ rel_h[(kx - qx) + (H - 1)]
+                    )
+    np.testing.assert_allclose(out, expected, rtol=1e-4, atol=1e-5)
+
+
+def test_abs_pos_logits():
+    rng = np.random.default_rng(3)
+    B, N, H, W, d = 2, 2, 2, 3, 4
+    q = rng.normal(size=(B, N, H * W, d)).astype(np.float32)
+    eh = rng.normal(size=(H, d)).astype(np.float32)
+    ew = rng.normal(size=(W, d)).astype(np.float32)
+    out = np.asarray(abs_pos_logits(jnp.asarray(q), jnp.asarray(eh), jnp.asarray(ew)))
+    emb = (eh[:, None, :] + ew[None, :, :]).reshape(H * W, d)
+    expected = np.einsum("bnid,jd->bnij", q, emb)
+    np.testing.assert_allclose(out, expected, rtol=1e-5)
+
+
+def test_mhsa_matches_plain_softmax_attention():
+    rng = np.random.default_rng(4)
+    B, N, L, d = 2, 2, 6, 4
+    q = rng.normal(size=(B, N, L, d)).astype(np.float32)
+    k = rng.normal(size=(B, N, L, d)).astype(np.float32)
+    v = rng.normal(size=(B, N, L, d)).astype(np.float32)
+    pos = rng.normal(size=(B, N, L, L)).astype(np.float32)
+    scale = d ** -0.5
+    out = np.asarray(mhsa_2d(*map(jnp.asarray, (q, k, v, pos)), scale))
+    logits = np.einsum("bnxd,bnyd->bnxy", q * scale, k) + pos
+    w = np.exp(logits - logits.max(-1, keepdims=True))
+    w /= w.sum(-1, keepdims=True)
+    expected = np.einsum("bnxy,bnyd->bnxd", w, v)
+    np.testing.assert_allclose(out, expected, rtol=1e-4, atol=1e-5)
+
+
+def test_botnet_mhsa_module_runs_under_jit():
+    from distribuuuu_tpu.models.botnet import MHSA2D
+
+    m = MHSA2D(fmap_size=(4, 4), heads=2, dim_qk=8, dim_v=8, dtype=jnp.float32)
+    x = jnp.ones((2, 4, 4, 16))
+    v = m.init(jax.random.key(0), x)
+    out = jax.jit(lambda v, x: m.apply(v, x))(v, x)
+    assert out.shape == (2, 4, 4, 16)
+    # wrong grid must fail loudly (ref hard-assert: botnet.py:270-271)
+    with pytest.raises(AssertionError):
+        m.apply(v, jnp.ones((2, 5, 5, 16)))

@@ -11,7 +11,8 @@ import pytest
 
 from distribuuuu_tpu.models import available_models, build_model
 
-# arch -> M params (torch/torchvision + reference README published values)
+# arch -> M params (torch/torchvision + reference README published values;
+# the timm-sourced archs use the reference baseline table README.md:206-217)
 PARAM_ORACLE = {
     "resnet18": 11.690,
     "resnet34": 21.798,
@@ -22,6 +23,15 @@ PARAM_ORACLE = {
     "resnext101_32x8d": 88.791,
     "wide_resnet50_2": 68.883,
     "wide_resnet101_2": 126.887,
+    "densenet121": 7.979,
+    "densenet161": 28.681,
+    "densenet169": 14.149,
+    "densenet201": 20.014,
+    "botnet50": 20.859,
+    "efficientnet_b0": 5.289,
+    "regnetx_160": 54.279,
+    "regnety_160": 83.590,
+    "regnety_320": 145.047,
 }
 
 
@@ -77,3 +87,58 @@ def test_num_classes_plumbs_through():
 def test_registry_covers_reference_resnets():
     for arch in PARAM_ORACLE:
         assert arch in available_models()
+
+
+@pytest.mark.parametrize(
+    "arch,kwargs",
+    [
+        ("densenet121", {}),
+        ("regnety_160", {}),
+        ("efficientnet_b0", {}),
+        ("botnet50", {"fmap_size": (2, 2)}),
+    ],
+)
+def test_family_forward_shapes(arch, kwargs):
+    """Every model family runs forward (train + eval) at a small size."""
+    model = build_model(arch, num_classes=10, **kwargs)
+    x = jnp.ones((2, 32, 32, 3))
+    variables = model.init(
+        {"params": jax.random.key(0), "dropout": jax.random.key(1)}, x, train=False
+    )
+    logits = model.apply(variables, x, train=False)
+    assert logits.shape == (2, 10)
+    logits, _ = model.apply(
+        variables, x, train=True, mutable=["batch_stats"],
+        rngs={"dropout": jax.random.key(2)},
+    )
+    assert logits.shape == (2, 10)
+
+
+def test_densenet_memory_efficient_grads_match():
+    """remat (≙ torch.utils.checkpoint, ref densenet.py:81-86) must not
+    change values or gradients."""
+    x = jnp.ones((2, 32, 32, 3))
+
+    def make(mem_eff):
+        m = build_model("densenet121", num_classes=5, memory_efficient=mem_eff)
+        v = m.init(jax.random.key(0), x, train=False)
+        return m, v
+
+    m0, v0 = make(False)
+    m1, v1 = make(True)
+
+    def loss(m, v):
+        def f(params):
+            out, _ = m.apply(
+                {"params": params, "batch_stats": v["batch_stats"]},
+                x, train=True, mutable=["batch_stats"],
+            )
+            return (out ** 2).mean()
+
+        return jax.value_and_grad(f)(v["params"])
+
+    l0, g0 = loss(m0, v0)
+    l1, g1 = loss(m1, v1)
+    assert float(l0) == pytest.approx(float(l1), rel=1e-5)
+    for a, b in zip(jax.tree.leaves(g0), jax.tree.leaves(g1)):
+        assert jnp.allclose(a, b, rtol=1e-4, atol=1e-6)
