@@ -47,11 +47,20 @@ def scan_image_folder(root: str):
 
 class ImageFolderDataset:
     def __init__(
-        self, root: str, split: str, im_size: int, train: bool, base_seed: int = 0
+        self,
+        root: str,
+        split: str,
+        im_size: int,
+        train: bool,
+        base_seed: int = 0,
+        crop_size: int | None = None,
     ):
         self.dir = os.path.join(root, split)
         self.samples, self.classes = scan_image_folder(self.dir)
         self.im_size = im_size
+        # val: shorter-side resize to im_size, then center-crop to the model
+        # input size (ref: utils.py:169-170 — Resize(256) + CenterCrop(224))
+        self.crop_size = im_size if crop_size is None else crop_size
         self.train = train
         self.base_seed = base_seed
         self._epoch_seed = 0
@@ -77,5 +86,5 @@ class ImageFolderDataset:
                 )
                 arr = train_transform(img, self.im_size, rng)
             else:
-                arr = val_transform(img, self.im_size)
+                arr = val_transform(img, self.im_size, self.crop_size)
         return arr, label

@@ -15,8 +15,8 @@ silently double-counts DistributedSampler's padded duplicates).
 
 from __future__ import annotations
 
-import queue
-import threading
+from collections import deque
+from concurrent.futures import ThreadPoolExecutor
 
 import jax
 import numpy as np
@@ -88,36 +88,22 @@ class Loader:
             idxs[b * self.batch_size : (b + 1) * self.batch_size]
             for b in range(n_batches)
         ]
-        # Background assembly: a small bounded queue keeps `workers` batches
-        # in flight ahead of the consumer (the torch worker-pool analogue).
-        q: queue.Queue = queue.Queue(maxsize=self.workers)
-        stop = threading.Event()
-
-        def _producer():
-            try:
-                for chunk in chunks:
-                    if stop.is_set():
-                        return
-                    q.put(self._assemble(chunk))
-            finally:
-                q.put(None)
-
-        t = threading.Thread(target=_producer, daemon=True)
-        t.start()
-        try:
-            while True:
-                item = q.get()
-                if item is None:
-                    break
-                yield item
-        finally:
-            stop.set()
-            # drain so the producer can exit
-            while t.is_alive():
-                try:
-                    q.get_nowait()
-                except queue.Empty:
-                    break
+        # Parallel background assembly (the torch worker-pool analogue):
+        # `workers` batches decode/augment concurrently ahead of the consumer.
+        # PIL decode and numpy transforms release the GIL, so threads give
+        # real decode parallelism; batch order is preserved.
+        with ThreadPoolExecutor(max_workers=self.workers) as pool:
+            in_flight: deque = deque()
+            chunk_iter = iter(chunks)
+            for chunk in chunks[: self.workers]:
+                in_flight.append(pool.submit(self._assemble, chunk))
+                next(chunk_iter)
+            while in_flight:
+                batch = in_flight.popleft().result()
+                nxt = next(chunk_iter, None)
+                if nxt is not None:
+                    in_flight.append(pool.submit(self._assemble, nxt))
+                yield batch
 
 
 def _build_dataset(split: str, train: bool):
@@ -130,11 +116,14 @@ def _build_dataset(split: str, train: bool):
     from distribuuuu_tpu.data.imagefolder import ImageFolderDataset
 
     root = cfg.TRAIN.DATASET if train else cfg.TEST.DATASET
-    # train: RandomResizedCrop target; val: shorter-side resize before the
-    # fixed 224 center crop (ref: utils.py:131,169-170)
+    # train: RandomResizedCrop target; val: shorter-side resize to
+    # TEST.IM_SIZE, center-crop to the model input size TRAIN.IM_SIZE
+    # (ref: utils.py:131,169-170 — Resize(256) + CenterCrop(224))
     im_size = cfg.TRAIN.IM_SIZE if train else cfg.TEST.IM_SIZE
     return ImageFolderDataset(
-        root, split, im_size=im_size, train=train, base_seed=cfg.RNG_SEED or 0
+        root, split, im_size=im_size, train=train,
+        base_seed=cfg.RNG_SEED or 0,
+        crop_size=None if train else cfg.TRAIN.IM_SIZE,
     )
 
 

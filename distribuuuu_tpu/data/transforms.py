@@ -2,8 +2,9 @@
 
 Train: RandomResizedCrop(IM_SIZE) + RandomHorizontalFlip + Normalize
 (ref: /root/reference/distribuuuu/utils.py:127-139).
-Val: Resize(shorter side = TEST.IM_SIZE) + CenterCrop(224) + Normalize
-(ref: utils.py:163-172). Mean/std are the standard ImageNet constants.
+Val: Resize(shorter side = TEST.IM_SIZE) + CenterCrop(model input size =
+TRAIN.IM_SIZE; 224 in the shipped configs) + Normalize (ref: utils.py:163-172).
+Mean/std are the standard ImageNet constants.
 
 Output is NHWC float32 (TPU-native layout); normalization can be delegated
 to the optional C++ kernel (native/) when built.
@@ -87,7 +88,7 @@ def train_transform(img: Image.Image, im_size: int, rng: np.random.Generator):
     return to_normalized_array(img)
 
 
-def val_transform(img: Image.Image, resize_size: int, crop_size: int = 224):
+def val_transform(img: Image.Image, resize_size: int, crop_size: int):
     img = resize_shorter(img, resize_size)
     img = center_crop(img, crop_size)
     return to_normalized_array(img)

@@ -17,7 +17,9 @@ import flax.linen as nn
 import jax.numpy as jnp
 
 from distribuuuu_tpu.models.layers import (
+    BatchNorm,
     Dense,
+    SqueezeExcite,
     global_avg_pool,
     kaiming_normal_fan_out,
 )
@@ -34,18 +36,9 @@ _B0_BLOCKS = (
 )
 
 
-class _BN(nn.Module):
-    dtype: Any = jnp.bfloat16
-
-    @nn.compact
-    def __call__(self, x, train: bool = False):
-        return nn.BatchNorm(
-            use_running_average=not train,
-            momentum=0.99,
-            epsilon=1e-3,
-            dtype=self.dtype,
-            param_dtype=jnp.float32,
-        )(x)
+def _BN(dtype):
+    # torch momentum 0.01 ⇒ flax momentum 0.99; eps 1e-3 (EfficientNet BN)
+    return BatchNorm(dtype=dtype, momentum=0.99, epsilon=1e-3)
 
 
 def _conv(features, kernel, strides=1, groups=1, dtype=jnp.bfloat16):
@@ -79,11 +72,7 @@ class MBConv(nn.Module):
         x = nn.silu(x)
         # SE, reduction relative to block input channels
         se_ch = max(1, self.in_ch // 4)
-        s = jnp.mean(x, axis=(1, 2), keepdims=True)
-        s = nn.Conv(se_ch, (1, 1), dtype=self.dtype, param_dtype=jnp.float32)(s)
-        s = nn.silu(s)
-        s = nn.Conv(ch, (1, 1), dtype=self.dtype, param_dtype=jnp.float32)(s)
-        x = x * nn.sigmoid(s)
+        x = SqueezeExcite(se_ch, act=nn.silu, dtype=self.dtype)(x)
         x = _conv(self.out_ch, 1, dtype=self.dtype)(x)
         x = _BN(self.dtype)(x, train=train)
         if self.strides == 1 and self.in_ch == self.out_ch:

@@ -75,22 +75,45 @@ class ConvBN(nn.Module):
 
 class BatchNorm(nn.Module):
     """BatchNorm with torch-matching hyperparams (torch momentum 0.1 == flax
-    momentum 0.9, eps 1e-5). Stats/params are fp32 regardless of compute
-    dtype; `train` selects batch stats vs running averages."""
+    momentum 0.9, eps 1e-5 by default; EfficientNet overrides). Stats/params
+    are fp32 regardless of compute dtype; `train` selects batch stats vs
+    running averages."""
 
     dtype: Any = jnp.bfloat16
     scale_init: Callable = nn.initializers.ones
+    momentum: float = 0.9
+    epsilon: float = 1e-5
 
     @nn.compact
     def __call__(self, x, train: bool = False):
         return nn.BatchNorm(
             use_running_average=not train,
-            momentum=0.9,
-            epsilon=1e-5,
+            momentum=self.momentum,
+            epsilon=self.epsilon,
             dtype=self.dtype,
             param_dtype=jnp.float32,
             scale_init=self.scale_init,
         )(x)
+
+
+class SqueezeExcite(nn.Module):
+    """Squeeze-and-excitation gate: global mean → 1x1 reduce → act →
+    1x1 expand → sigmoid. Reduction width is caller-chosen (RegNet-Y uses
+    ratio×block-input, EfficientNet in_ch//4)."""
+
+    se_width: int
+    act: Callable = nn.relu
+    dtype: Any = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x):
+        s = jnp.mean(x, axis=(1, 2), keepdims=True)
+        s = nn.Conv(self.se_width, (1, 1), dtype=self.dtype,
+                    param_dtype=jnp.float32)(s)
+        s = self.act(s)
+        s = nn.Conv(x.shape[-1], (1, 1), dtype=self.dtype,
+                    param_dtype=jnp.float32)(s)
+        return x * nn.sigmoid(s)
 
 
 class Dense(nn.Module):

@@ -20,7 +20,12 @@ import flax.linen as nn
 import jax.numpy as jnp
 import numpy as np
 
-from distribuuuu_tpu.models.layers import ConvBN, Dense, global_avg_pool
+from distribuuuu_tpu.models.layers import (
+    ConvBN,
+    Dense,
+    SqueezeExcite,
+    global_avg_pool,
+)
 
 
 def generate_widths(w_a: float, w_0: int, w_m: float, depth: int, q: int = 8):
@@ -29,9 +34,8 @@ def generate_widths(w_a: float, w_0: int, w_m: float, depth: int, q: int = 8):
     ks = np.round(np.log(ws_cont / w_0) / np.log(w_m))
     ws = w_0 * np.power(w_m, ks)
     ws = (np.round(ws / q) * q).astype(int)
-    stage_ws, stage_ds = np.unique(ws, return_counts=True)
-    order = np.argsort(stage_ws)
-    return stage_ws[order].tolist(), stage_ds[order].tolist()
+    stage_ws, stage_ds = np.unique(ws, return_counts=True)  # sorted ascending
+    return stage_ws.tolist(), stage_ds.tolist()
 
 
 def adjust_groups(widths, group_w: int):
@@ -39,21 +43,6 @@ def adjust_groups(widths, group_w: int):
     gs = [min(group_w, w) for w in widths]
     ws = [int(round(w / g) * g) for w, g in zip(widths, gs)]
     return ws, gs
-
-
-class SqueezeExcite(nn.Module):
-    """SE with reduction relative to a caller-chosen width."""
-
-    se_width: int
-    dtype: Any = jnp.bfloat16
-
-    @nn.compact
-    def __call__(self, x):
-        s = jnp.mean(x, axis=(1, 2), keepdims=True)
-        s = nn.Conv(self.se_width, (1, 1), dtype=self.dtype, param_dtype=jnp.float32)(s)
-        s = nn.relu(s)
-        s = nn.Conv(x.shape[-1], (1, 1), dtype=self.dtype, param_dtype=jnp.float32)(s)
-        return x * nn.sigmoid(s)
 
 
 class RegNetBlock(nn.Module):

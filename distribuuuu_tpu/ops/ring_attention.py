@@ -1,0 +1,221 @@
+"""Sequence/context parallelism: ring attention and Ulysses all-to-all.
+
+The reference has no sequence-scaling mechanism at all — its only attention
+runs on a fixed 196-token grid (ref: /root/reference/distribuuuu/models/
+botnet.py:270-281, hard-asserted shape; SURVEY.md §5.7). This module is the
+TPU-native capability the reference lacks: attention over sequences sharded
+across the ``seq`` mesh axis, so context length scales with chips.
+
+Two strategies, both built on XLA collectives riding ICI:
+
+- **Ring attention** (Liu et al., arXiv:2310.01889): each device holds one
+  query block and rotates K/V blocks around the ring with ``ppermute``,
+  accumulating exact softmax attention with the online (flash) update. The
+  K/V transfer for step ``i+1`` overlaps the block computation of step ``i``
+  under XLA's latency-hiding scheduler. Exact — not an approximation.
+- **Ulysses all-to-all** (arXiv:2309.14509): ``all_to_all`` re-shards
+  sequence→heads, computes full attention locally on a head subset, and
+  re-shards back. Cheaper at moderate sequence lengths; requires
+  ``heads % seq_axis_size == 0``.
+
+Both are pure functions of ``[B, H, S_shard, D]`` blocks designed to be
+called inside ``shard_map`` (the mesh-axis name bound); ``ring_attention`` /
+``ulysses_attention`` are the host-level wrappers that bind a mesh.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, PartitionSpec as P
+
+try:  # jax >= 0.8
+    from jax import shard_map as _shard_map
+except ImportError:  # pragma: no cover - older jax
+    from jax.experimental.shard_map import shard_map as _shard_map
+
+
+def shard_map(fn, *, mesh, in_specs, out_specs):
+    try:  # jax >= 0.8 spells the kwarg check_vma; older spells it check_rep
+        return _shard_map(fn, mesh=mesh, in_specs=in_specs,
+                          out_specs=out_specs, check_vma=False)
+    except TypeError:  # pragma: no cover - older jax
+        return _shard_map(fn, mesh=mesh, in_specs=in_specs,
+                          out_specs=out_specs, check_rep=False)
+
+_NEG_BIG = -0.7 * float(jnp.finfo(jnp.float32).max)  # safe additive -inf
+
+
+def _block_update(q, k, v, m, l, o, scale, mask):
+    """One online-softmax accumulation step over a K/V block.
+
+    q: [B,H,Sq,D]; k,v: [B,H,Sk,D]; m,l: [B,H,Sq] running max / normalizer;
+    o: [B,H,Sq,Dv] unnormalized accumulator; mask: [Sq,Sk] bool or None.
+    """
+    s = jnp.einsum(
+        "bhqd,bhkd->bhqk", q, k, preferred_element_type=jnp.float32
+    ) * scale
+    if mask is not None:
+        s = jnp.where(mask[None, None], s, _NEG_BIG)
+    m_new = jnp.maximum(m, s.max(axis=-1))
+    # exp of masked-out logits underflows to 0 via the _NEG_BIG shift
+    p = jnp.exp(s - m_new[..., None])
+    if mask is not None:
+        p = jnp.where(mask[None, None], p, 0.0)
+    corr = jnp.exp(m - m_new)
+    l_new = corr * l + p.sum(axis=-1)
+    o_new = o * corr[..., None] + jnp.einsum(
+        "bhqk,bhkd->bhqd", p, v.astype(jnp.float32),
+        preferred_element_type=jnp.float32,
+    )
+    return m_new, l_new, o_new
+
+
+def ring_self_attention(
+    q, k, v, *, axis_name: str = "seq", causal: bool = False,
+    scale: float | None = None,
+):
+    """Exact attention over a ring-sharded sequence. Call inside shard_map.
+
+    q, k, v: [B, H, S_shard, D] — this device's sequence block; the global
+    sequence is the concatenation of blocks in mesh-axis order. Returns
+    [B, H, S_shard, Dv] in v.dtype.
+    """
+    n = jax.lax.axis_size(axis_name)
+    my_idx = jax.lax.axis_index(axis_name)
+    b, h, sq, d = q.shape
+    sk = k.shape[2]
+    scale = d ** -0.5 if scale is None else scale
+    qf = q.astype(jnp.float32)
+
+    m0 = jnp.full((b, h, sq), _NEG_BIG, jnp.float32)
+    l0 = jnp.zeros((b, h, sq), jnp.float32)
+    o0 = jnp.zeros((b, h, sq, v.shape[-1]), jnp.float32)
+    perm = [(j, (j + 1) % n) for j in range(n)]
+    q_pos = my_idx * sq + jnp.arange(sq)
+
+    def block_mask(src):
+        if not causal:
+            return None
+        k_pos = src * sk + jnp.arange(sk)
+        return q_pos[:, None] >= k_pos[None, :]
+
+    # local block first (no rotation needed), then n-1 rotate-and-update steps
+    m, l, o = _block_update(qf, k.astype(jnp.float32), v, m0, l0, o0,
+                            scale, block_mask(my_idx))
+
+    def step(carry, step_idx):
+        m, l, o, kb, vb = carry
+        # rotate K/V from the previous device; XLA's latency-hiding scheduler
+        # overlaps the transfer with the previous iteration's compute
+        kb = jax.lax.ppermute(kb, axis_name, perm)
+        vb = jax.lax.ppermute(vb, axis_name, perm)
+        # after `step_idx` rotations this device holds block (my_idx - step_idx)
+        src = (my_idx - step_idx) % n
+        m, l, o = _block_update(qf, kb.astype(jnp.float32), vb, m, l, o,
+                                scale, block_mask(src))
+        return (m, l, o, kb, vb), None
+
+    if n > 1:
+        (m, l, o, _, _), _ = jax.lax.scan(
+            step, (m, l, o, k, v), jnp.arange(1, n)
+        )
+    out = o / jnp.maximum(l, 1e-30)[..., None]
+    return out.astype(v.dtype)
+
+
+def ulysses_self_attention(
+    q, k, v, *, axis_name: str = "seq", causal: bool = False,
+    scale: float | None = None,
+):
+    """All-to-all sequence parallelism. Call inside shard_map.
+
+    Re-shards [B, H, S_shard, D] → [B, H/n, S_full, D] with one all_to_all,
+    runs full (flash-style fp32-softmax) attention on the local head subset,
+    and re-shards back. heads must divide by the axis size.
+    """
+    n = jax.lax.axis_size(axis_name)
+    assert q.shape[1] % n == 0, (
+        f"heads {q.shape[1]} not divisible by seq axis {n}"
+    )
+    # seq-sharded → head-sharded (gather full sequence, scatter heads)
+    q, k, v = (
+        jax.lax.all_to_all(t, axis_name, split_axis=1, concat_axis=2,
+                           tiled=True)
+        for t in (q, k, v)
+    )
+    d = q.shape[-1]
+    scale = d ** -0.5 if scale is None else scale
+    s = jnp.einsum(
+        "bhqd,bhkd->bhqk", q.astype(jnp.float32), k.astype(jnp.float32),
+        preferred_element_type=jnp.float32,
+    ) * scale
+    if causal:
+        sl = s.shape[-1]
+        mask = jnp.tril(jnp.ones((sl, sl), bool))
+        s = jnp.where(mask[None, None], s, _NEG_BIG)
+    w = jax.nn.softmax(s, axis=-1)
+    out = jnp.einsum(
+        "bhqk,bhkd->bhqd", w, v.astype(jnp.float32),
+        preferred_element_type=jnp.float32,
+    ).astype(v.dtype)
+    # head-sharded → seq-sharded
+    return jax.lax.all_to_all(out, axis_name, split_axis=2, concat_axis=1,
+                              tiled=True)
+
+
+def _spec(mesh: Mesh, data_axis: str | None, seq_axis: str):
+    data = data_axis if data_axis and data_axis in mesh.axis_names else None
+    return P(data, None, seq_axis, None)
+
+
+def ring_attention(
+    q, k, v, mesh: Mesh, *, seq_axis: str = "seq",
+    data_axis: str | None = "data", causal: bool = False,
+    scale: float | None = None,
+):
+    """Host-level ring attention: q,k,v are global [B, H, S, D] arrays with S
+    sharded over ``seq_axis`` (and B optionally over ``data_axis``)."""
+    spec = _spec(mesh, data_axis, seq_axis)
+    fn = functools.partial(
+        ring_self_attention, axis_name=seq_axis, causal=causal, scale=scale
+    )
+    return shard_map(
+        fn, mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec
+    )(q, k, v)
+
+
+def ulysses_attention(
+    q, k, v, mesh: Mesh, *, seq_axis: str = "seq",
+    data_axis: str | None = "data", causal: bool = False,
+    scale: float | None = None,
+):
+    """Host-level Ulysses attention over a ``seq``-sharded sequence."""
+    spec = _spec(mesh, data_axis, seq_axis)
+    fn = functools.partial(
+        ulysses_self_attention, axis_name=seq_axis, causal=causal, scale=scale
+    )
+    return shard_map(
+        fn, mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec
+    )(q, k, v)
+
+
+def reference_attention(q, k, v, *, causal: bool = False,
+                        scale: float | None = None):
+    """Single-device exact attention — the numerics oracle for the tests."""
+    d = q.shape[-1]
+    scale = d ** -0.5 if scale is None else scale
+    s = jnp.einsum(
+        "bhqd,bhkd->bhqk", q.astype(jnp.float32), k.astype(jnp.float32)
+    ) * scale
+    if causal:
+        sl = s.shape[-1]
+        s = jnp.where(jnp.tril(jnp.ones((sl, sl), bool))[None, None], s,
+                      _NEG_BIG)
+    w = jax.nn.softmax(s, axis=-1)
+    return jnp.einsum("bhqk,bhkd->bhqd", w, v.astype(jnp.float32)).astype(
+        v.dtype
+    )

@@ -139,7 +139,7 @@ def make_eval_step(model, topk: int):
         mask = batch["mask"]
         logp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
         nll = -jnp.take_along_axis(logp, batch["label"][:, None], axis=-1)[:, 0]
-        _, pred = jax.lax.top_k(logits, min(topk, logits.shape[-1]))
+        _, pred = jax.lax.top_k(logits, topk)  # topk pre-clamped (effective_topk)
         hits = pred == batch["label"][:, None]
         c1 = (hits[:, :1].any(axis=1) * mask).sum()
         ck = (hits.any(axis=1) * mask).sum()

@@ -21,17 +21,19 @@ def accuracy(logits, targets, topk=(1,)):
     Args:
         logits: [batch, classes] float array.
         targets: [batch] int class labels.
-        topk: tuple of k values.
+        topk: tuple of k values, each ≤ the class count (the trainer clamps
+            once via ``effective_topk``; see trainer.py).
     Returns:
         list of scalar percentages, one per k.
     """
-    # clamp k to the class count (TOPK=5 must not crash a 4-class head)
-    maxk = min(max(topk), logits.shape[-1])
+    maxk = max(topk)
+    assert maxk <= logits.shape[-1], (
+        f"top-{maxk} needs ≥{maxk} classes, got {logits.shape[-1]}"
+    )
     _, pred = jax.lax.top_k(logits, maxk)  # [batch, maxk], ordered
     hits = pred == targets[:, None]
     return [
-        hits[:, : min(k, maxk)].any(axis=1).mean(dtype=jnp.float32) * 100.0
-        for k in topk
+        hits[:, :k].any(axis=1).mean(dtype=jnp.float32) * 100.0 for k in topk
     ]
 
 

@@ -1,0 +1,82 @@
+"""Ring / Ulysses sequence-parallel attention vs the single-device oracle.
+
+Runs on the virtual 8-device CPU mesh (conftest.py) — the JAX analogue of the
+reference's "multi-node without a cluster" trick (ref: README.md:119-144).
+The reference itself has no sequence parallelism (SURVEY.md §5.7); these ops
+are the TPU framework's long-context capability, so they are tested for exact
+numerics (forward AND gradients) against full attention.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from distribuuuu_tpu.ops import ring_attention as ra
+from distribuuuu_tpu.parallel import mesh as mesh_lib
+
+
+def _qkv(b=2, h=4, s=32, d=16, seed=0):
+    rng = np.random.default_rng(seed)
+    return tuple(
+        jnp.asarray(rng.standard_normal((b, h, s, d)).astype(np.float32))
+        for _ in range(3)
+    )
+
+
+@pytest.fixture(scope="module")
+def mesh():
+    # data=2 × seq=4 — both batch and sequence sharded
+    return mesh_lib.build_mesh(data=2, model=1, seq=4)
+
+
+@pytest.mark.parametrize("causal", [False, True])
+def test_ring_matches_reference(mesh, causal):
+    q, k, v = _qkv()
+    want = ra.reference_attention(q, k, v, causal=causal)
+    got = ra.ring_attention(q, k, v, mesh, causal=causal)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               rtol=1e-5, atol=1e-5)
+
+
+@pytest.mark.parametrize("causal", [False, True])
+def test_ulysses_matches_reference(mesh, causal):
+    q, k, v = _qkv(seed=1)
+    want = ra.reference_attention(q, k, v, causal=causal)
+    got = ra.ulysses_attention(q, k, v, mesh, causal=causal)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               rtol=1e-5, atol=1e-5)
+
+
+def test_ring_gradients_match(mesh):
+    q, k, v = _qkv(s=16, seed=2)
+
+    def loss_ref(q, k, v):
+        return (ra.reference_attention(q, k, v, causal=True) ** 2).sum()
+
+    def loss_ring(q, k, v):
+        return (ra.ring_attention(q, k, v, mesh, causal=True) ** 2).sum()
+
+    g_ref = jax.grad(loss_ref, argnums=(0, 1, 2))(q, k, v)
+    g_ring = jax.grad(loss_ring, argnums=(0, 1, 2))(q, k, v)
+    for a, b in zip(g_ring, g_ref):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=1e-4, atol=1e-4)
+
+
+def test_ring_under_jit_seq_only(mesh):
+    # seq-only sharding (data axis unused) and jit around the shard_map
+    q, k, v = _qkv(b=1, seed=3)
+    fn = jax.jit(
+        lambda q, k, v: ra.ring_attention(q, k, v, mesh, data_axis=None,
+                                          causal=True)
+    )
+    want = ra.reference_attention(q, k, v, causal=True)
+    np.testing.assert_allclose(np.asarray(fn(q, k, v)), np.asarray(want),
+                               rtol=1e-5, atol=1e-5)
+
+
+def test_ulysses_rejects_indivisible_heads(mesh):
+    q, k, v = _qkv(h=2)  # 2 heads, seq axis 4
+    with pytest.raises(Exception):
+        ra.ulysses_attention(q, k, v, mesh)
