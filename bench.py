@@ -50,19 +50,30 @@ def main():
     }
     gbatch = sharding_lib.shard_batch(mesh, host_batch)
 
+    # The timed window must end with a *value fetch* that depends on the last
+    # step's parameter update: on remote-tunnel transports (axon)
+    # block_until_ready was observed returning before the work ran (a
+    # 8192³ matmul "finished" at 100+ PFLOP/s), so syncing on a scalar
+    # derived from the updated params is the reliable fence.
+    import jax.numpy as jnp
+
+    def fence(state):
+        leaf = jax.tree.leaves(state.params)[0]
+        return float(jnp.sum(leaf))
+
     # compile + warmup
     state, metrics = train_step(state, gbatch)
-    jax.block_until_ready(metrics["loss"])
+    fence(state)
     for _ in range(3):
         state, metrics = train_step(state, gbatch)
-    jax.block_until_ready(metrics["loss"])
+    fence(state)
 
     # timed steady state
     iters = 20
     t0 = time.perf_counter()
     for _ in range(iters):
         state, metrics = train_step(state, gbatch)
-    jax.block_until_ready(metrics["loss"])
+    fence(state)
     dt = time.perf_counter() - t0
 
     img_per_sec = batch * iters / dt

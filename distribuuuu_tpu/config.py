@@ -247,6 +247,9 @@ _C.DEVICE.PLATFORM = "auto"
 _C.DEVICE.COMPUTE_DTYPE = "bfloat16"
 # Deterministic XLA ops (maps CUDNN.DETERMINISTIC intent onto TPU).
 _C.DEVICE.DETERMINISTIC = False
+# Attention implementation for attention archs: "auto" | "xla" | "pallas".
+# "auto" resolves per measurement (see ops/pallas_attention.use_pallas).
+_C.DEVICE.ATTN_IMPL = "auto"
 
 _C.MESH = CfgNode()
 # Logical mesh axis sizes; -1 means "all remaining devices" on that axis.

@@ -22,11 +22,12 @@ from distribuuuu_tpu.models.layers import (
     BatchNorm,
     ConvBN,
     Dense,
+    conv_kernel_init_default,
     global_avg_pool,
     max_pool_3x3_s2,
 )
 from distribuuuu_tpu.models.resnet import Bottleneck
-from distribuuuu_tpu.ops import attention as att_ops
+from distribuuuu_tpu.ops import attention as att_ops, pallas_attention
 
 
 class MHSA2D(nn.Module):
@@ -38,6 +39,7 @@ class MHSA2D(nn.Module):
     dim_qk: int = 128
     dim_v: int = 128
     rel_pos_emb: bool = True
+    attn_impl: str = "auto"  # auto | pallas | xla (auto = pallas on TPU)
     dtype: Any = jnp.bfloat16
 
     @nn.compact
@@ -47,13 +49,15 @@ class MHSA2D(nn.Module):
             f"MHSA grid mismatch: got {(h, w)}, built for {self.fmap_size}"
         )
         n, dqk, dv = self.heads, self.dim_qk, self.dim_v
+        # output channels = heads × head_dim, so the model-axis partitioning
+        # of the kernel is head-parallel attention (Megatron-style TP)
         qk = nn.Conv(
             n * dqk * 2, (1, 1), use_bias=False, dtype=self.dtype,
-            param_dtype=jnp.float32,
+            param_dtype=jnp.float32, kernel_init=conv_kernel_init_default,
         )(x)
         v = nn.Conv(
             n * dv, (1, 1), use_bias=False, dtype=self.dtype,
-            param_dtype=jnp.float32,
+            param_dtype=jnp.float32, kernel_init=conv_kernel_init_default,
         )(x)
         q, k = jnp.split(qk, 2, axis=-1)
 
@@ -77,7 +81,10 @@ class MHSA2D(nn.Module):
             emb_w = self.param("emb_width", init, (w, dqk), jnp.float32)
             pos = att_ops.abs_pos_logits((q * scale).astype(jnp.float32), emb_h, emb_w)
 
-        out = att_ops.mhsa_2d(q, k, v, pos, scale)
+        if pallas_attention.use_pallas(self.attn_impl):
+            out = pallas_attention.mhsa_2d_fused(q, k, v, pos, scale)
+        else:
+            out = att_ops.mhsa_2d(q, k, v, pos, scale)
         # [B, N, HW, dv] -> NHWC
         return out.transpose(0, 2, 1, 3).reshape(b, h, w, n * dv)
 
@@ -95,6 +102,7 @@ class BoTBlock(nn.Module):
     dim_v: int = 128
     rel_pos_emb: bool = True
     downsample: bool = False
+    attn_impl: str = "auto"
     dtype: Any = jnp.bfloat16
 
     @nn.compact
@@ -113,6 +121,7 @@ class BoTBlock(nn.Module):
             dim_qk=self.dim_qk,
             dim_v=self.dim_v,
             rel_pos_emb=self.rel_pos_emb,
+            attn_impl=self.attn_impl,
             dtype=self.dtype,
         )(out)
         if self.strides == 2:
@@ -132,6 +141,7 @@ class BoTNet50(nn.Module):
 
     num_classes: int = 1000
     fmap_size: tuple[int, int] = (14, 14)
+    attn_impl: str = "auto"
     dtype: Any = jnp.bfloat16
 
     @nn.compact
@@ -159,6 +169,7 @@ class BoTNet50(nn.Module):
                 strides=1,
                 rel_pos_emb=True,
                 downsample=(i == 0),
+                attn_impl=self.attn_impl,
                 dtype=self.dtype,
             )(x, train=train)
         x = global_avg_pool(x)

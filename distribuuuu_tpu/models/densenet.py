@@ -22,7 +22,7 @@ from distribuuuu_tpu.models.layers import (
     BatchNorm,
     Dense,
     global_avg_pool,
-    kaiming_normal_fan_out,
+    conv_kernel_init,
     max_pool_3x3_s2,
 )
 
@@ -41,14 +41,14 @@ class DenseLayer(nn.Module):
         out = nn.Conv(
             self.bn_size * self.growth_rate, (1, 1), use_bias=False,
             dtype=self.dtype, param_dtype=jnp.float32,
-            kernel_init=kaiming_normal_fan_out,
+            kernel_init=conv_kernel_init,
         )(out)
         out = BatchNorm(dtype=self.dtype)(out, train=train)
         out = nn.relu(out)
         out = nn.Conv(
             self.growth_rate, (3, 3), padding=[(1, 1), (1, 1)], use_bias=False,
             dtype=self.dtype, param_dtype=jnp.float32,
-            kernel_init=kaiming_normal_fan_out,
+            kernel_init=conv_kernel_init,
         )(out)
         return out
 
@@ -70,7 +70,7 @@ class DenseNet(nn.Module):
         x = nn.Conv(
             self.num_init_features, (7, 7), strides=2, padding=[(3, 3), (3, 3)],
             use_bias=False, dtype=self.dtype, param_dtype=jnp.float32,
-            kernel_init=kaiming_normal_fan_out,
+            kernel_init=conv_kernel_init,
         )(x)
         x = BatchNorm(dtype=self.dtype)(x, train=train)
         x = nn.relu(x)
@@ -102,7 +102,7 @@ class DenseNet(nn.Module):
                 num_features = num_features // 2
                 x = nn.Conv(
                     num_features, (1, 1), use_bias=False, dtype=self.dtype,
-                    param_dtype=jnp.float32, kernel_init=kaiming_normal_fan_out,
+                    param_dtype=jnp.float32, kernel_init=conv_kernel_init,
                 )(x)
                 x = nn.avg_pool(x, (2, 2), strides=(2, 2))
 

@@ -21,7 +21,7 @@ from distribuuuu_tpu.models.layers import (
     Dense,
     SqueezeExcite,
     global_avg_pool,
-    kaiming_normal_fan_out,
+    conv_kernel_init,
 )
 
 # (expand_ratio, channels, repeats, stride, kernel)
@@ -47,7 +47,7 @@ def _conv(features, kernel, strides=1, groups=1, dtype=jnp.bfloat16):
     return nn.Conv(
         features, k, strides=strides, padding=pad, feature_group_count=groups,
         use_bias=False, dtype=dtype, param_dtype=jnp.float32,
-        kernel_init=kaiming_normal_fan_out,
+        kernel_init=conv_kernel_init,
     )
 
 

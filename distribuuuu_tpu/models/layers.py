@@ -20,11 +20,19 @@ import flax.linen as nn
 import jax.numpy as jnp
 from jax.nn.initializers import variance_scaling
 
+from distribuuuu_tpu.parallel import tp
+
 # torch nn.Conv2d's companion init is kaiming; the reference ResNet explicitly
 # uses kaiming_normal(fan_out, relu) (ref: resnet.py:213-218).
 kaiming_normal_fan_out = variance_scaling(2.0, "fan_out", "normal")
 # torch nn.Linear default: kaiming_uniform(a=sqrt(5)) == U(±1/sqrt(fan_in)).
 torch_linear_init = variance_scaling(1.0 / 3.0, "fan_in", "uniform")
+
+# Partitioned variants: kernels carry ``model``-axis metadata so the trainer
+# can lay params out for tensor parallelism (no-op at MESH.MODEL=1).
+conv_kernel_init = tp.conv_init(kaiming_normal_fan_out)
+conv_kernel_init_default = tp.conv_init(nn.initializers.lecun_normal())
+dense_kernel_init = tp.column_init(torch_linear_init)
 
 
 def resolve_dtype(name: str):
@@ -62,7 +70,7 @@ class ConvBN(nn.Module):
             use_bias=False,
             dtype=self.dtype,
             param_dtype=jnp.float32,
-            kernel_init=kaiming_normal_fan_out,
+            kernel_init=conv_kernel_init,
         )(x)
         if self.use_bn:
             x = BatchNorm(dtype=self.dtype, scale_init=self.bn_scale_init)(
@@ -128,7 +136,7 @@ class Dense(nn.Module):
             self.features,
             dtype=self.dtype,
             param_dtype=jnp.float32,
-            kernel_init=torch_linear_init,
+            kernel_init=dense_kernel_init,
         )(x)
 
 

@@ -1,0 +1,122 @@
+"""Tensor (model) parallelism: param partitioning over the ``model`` mesh axis.
+
+The reference has no tensor parallelism (SURVEY.md §2.3 — DDP only). Here TP
+is declarative, the idiomatic JAX/XLA form: weight matrices carry
+``nn.with_partitioning`` metadata naming the ``model`` axis, the trainer
+places params by those specs (see trainer.create_train_state), and GSPMD
+inserts the all-gathers/reduce-scatters — there is no hand-written collective
+per layer the way Megatron structures its column/row pairs. At
+``MESH.MODEL=1`` every spec collapses to replication, so the same code path
+serves pure data parallelism (the reference's topology) and dp×tp meshes.
+
+Conventions:
+  - Conv kernels   [kh, kw, in, out] → shard ``out`` (head/channel parallel)
+  - Dense kernels  [in, out]         → shard ``out`` (column parallel)
+  - ``RowParallelDense``             → shard ``in``  (row parallel; pairs
+    with a column-parallel producer so the activation stays sharded between
+    the two matmuls and GSPMD reduces once at the end)
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+MODEL_AXIS = "model"
+
+
+def column_init(init: Callable) -> Callable:
+    """Partition a Dense kernel [in, out] column-wise over ``model``."""
+    return nn.with_partitioning(init, (None, MODEL_AXIS))
+
+
+def row_init(init: Callable) -> Callable:
+    """Partition a Dense kernel [in, out] row-wise over ``model``."""
+    return nn.with_partitioning(init, (MODEL_AXIS, None))
+
+
+def conv_init(init: Callable) -> Callable:
+    """Partition a Conv kernel [kh, kw, in, out] on output channels."""
+    return nn.with_partitioning(init, (None, None, None, MODEL_AXIS))
+
+
+def constrain_like(tree, template_tree, template_shardings):
+    """Constrain every subtree of ``tree`` that is param-tree-shaped.
+
+    Optimizer states embed whole copies of the param tree (momentum buffers);
+    this pins each such copy to the params' layout so TP-sharded kernels get
+    TP-sharded momentum instead of whatever XLA picks for unconstrained
+    outputs. Call inside jit.
+    """
+    tdef = jax.tree.structure(template_tree)
+
+    def is_param_shaped(node):
+        return jax.tree.structure(node) == tdef
+
+    def constrain(node):
+        if is_param_shaped(node):
+            return jax.tree.map(
+                jax.lax.with_sharding_constraint, node, template_shardings
+            )
+        return node
+
+    return jax.tree.map(constrain, tree, is_leaf=is_param_shaped)
+
+
+def param_shardings(mesh: Mesh, abstract_variables) -> Any:
+    """Map a (possibly boxed) variables tree to NamedShardings.
+
+    ``nn.get_partition_spec`` yields the annotated PartitionSpec for boxed
+    leaves and ``P()`` (replicated) for plain ones.
+    """
+    specs = nn.get_partition_spec(abstract_variables)
+    return jax.tree.map(lambda s: NamedSharding(mesh, s), specs)
+
+
+class ColumnParallelDense(nn.Module):
+    """Dense with the kernel sharded on the output dim (Megatron column)."""
+
+    features: int
+    use_bias: bool = True
+    dtype: Any = jnp.bfloat16
+    kernel_init: Callable = nn.initializers.lecun_normal()
+
+    @nn.compact
+    def __call__(self, x):
+        return nn.Dense(
+            self.features,
+            use_bias=self.use_bias,
+            dtype=self.dtype,
+            param_dtype=jnp.float32,
+            kernel_init=column_init(self.kernel_init),
+            bias_init=nn.with_partitioning(
+                nn.initializers.zeros, (MODEL_AXIS,)
+            ),
+        )(x)
+
+
+class RowParallelDense(nn.Module):
+    """Dense with the kernel sharded on the input dim (Megatron row).
+
+    Feed it the output of a ColumnParallelDense: the intermediate activation
+    stays ``model``-sharded and GSPMD emits a single reduce at the output.
+    """
+
+    features: int
+    use_bias: bool = True
+    dtype: Any = jnp.bfloat16
+    kernel_init: Callable = nn.initializers.lecun_normal()
+
+    @nn.compact
+    def __call__(self, x):
+        return nn.Dense(
+            self.features,
+            use_bias=self.use_bias,
+            dtype=self.dtype,
+            param_dtype=jnp.float32,
+            kernel_init=row_init(self.kernel_init),
+        )(x)

@@ -60,31 +60,62 @@ class TrainState:
 def build_model_from_cfg():
     """Build the configured arch (≙ models.build_model + timm fallback,
     ref: trainer.py:117-128 — the zoo here is closed, no fallback needed)."""
-    return models.build_model(
-        cfg.MODEL.ARCH,
+    kwargs = dict(
         num_classes=cfg.MODEL.NUM_CLASSES,
         dtype=resolve_dtype(cfg.DEVICE.COMPUTE_DTYPE),
     )
+    if cfg.MODEL.ARCH == "botnet50":
+        # the attention grid follows the input size; each stride-2 op maps
+        # n → ceil(n/2), so the stride-16 backbone gives ceil(IM_SIZE/16).
+        # The reference instead hard-asserts 224 inputs (ref: botnet.py:270-271)
+        fmap = max(1, -(-cfg.TRAIN.IM_SIZE // 16))
+        kwargs["fmap_size"] = (fmap, fmap)
+        kwargs["attn_impl"] = cfg.DEVICE.ATTN_IMPL
+    return models.build_model(cfg.MODEL.ARCH, **kwargs)
 
 
 def create_train_state(model, key, mesh, im_size: int) -> TrainState:
-    """Initialize params/stats/optimizer replicated over the mesh.
+    """Initialize params/stats/optimizer laid out over the mesh.
 
-    Replicated placement ≙ DDP's init broadcast (ref: trainer.py:134): every
-    replica holds identical params by construction.
+    Params are placed by their ``nn.with_partitioning`` metadata: replicated
+    by default (≙ DDP's init broadcast, ref: trainer.py:134) and sharded over
+    the ``model`` axis where a kernel is annotated (tensor parallelism —
+    collapses to replication at MESH.MODEL=1). The optimizer's momentum
+    buffers inherit the param layout through GSPMD propagation.
     """
+    import functools
+
+    from distribuuuu_tpu.parallel import tp
+
     dummy = jnp.ones((2, im_size, im_size, 3), jnp.float32)
-    variables = jax.jit(model.init, static_argnames="train")(key, dummy, train=False)
     optimizer = construct_optimizer()
-    opt_state = optimizer.init(variables["params"])
-    state = TrainState(
-        params=variables["params"],
-        batch_stats=variables["batch_stats"],
-        opt_state=opt_state,
-        step=jnp.int32(0),
-        key=key,
+    abstract = jax.eval_shape(
+        functools.partial(model.init, train=False), key, dummy
     )
-    return jax.device_put(state, sharding_lib.replicate(mesh))
+    shardings = tp.param_shardings(mesh, abstract)
+    repl = sharding_lib.replicate(mesh)
+
+    def init_all(key):
+        variables = flax.linen.meta.unbox(model.init(key, dummy, train=False))
+        params = jax.lax.with_sharding_constraint(
+            variables["params"], shardings["params"]
+        )
+        stats = jax.lax.with_sharding_constraint(
+            variables["batch_stats"],
+            jax.tree.map(lambda _: repl, variables["batch_stats"]),
+        )
+        opt_state = tp.constrain_like(
+            optimizer.init(params), params, shardings["params"]
+        )
+        return TrainState(
+            params=params,
+            batch_stats=stats,
+            opt_state=opt_state,
+            step=jnp.int32(0),
+            key=key,
+        )
+
+    return jax.jit(init_all)(key)
 
 
 def make_train_step(model, optimizer, topk: int):
@@ -218,6 +249,18 @@ def validate(loader, mesh, state, eval_step, epoch: int, logger):
     return top1, topk
 
 
+def _place_like(tmpl, new):
+    """Place restored host arrays with the live template's dtype + layout
+    (replicated or TP-sharded), leaf by leaf."""
+    return jax.tree.map(
+        lambda t, n: jax.device_put(
+            np.asarray(n, dtype=getattr(t, "dtype", None)), t.sharding
+        ),
+        tmpl,
+        new,
+    )
+
+
 def _state_tree(state: TrainState) -> dict:
     # key is intentionally excluded: it is re-derived from RNG_SEED at startup
     return {
@@ -233,26 +276,13 @@ def _resume(state: TrainState, mesh) -> tuple[TrainState, int, float]:
     logger = get_logger()
     path = ckpt.get_last_checkpoint()
     restored = ckpt.load_checkpoint(path)
-    repl = sharding_lib.replicate(mesh)
 
-    def _place(tmpl, new):
-        return jax.device_put(
-            jax.tree.map(lambda t, n: np.asarray(n, dtype=t.dtype), tmpl, new), repl
-        )
-
-    params = _place(state.params, restored["params"])
-    stats = _place(state.batch_stats, restored["batch_stats"])
+    params = _place_like(state.params, restored["params"])
+    stats = _place_like(state.batch_stats, restored["batch_stats"])
     opt_state = state.opt_state
     if cfg.TRAIN.LOAD_OPT and "opt_state" in restored:
         try:
-            opt_state = jax.device_put(
-                jax.tree.map(
-                    lambda t, n: jnp.asarray(n, dtype=getattr(t, "dtype", None)),
-                    state.opt_state,
-                    restored["opt_state"],
-                ),
-                repl,
-            )
+            opt_state = _place_like(state.opt_state, restored["opt_state"])
         except Exception as e:  # graceful weights-only fallback (utils.py:399-405)
             logger.warning("optimizer state not restored (%s); fresh optimizer", e)
     start_epoch = int(restored.get("epoch", -1)) + 1
@@ -325,14 +355,9 @@ def test_model():
     state = create_train_state(model, key, mesh, cfg.TRAIN.IM_SIZE)
     if cfg.MODEL.WEIGHTS:
         restored = ckpt.load_checkpoint(cfg.MODEL.WEIGHTS)
-        repl = sharding_lib.replicate(mesh)
         state = TrainState(
-            params=jax.device_put(
-                jax.tree.map(lambda t, n: np.asarray(n, t.dtype), state.params,
-                             restored["params"]), repl),
-            batch_stats=jax.device_put(
-                jax.tree.map(lambda t, n: np.asarray(n, t.dtype), state.batch_stats,
-                             restored["batch_stats"]), repl),
+            params=_place_like(state.params, restored["params"]),
+            batch_stats=_place_like(state.batch_stats, restored["batch_stats"]),
             opt_state=state.opt_state,
             step=state.step,
             key=state.key,
