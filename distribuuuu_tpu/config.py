@@ -258,6 +258,12 @@ _C.MESH.DATA = -1
 _C.MESH.MODEL = 1
 _C.MESH.SEQ = 1
 
+# ------------------------------- data pipeline -------------------------------
+_C.DATA = CfgNode()
+# Decode backend: "auto" uses the C++ kernel (native/decode.cc) when it
+# builds, else PIL; "native" requires it; "pil" forces pure Python.
+_C.DATA.BACKEND = "auto"
+
 # ------------------------------- misc ---------------------------------------
 _C.OUT_DIR = "./output"
 _C.CFG_DEST = "config.yaml"

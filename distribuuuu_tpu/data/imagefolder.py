@@ -54,6 +54,7 @@ class ImageFolderDataset:
         train: bool,
         base_seed: int = 0,
         crop_size: int | None = None,
+        backend: str = "auto",
     ):
         self.dir = os.path.join(root, split)
         self.samples, self.classes = scan_image_folder(self.dir)
@@ -64,6 +65,75 @@ class ImageFolderDataset:
         self.train = train
         self.base_seed = base_seed
         self._epoch_seed = 0
+        if backend not in ("auto", "native", "pil"):
+            raise ValueError(f"DATA.BACKEND must be auto|native|pil, got {backend}")
+        self.backend = backend
+
+    def _use_native(self) -> bool:
+        if self.backend == "pil":
+            return False
+        from distribuuuu_tpu import native
+
+        if native.available():
+            return True
+        if self.backend == "native":
+            raise RuntimeError(
+                f"DATA.BACKEND=native but the C++ kernel is unavailable: "
+                f"{native.build_error()}"
+            )
+        return False
+
+    def _rng(self, idx: int) -> np.random.Generator:
+        # RNG_SEED participates so different seeds draw different augmentation
+        # streams (≙ rank-offset host seeding intent, ref: utils.py:61-63).
+        # One generator per (seed, epoch, sample): backend-independent.
+        return np.random.default_rng(
+            np.random.SeedSequence([self.base_seed, self._epoch_seed, idx])
+        )
+
+    def load_batch(self, idxs, n_threads: int = 4):
+        """Decode+transform a batch of samples, via the C++ kernel when
+        available (one GIL-free call, internal thread pool) with per-image
+        PIL fallback; otherwise plain per-item PIL.
+
+        Returns ``(images [n,H,W,3] float32, labels [n] int32)``.
+        """
+        out_size = self.im_size if self.train else self.crop_size
+        labels = np.asarray(
+            [self.samples[int(i)][1] for i in idxs], np.int32
+        )
+        if not self._use_native():
+            images = np.stack([self[int(i)][0] for i in idxs])
+            return images.astype(np.float32), labels
+
+        from distribuuuu_tpu import native
+        from distribuuuu_tpu.data import transforms as T
+
+        n = len(idxs)
+        geoms = np.zeros((n,), native.GEOM_DTYPE)
+        paths: list[str] = []
+        fallback: list[int] = []  # positions the native path can't handle
+        for pos, idx in enumerate(int(i) for i in idxs):
+            path, _ = self.samples[idx]
+            dims = native.file_dims(path)
+            if dims is None:  # exotic format → PIL for this image
+                paths.append("")  # sentinel: C++ fails it instantly, no IO
+                fallback.append(pos)
+                continue
+            paths.append(path)
+            w, h = dims
+            if self.train:
+                g = T.train_geom(w, h, self.im_size, self._rng(idx))
+            else:
+                g = T.val_geom(w, h, self.im_size, self.crop_size)
+            geoms[pos] = g + (0,)  # trailing struct padding field
+        images, statuses = native.load_batch(
+            paths, geoms, (out_size, out_size),
+            T.IMAGENET_MEAN, T.IMAGENET_STD, n_threads,
+        )
+        for pos in set(fallback) | set(np.nonzero(statuses)[0].tolist()):
+            images[pos] = self[int(idxs[pos])][0]
+        return images, labels
 
     def set_epoch_seed(self, seed: int) -> None:
         """Augmentation randomness folds in the epoch (reference semantics:
@@ -78,13 +148,7 @@ class ImageFolderDataset:
         with Image.open(path) as img:
             img = img.convert("RGB")
             if self.train:
-                # RNG_SEED participates so different seeds draw different
-                # augmentation streams (≙ rank-offset host seeding intent,
-                # ref: utils.py:61-63)
-                rng = np.random.default_rng(
-                    np.random.SeedSequence([self.base_seed, self._epoch_seed, idx])
-                )
-                arr = train_transform(img, self.im_size, rng)
+                arr = train_transform(img, self.im_size, self._rng(idx))
             else:
                 arr = val_transform(img, self.im_size, self.crop_size)
         return arr, label

@@ -42,6 +42,19 @@ class Loader:
         self.batch_size = batch_size
         self.drop_last = drop_last
         self.workers = max(1, workers)
+        # Prefetch depth (batches assembled ahead of the consumer). When the
+        # native backend is active each _assemble call already fans out over
+        # `workers` C++ threads, so deep Python-side prefetch would multiply
+        # to workers² decode threads; two in-flight batches suffice to
+        # overlap. The PIL path decodes one image per Python thread, so there
+        # the prefetch depth IS the parallelism.
+        native_batch = False
+        if hasattr(dataset, "_use_native"):
+            try:
+                native_batch = dataset._use_native()
+            except RuntimeError:
+                pass  # surfaces with a clear error at iteration time
+        self.prefetch_depth = 2 if native_batch else self.workers
         self.sampler = DistributedSampler(
             len(dataset),
             num_replicas=jax.process_count(),
@@ -61,15 +74,18 @@ class Loader:
         return n // self.batch_size if self.drop_last else -(-n // self.batch_size)
 
     def _assemble(self, idxs: np.ndarray) -> dict:
-        images, labels = [], []
-        for i in idxs:
-            img, lab = self.dataset[int(i)]
-            images.append(img)
-            labels.append(lab)
+        if hasattr(self.dataset, "load_batch"):
+            # ImageFolder path: batch-level decode (C++ kernel when built —
+            # one GIL-free call with an internal thread pool; PIL otherwise).
+            images, labels = self.dataset.load_batch(idxs, n_threads=self.workers)
+        else:
+            pairs = [self.dataset[int(i)] for i in idxs]
+            images = np.stack([p[0] for p in pairs])
+            labels = np.asarray([p[1] for p in pairs], np.int32)
         n = len(images)
         batch = {
-            "image": np.stack(images).astype(np.float32),
-            "label": np.asarray(labels, np.int32),
+            "image": np.asarray(images, np.float32),
+            "label": labels.astype(np.int32),
             "mask": np.ones((n,), np.float32),
         }
         if n < self.batch_size:  # pad ragged final eval batch, mask it out
@@ -92,10 +108,10 @@ class Loader:
         # `workers` batches decode/augment concurrently ahead of the consumer.
         # PIL decode and numpy transforms release the GIL, so threads give
         # real decode parallelism; batch order is preserved.
-        with ThreadPoolExecutor(max_workers=self.workers) as pool:
+        with ThreadPoolExecutor(max_workers=self.prefetch_depth) as pool:
             in_flight: deque = deque()
             chunk_iter = iter(chunks)
-            for chunk in chunks[: self.workers]:
+            for chunk in chunks[: self.prefetch_depth]:
                 in_flight.append(pool.submit(self._assemble, chunk))
                 next(chunk_iter)
             while in_flight:
@@ -124,6 +140,7 @@ def _build_dataset(split: str, train: bool):
         root, split, im_size=im_size, train=train,
         base_seed=cfg.RNG_SEED or 0,
         crop_size=None if train else cfg.TRAIN.IM_SIZE,
+        backend=cfg.DATA.BACKEND,
     )
 
 

@@ -21,16 +21,20 @@ IMAGENET_MEAN = np.asarray([0.485, 0.456, 0.406], np.float32)
 IMAGENET_STD = np.asarray([0.229, 0.224, 0.225], np.float32)
 
 
-def random_resized_crop(
-    img: Image.Image,
-    size: int,
+def sample_rrc_box(
+    width: int,
+    height: int,
     rng: np.random.Generator,
     scale=(0.08, 1.0),
     ratio=(3 / 4, 4 / 3),
-) -> Image.Image:
-    """torchvision RandomResizedCrop: 10 attempts at area/ratio jitter, then
-    a center-crop fallback."""
-    width, height = img.size
+) -> tuple[int, int, int, int]:
+    """torchvision RandomResizedCrop box sampling: 10 attempts at area/ratio
+    jitter, then a center-crop fallback. Returns ``(j, i, w, h)`` — left, top,
+    width, height of the crop box in source pixels.
+
+    This is the *only* place train-augmentation randomness is drawn, shared by
+    the PIL and native (C++) decode backends so both see the same stream.
+    """
     area = width * height
     log_ratio = (math.log(ratio[0]), math.log(ratio[1]))
     for _ in range(10):
@@ -41,9 +45,7 @@ def random_resized_crop(
         if 0 < w <= width and 0 < h <= height:
             i = int(rng.integers(0, height - h + 1))
             j = int(rng.integers(0, width - w + 1))
-            return img.resize(
-                (size, size), Image.BILINEAR, box=(j, i, j + w, i + h)
-            )
+            return j, i, w, h
     # fallback: center crop at the closest valid ratio
     in_ratio = width / height
     if in_ratio < ratio[0]:
@@ -53,6 +55,17 @@ def random_resized_crop(
     else:
         w, h = width, height
     i, j = (height - h) // 2, (width - w) // 2
+    return j, i, w, h
+
+
+def random_resized_crop(
+    img: Image.Image,
+    size: int,
+    rng: np.random.Generator,
+    scale=(0.08, 1.0),
+    ratio=(3 / 4, 4 / 3),
+) -> Image.Image:
+    j, i, w, h = sample_rrc_box(img.size[0], img.size[1], rng, scale, ratio)
     return img.resize((size, size), Image.BILINEAR, box=(j, i, j + w, i + h))
 
 
@@ -92,3 +105,41 @@ def val_transform(img: Image.Image, resize_size: int, crop_size: int):
     img = resize_shorter(img, resize_size)
     img = center_crop(img, crop_size)
     return to_normalized_array(img)
+
+
+# ---------------------------------------------------------------------------
+# Resample geometries for the native (C++) decode backend. Both transform
+# pipelines reduce to one resample whose output pixel (x, y) samples source
+# position (box + (out0 + x + 0.5) * scale):
+#   train — crop-box resize: box = RRC corner, out0 = 0
+#   val   — shorter-side resize then center-crop: box = 0, out0 = crop offset
+# The draws in train_geom are EXACTLY those of train_transform (same rng
+# stream), so PIL and native backends produce the same augmentations.
+# ---------------------------------------------------------------------------
+
+
+def train_geom(width: int, height: int, im_size: int, rng: np.random.Generator):
+    """(box_x, box_y, scale_x, scale_y, out_x0, out_y0, flip) for train."""
+    j, i, w, h = sample_rrc_box(width, height, rng)
+    flip = 1 if rng.random() < 0.5 else 0
+    return (
+        float(j), float(i), w / im_size, h / im_size, 0, 0, flip,
+    )
+
+
+def val_geom(width: int, height: int, resize_size: int, crop_size: int):
+    """Geometry for val: Resize(shorter=resize_size) + CenterCrop(crop_size).
+
+    Computing only the cropped window of the virtual resized image is exact:
+    each output pixel of a convolution resample depends only on its own
+    source window, so resize-then-crop == crop-of-resize.
+    """
+    if width <= height:
+        new_w, new_h = resize_size, int(round(resize_size * height / width))
+    else:
+        new_w, new_h = int(round(resize_size * width / height)), resize_size
+    left = (new_w - crop_size) // 2
+    top = (new_h - crop_size) // 2
+    return (
+        0.0, 0.0, width / new_w, height / new_h, left, top, 0,
+    )

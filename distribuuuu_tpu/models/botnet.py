@@ -39,7 +39,9 @@ class MHSA2D(nn.Module):
     dim_qk: int = 128
     dim_v: int = 128
     rel_pos_emb: bool = True
-    attn_impl: str = "auto"  # auto | pallas | xla (auto = pallas on TPU)
+    # auto | pallas | xla — "auto" picks the measured winner per shape (XLA
+    # for this 196-token grid; see ops/pallas_attention.use_pallas).
+    attn_impl: str = "auto"
     dtype: Any = jnp.bfloat16
 
     @nn.compact

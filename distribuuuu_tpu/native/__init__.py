@@ -1,0 +1,177 @@
+"""Native (C++) input-pipeline kernel: build + ctypes bindings.
+
+The reference reaches native decode through torchvision/PIL and parallelizes
+it with the DataLoader worker pool (ref: /root/reference/distribuuuu/
+utils.py:127,147). Here the equivalent is first-party C++ (decode.cc):
+libjpeg/libpng decode, a PIL-compatible resampler, normalization, and an
+internal std::thread pool — one GIL-free call per batch.
+
+The library is built lazily with g++ on first use and cached next to the
+source; everything degrades gracefully to the pure-PIL path when a toolchain
+or libjpeg headers are missing (``available()`` → False).
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+
+import numpy as np
+
+_SRC = os.path.join(os.path.dirname(__file__), "decode.cc")
+_LIB = os.path.join(os.path.dirname(__file__), "_libdtpu_decode.so")
+_ABI_VERSION = 2
+
+_lock = threading.Lock()
+_lib = None
+_build_error: str | None = None
+
+
+class Geom(ctypes.Structure):
+    """Mirror of decode.cc's Geom: one resample geometry per image."""
+
+    _fields_ = [
+        ("box_x", ctypes.c_double),
+        ("box_y", ctypes.c_double),
+        ("scale_x", ctypes.c_double),
+        ("scale_y", ctypes.c_double),
+        ("out_x0", ctypes.c_int32),
+        ("out_y0", ctypes.c_int32),
+        ("flip", ctypes.c_int32),
+        ("_pad", ctypes.c_int32),
+    ]
+
+
+def _build() -> str | None:
+    """Compile decode.cc → shared lib. Returns error string or None."""
+    if os.path.exists(_LIB) and os.path.getmtime(_LIB) >= os.path.getmtime(_SRC):
+        return None
+    cmd = [
+        "g++", "-O3", "-shared", "-fPIC", "-std=c++17", "-pthread",
+        _SRC, "-o", _LIB + ".tmp", "-ljpeg", "-lpng",
+    ]
+    try:
+        proc = subprocess.run(cmd, capture_output=True, text=True, timeout=120)
+    except (OSError, subprocess.TimeoutExpired) as exc:  # no g++ etc.
+        return f"native build failed to launch: {exc}"
+    if proc.returncode != 0:
+        return f"native build failed:\n{proc.stderr[-2000:]}"
+    os.replace(_LIB + ".tmp", _LIB)
+    return None
+
+
+def _load():
+    global _lib, _build_error
+    with _lock:
+        if _lib is not None or _build_error is not None:
+            return _lib
+        err = _build()
+        if err is not None:
+            _build_error = err
+            return None
+        try:
+            lib = ctypes.CDLL(_LIB)
+        except OSError as exc:
+            _build_error = f"native lib load failed: {exc}"
+            return None
+        if lib.dtpu_abi_version() != _ABI_VERSION:
+            _build_error = "native ABI mismatch (stale _libdtpu_decode.so?)"
+            return None
+        lib.dtpu_file_dims.restype = ctypes.c_int
+        lib.dtpu_file_dims.argtypes = [
+            ctypes.c_char_p,
+            ctypes.POINTER(ctypes.c_int32),
+            ctypes.POINTER(ctypes.c_int32),
+        ]
+        lib.dtpu_load_batch.restype = None
+        lib.dtpu_load_batch.argtypes = [
+            ctypes.POINTER(ctypes.c_char_p),
+            ctypes.c_void_p,
+            ctypes.c_int32,
+            ctypes.c_int32,
+            ctypes.c_int32,
+            ctypes.POINTER(ctypes.c_float),
+            ctypes.POINTER(ctypes.c_float),
+            ctypes.c_int32,
+            ctypes.POINTER(ctypes.c_float),
+            ctypes.POINTER(ctypes.c_int32),
+        ]
+        _lib = lib
+        return _lib
+
+
+def available() -> bool:
+    """True when the native kernel built/loaded (builds on first call)."""
+    return _load() is not None
+
+
+def build_error() -> str | None:
+    _load()
+    return _build_error
+
+
+def file_dims(path: str) -> tuple[int, int] | None:
+    """(width, height) from the image header, or None if unsupported."""
+    lib = _load()
+    if lib is None:
+        return None
+    w, h = ctypes.c_int32(), ctypes.c_int32()
+    if lib.dtpu_file_dims(path.encode(), ctypes.byref(w), ctypes.byref(h)):
+        return None
+    return w.value, h.value
+
+
+def load_batch(
+    paths: list[str],
+    geoms: np.ndarray,  # structured array matching Geom, len n
+    out_size: tuple[int, int],  # (h, w)
+    mean: np.ndarray,
+    std: np.ndarray,
+    n_threads: int,
+) -> tuple[np.ndarray, np.ndarray]:
+    """Decode+transform a batch. Returns (images [n,h,w,3] f32, statuses [n]).
+
+    Nonzero status marks an image the native path could not handle (exotic
+    format/CMYK/corrupt); the caller re-does those via PIL.
+    """
+    lib = _load()
+    if lib is None:
+        raise RuntimeError(f"native decode unavailable: {_build_error}")
+    n = len(paths)
+    out_h, out_w = out_size
+    images = np.empty((n, out_h, out_w, 3), np.float32)
+    statuses = np.empty((n,), np.int32)
+    c_paths = (ctypes.c_char_p * n)(*[p.encode() for p in paths])
+    mean32 = np.ascontiguousarray(mean, np.float32)
+    std32 = np.ascontiguousarray(std, np.float32)
+    geoms = np.ascontiguousarray(geoms)
+    assert geoms.nbytes == n * ctypes.sizeof(Geom), "geom layout mismatch"
+    lib.dtpu_load_batch(
+        c_paths,
+        geoms.ctypes.data_as(ctypes.c_void_p),
+        n,
+        out_w,
+        out_h,
+        mean32.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
+        std32.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
+        n_threads,
+        images.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
+        statuses.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
+    )
+    return images, statuses
+
+
+GEOM_DTYPE = np.dtype(
+    [
+        ("box_x", np.float64),
+        ("box_y", np.float64),
+        ("scale_x", np.float64),
+        ("scale_y", np.float64),
+        ("out_x0", np.int32),
+        ("out_y0", np.int32),
+        ("flip", np.int32),
+        ("_pad", np.int32),
+    ]
+)
