@@ -69,13 +69,17 @@ def random_resized_crop(
     return img.resize((size, size), Image.BILINEAR, box=(j, i, j + w, i + h))
 
 
+def compute_resize_dims(width: int, height: int, size: int) -> tuple[int, int]:
+    """torchvision Resize(int) target dims: shorter side to ``size``, keep
+    aspect. Shared by the PIL and native val pipelines — they must agree."""
+    if width <= height:
+        return size, int(round(size * height / width))
+    return int(round(size * width / height)), size
+
+
 def resize_shorter(img: Image.Image, size: int) -> Image.Image:
     """torchvision Resize(int): shorter side to ``size``, keep aspect."""
-    width, height = img.size
-    if width <= height:
-        new_w, new_h = size, int(round(size * height / width))
-    else:
-        new_w, new_h = int(round(size * width / height)), size
+    new_w, new_h = compute_resize_dims(img.size[0], img.size[1], size)
     return img.resize((new_w, new_h), Image.BILINEAR)
 
 
@@ -134,10 +138,7 @@ def val_geom(width: int, height: int, resize_size: int, crop_size: int):
     each output pixel of a convolution resample depends only on its own
     source window, so resize-then-crop == crop-of-resize.
     """
-    if width <= height:
-        new_w, new_h = resize_size, int(round(resize_size * height / width))
-    else:
-        new_w, new_h = int(round(resize_size * width / height)), resize_size
+    new_w, new_h = compute_resize_dims(width, height, resize_size)
     left = (new_w - crop_size) // 2
     top = (new_h - crop_size) // 2
     return (

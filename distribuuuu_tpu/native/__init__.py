@@ -48,9 +48,13 @@ def _build() -> str | None:
     """Compile decode.cc → shared lib. Returns error string or None."""
     if os.path.exists(_LIB) and os.path.getmtime(_LIB) >= os.path.getmtime(_SRC):
         return None
+    # Per-pid temp target: concurrent first-use builds (multi-process JAX on
+    # one host, shared package dir) must not interleave writes; os.replace of
+    # a fully-written file is atomic either way.
+    tmp = f"{_LIB}.{os.getpid()}.tmp"
     cmd = [
         "g++", "-O3", "-shared", "-fPIC", "-std=c++17", "-pthread",
-        _SRC, "-o", _LIB + ".tmp", "-ljpeg", "-lpng",
+        _SRC, "-o", tmp, "-ljpeg", "-lpng",
     ]
     try:
         proc = subprocess.run(cmd, capture_output=True, text=True, timeout=120)
@@ -58,7 +62,7 @@ def _build() -> str | None:
         return f"native build failed to launch: {exc}"
     if proc.returncode != 0:
         return f"native build failed:\n{proc.stderr[-2000:]}"
-    os.replace(_LIB + ".tmp", _LIB)
+    os.replace(tmp, _LIB)
     return None
 
 

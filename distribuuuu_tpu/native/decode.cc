@@ -125,6 +125,13 @@ bool decode_png(const uint8_t* data, size_t len, ImageU8* out) {
   std::memset(&image, 0, sizeof(image));
   image.version = PNG_IMAGE_VERSION;
   if (!png_image_begin_read_from_memory(&image, data, len)) return false;
+  // Alpha (incl. palette tRNS): libpng would COMPOSITE it away, while the
+  // PIL path's convert("RGB") drops the band — different pixels. Punt those
+  // to the PIL fallback so both backends agree (same treatment as CMYK JPEG).
+  if (image.format & PNG_FORMAT_FLAG_ALPHA) {
+    png_image_free(&image);
+    return false;
+  }
   image.format = PNG_FORMAT_RGB;
   out->w = static_cast<int>(image.width);
   out->h = static_cast<int>(image.height);

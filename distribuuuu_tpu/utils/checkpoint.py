@@ -29,7 +29,8 @@ _BEST_NAME = "best"
 
 
 def get_checkpoint_dir() -> str:
-    return os.path.join(cfg.OUT_DIR, "checkpoints")
+    # Absolute: orbax/tensorstore rejects relative paths.
+    return os.path.abspath(os.path.join(cfg.OUT_DIR, "checkpoints"))
 
 
 def get_checkpoint(epoch: int) -> str:

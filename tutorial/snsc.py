@@ -1,0 +1,120 @@
+"""Tutorial 1/6 — SNSC: Single Node, Single Chip.
+
+The baseline every later script builds on (≙ ref tutorial/snsc.py: one GPU,
+CIFAR-10, resnet18, SGD). Everything JAX needs for supervised training on ONE
+device, with zero parallelism:
+
+  1. a flax model (here: a small CIFAR-style ResNet-18),
+  2. an optax optimizer (SGD + momentum, the reference's recipe),
+  3. ONE jitted ``train_step`` holding forward, loss, backward and update —
+     under ``jax.jit`` the whole step is traced once, compiled by XLA into a
+     single device program, and cached. This is the core difference from
+     eager torch: there is no per-op dispatch in the hot loop.
+
+Run (any single device — TPU chip or CPU):
+
+    python tutorial/snsc.py
+
+Uses synthetic CIFAR-shaped data so it runs with zero downloads; swap
+``synthetic_cifar`` for a real CIFAR-10 reader to reproduce accuracy (the
+reference's transcript reaches ~64% test acc after 5 epochs; this script's
+loss trajectory on synthetic data is shown below).
+
+Expected output (one TPU v5e chip, synthetic data, seed 0 — wall times vary;
+the easy synthetic labels are learned almost immediately):
+
+    devices: [TPU v5 lite0]
+    [epoch 1/2] step  50/ 97  loss 0.0100
+    [epoch 1/2] step  97/ 97  loss 0.0019
+    [epoch 1/2] train_loss 0.2588  (52.0s)
+    [epoch 2/2] step  50/ 97  loss 0.0078
+    [epoch 2/2] step  97/ 97  loss 0.0019
+    [epoch 2/2] train_loss 0.0065  (37.4s)
+    done: final train loss 0.0019 on 1 device(s)
+"""
+
+from __future__ import annotations
+
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+from distribuuuu_tpu import models
+
+BATCH = 512
+EPOCHS = 2
+STEPS_PER_EPOCH = 97  # ≙ ceil(50000 / 512): one synthetic "CIFAR epoch"
+LR = 0.1
+SEED = 0
+
+
+def synthetic_cifar(rng: np.random.Generator, n: int):
+    """Stand-in for the CIFAR-10 train split: [n,32,32,3] floats + labels.
+
+    The labels are a deterministic function of the images (mean-brightness
+    bucket) so the model has something learnable and the loss actually falls.
+    """
+    images = rng.standard_normal((n, 32, 32, 3), dtype=np.float32)
+    labels = (
+        (images.mean(axis=(1, 2, 3)) * 40.0).astype(np.int64) % 10
+    ).astype(np.int32)
+    # make the signal easy: shift each image by its label
+    images += labels[:, None, None, None] * 0.1
+    return images, labels
+
+
+def main():
+    print(f"devices: {jax.devices()}")
+    # CIFAR-sized resnet18: 10 classes, fp32 (tiny model; bf16 gains nothing here)
+    model = models.build_model("resnet18", num_classes=10, dtype=jnp.float32)
+    key = jax.random.key(SEED)
+    variables = model.init(key, jnp.ones((1, 32, 32, 3)), train=False)
+    params, batch_stats = variables["params"], variables["batch_stats"]
+
+    # SGD + momentum 0.9 — the reference recipe (ref: tutorial/snsc.py optimizer)
+    tx = optax.sgd(LR, momentum=0.9, nesterov=True)
+    opt_state = tx.init(params)
+
+    @jax.jit  # one compiled program = fwd + loss + bwd + update
+    def train_step(params, batch_stats, opt_state, images, labels):
+        def loss_fn(p):
+            logits, mutated = model.apply(
+                {"params": p, "batch_stats": batch_stats},
+                images, train=True, mutable=["batch_stats"],
+            )
+            onehot = jax.nn.one_hot(labels, 10)
+            loss = optax.softmax_cross_entropy(logits, onehot).mean()
+            return loss, mutated["batch_stats"]
+
+        (loss, new_stats), grads = jax.value_and_grad(loss_fn, has_aux=True)(params)
+        updates, opt_state = tx.update(grads, opt_state, params)
+        params = optax.apply_updates(params, updates)
+        return params, new_stats, opt_state, loss
+
+    rng = np.random.default_rng(SEED)
+    final = 0.0
+    for epoch in range(EPOCHS):
+        t0, total = time.perf_counter(), 0.0
+        for step in range(STEPS_PER_EPOCH):
+            images, labels = synthetic_cifar(rng, BATCH)
+            params, batch_stats, opt_state, loss = train_step(
+                params, batch_stats, opt_state, images, labels
+            )
+            total += (final := float(loss))
+            if (step + 1) % 50 == 0 or step + 1 == STEPS_PER_EPOCH:
+                print(
+                    f"[epoch {epoch + 1}/{EPOCHS}] step {step + 1:3d}/{STEPS_PER_EPOCH:3d}"
+                    f"  loss {final:.4f}"
+                )
+        print(
+            f"[epoch {epoch + 1}/{EPOCHS}] train_loss {total / STEPS_PER_EPOCH:.4f}"
+            f"  ({time.perf_counter() - t0:.1f}s)"
+        )
+    print(f"done: final train loss {final:.4f} on {jax.device_count()} device(s)")
+
+
+if __name__ == "__main__":
+    main()
