@@ -1,0 +1,39 @@
+#!/usr/bin/env bash
+# Static checks (≙ the reference's isort → black → flake8 pipeline,
+# ref: /root/reference/.dev/pre-commit.sh). Formatters/linters run when
+# installed; the compile + test-collection floor always runs, so the hook is
+# useful even on hermetic machines with no lint toolchain.
+#
+# Install as a git hook:  ln -s ../../.dev/pre-commit.sh .git/hooks/pre-commit
+set -euo pipefail
+cd "$(dirname "$0")/.."
+
+PY_TARGETS=(distribuuuu_tpu tests tutorial train_net.py test_net.py bench.py)
+
+if command -v ruff >/dev/null 2>&1; then
+    echo "[pre-commit] ruff check"
+    ruff check "${PY_TARGETS[@]}"
+    echo "[pre-commit] ruff format --check"
+    ruff format --check "${PY_TARGETS[@]}"
+else
+    if command -v isort >/dev/null 2>&1; then
+        echo "[pre-commit] isort --check"
+        isort --check-only --profile black "${PY_TARGETS[@]}"
+    fi
+    if command -v black >/dev/null 2>&1; then
+        echo "[pre-commit] black --check"
+        black --check "${PY_TARGETS[@]}"
+    fi
+    if command -v flake8 >/dev/null 2>&1; then
+        echo "[pre-commit] flake8"
+        flake8 "${PY_TARGETS[@]}"
+    fi
+fi
+
+echo "[pre-commit] compileall (syntax floor)"
+python -m compileall -q distribuuuu_tpu tests tutorial train_net.py test_net.py bench.py
+
+echo "[pre-commit] pytest collection (import floor)"
+python -m pytest tests/ -q --collect-only >/dev/null
+
+echo "[pre-commit] ok"
