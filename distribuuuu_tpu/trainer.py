@@ -271,6 +271,33 @@ def _state_tree(state: TrainState) -> dict:
     }
 
 
+def _restore_weights(path: str, model):
+    """Weights from an orbax checkpoint dir OR a torch ``.pth`` pickle
+    (reference-trained weights / URL-zoo files, ref: resnet.py:23-33,
+    trainer.py:204-205). Returns {"params", "batch_stats"} numpy/jax trees."""
+    from distribuuuu_tpu.utils import torch_ingest
+
+    if torch_ingest.is_torch_checkpoint(path):
+        sd = torch_ingest.load_torch_state_dict(path)
+        return torch_ingest.convert_state_dict(
+            sd, torch_ingest.ordered_variables(model, im_size=cfg.TRAIN.IM_SIZE)
+        )
+    return ckpt.load_checkpoint(path)
+
+
+def _with_restored_weights(state: TrainState, path: str, model) -> TrainState:
+    """State with params/batch_stats replaced from ``path`` (orbax or torch),
+    placed with the live layout; optimizer state and step untouched."""
+    restored = _restore_weights(path, model)
+    return TrainState(
+        params=_place_like(state.params, restored["params"]),
+        batch_stats=_place_like(state.batch_stats, restored["batch_stats"]),
+        opt_state=state.opt_state,
+        step=state.step,
+        key=state.key,
+    )
+
+
 def _resume(state: TrainState, mesh) -> tuple[TrainState, int, float]:
     """Auto-resume from the last epoch checkpoint (ref: trainer.py:143-149)."""
     logger = get_logger()
@@ -328,6 +355,25 @@ def train_model():
     start_epoch, best_acc1 = 0, 0.0
     if cfg.TRAIN.AUTO_RESUME and ckpt.has_checkpoint():
         state, start_epoch, best_acc1 = _resume(state, mesh)
+    elif cfg.MODEL.PRETRAINED and cfg.MODEL.WEIGHTS:
+        # warm start from pretrained weights (≙ the reference's URL-zoo
+        # `pretrained=True` path, ref: resnet.py:309-311 — here the file may
+        # be a torch pickle or an orbax dir)
+        state = _with_restored_weights(state, cfg.MODEL.WEIGHTS, model)
+        logger.info("warm-started from pretrained weights %s", cfg.MODEL.WEIGHTS)
+    elif cfg.MODEL.PRETRAINED:
+        # The reference downloads zoo weights on PRETRAINED=True; offline, a
+        # weights file is required — refuse rather than silently train from
+        # random init.
+        raise ValueError(
+            "MODEL.PRETRAINED True needs MODEL.WEIGHTS pointing at a weights "
+            "file (torch .pth or orbax dir); there is no URL zoo offline"
+        )
+    elif cfg.MODEL.WEIGHTS:
+        logger.warning(
+            "MODEL.WEIGHTS is ignored during training unless "
+            "MODEL.PRETRAINED True (evaluation uses test_net.py)"
+        )
 
     for epoch in range(start_epoch, cfg.OPTIM.MAX_EPOCH):
         state = train_epoch(loader=train_loader, mesh=mesh, state=state,
@@ -354,14 +400,7 @@ def test_model():
     key = jax.random.key(cfg.RNG_SEED or 0)
     state = create_train_state(model, key, mesh, cfg.TRAIN.IM_SIZE)
     if cfg.MODEL.WEIGHTS:
-        restored = ckpt.load_checkpoint(cfg.MODEL.WEIGHTS)
-        state = TrainState(
-            params=_place_like(state.params, restored["params"]),
-            batch_stats=_place_like(state.batch_stats, restored["batch_stats"]),
-            opt_state=state.opt_state,
-            step=state.step,
-            key=state.key,
-        )
+        state = _with_restored_weights(state, cfg.MODEL.WEIGHTS, model)
         logger.info("loaded weights from %s", cfg.MODEL.WEIGHTS)
     val_loader = construct_val_loader()
     eval_step = make_eval_step(model, effective_topk())

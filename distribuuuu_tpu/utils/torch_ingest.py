@@ -1,0 +1,266 @@
+"""Ingest PyTorch checkpoints (reference-trained weights) into flax trees.
+
+The reference ships a pretrained-weight path: a URL zoo for ResNet
+(ref: /root/reference/distribuuuu/models/resnet.py:23-33,309-311) and
+DenseNet with a legacy-key remap (ref: densenet.py:266-282), plus
+``MODEL.WEIGHTS`` checkpoint loading (ref: trainer.py:204-205). This module
+is the TPU-native equivalent: it converts a torch ``state_dict`` (torchvision
+naming, or the reference's training checkpoints ``{state_dict: ...}``) into
+this framework's ``{"params": ..., "batch_stats": ...}`` pytrees, so users
+can bring reference-trained weights to TPU.
+
+Strategy: align by *kind and definition order*, not by name. Both frameworks
+enumerate modules in definition order (torch ``state_dict`` insertion order;
+flax init-dict insertion order). Convs, BatchNorms and Linears are each
+matched in that order per kind, which is invariant to naming schemes and to
+conv/BN interleaving differences. Every pairing is shape-checked after
+layout transposition, so any misalignment fails loudly:
+
+  - conv weight  [O, I/g, kh, kw]  →  kernel [kh, kw, I/g, O]
+  - linear weight [O, I]           →  kernel [I, O]
+  - bn {weight, bias, running_mean, running_var}
+        → params {scale, bias} + batch_stats {mean, var}
+
+Torch is only needed when reading ``.pth`` pickles; a pre-extracted numpy
+``state_dict``-style mapping works without torch installed.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Mapping
+
+import numpy as np
+
+__all__ = [
+    "load_torch_state_dict",
+    "convert_state_dict",
+    "is_torch_checkpoint",
+    "ordered_variables",
+]
+
+_TORCH_SUFFIXES = (".pth", ".pt", ".pth.tar", ".pt.tar", ".bin")
+
+
+def is_torch_checkpoint(path: str) -> bool:
+    return any(path.endswith(s) for s in _TORCH_SUFFIXES)
+
+
+def ordered_variables(model, im_size: int = 64):
+    """Init ``model`` eagerly to recover *definition-ordered* variable dicts.
+
+    Conversion aligns modules by definition order, which plain ``init``
+    preserves via dict insertion order — but anything that round-trips
+    through a jax transform (jit, eval_shape) canonicalizes pytree dict keys
+    to sorted order and loses it. Always feed conversion from here.
+    """
+    import jax
+    import jax.numpy as jnp
+
+    return model.init(
+        jax.random.key(0), jnp.ones((1, im_size, im_size, 3)), train=False
+    )
+
+
+def load_torch_state_dict(path: str) -> dict[str, np.ndarray]:
+    """Read a torch checkpoint file → {name: numpy array}, insertion-ordered.
+
+    Accepts either a bare ``state_dict`` or the reference trainer's
+    checkpoint dict ``{"state_dict": ..., ...}`` (ref: utils.py:375-380);
+    DDP ``module.`` prefixes are stripped (ref: utils.py:360-363).
+    """
+    import torch  # CPU build is sufficient; only used as a pickle reader
+
+    obj = torch.load(path, map_location="cpu", weights_only=True)
+    if isinstance(obj, dict) and "state_dict" in obj:
+        obj = obj["state_dict"]
+    out: dict[str, np.ndarray] = {}
+    for k, v in obj.items():
+        if k.startswith("module."):
+            k = k[len("module."):]
+        out[k] = np.asarray(v.detach().cpu().numpy() if hasattr(v, "detach") else v)
+    return out
+
+
+# ---------------------------------------------------------------------------
+# torch side: group the flat state_dict into per-module slots, in order
+# ---------------------------------------------------------------------------
+
+
+def _torch_slots(state_dict: Mapping[str, np.ndarray]):
+    """Yield ('conv'|'linear'|'bn', dict) per module, in definition order."""
+    groups: dict[str, dict[str, np.ndarray]] = {}
+    order: list[str] = []
+    for key, val in state_dict.items():
+        if key.endswith("num_batches_tracked"):
+            continue
+        prefix, _, leaf = key.rpartition(".")
+        if prefix not in groups:
+            groups[prefix] = {}
+            order.append(prefix)
+        groups[prefix][leaf] = np.asarray(val)
+    for prefix in order:
+        g = groups[prefix]
+        if "running_mean" in g:
+            yield "bn", prefix, g
+        elif "weight" in g and g["weight"].ndim == 4:
+            yield "conv", prefix, g
+        elif "weight" in g and g["weight"].ndim == 2:
+            yield "linear", prefix, g
+        elif "weight" in g and g["weight"].ndim == 1:
+            # 1D weight without running stats: an affine norm layer saved
+            # without stats — treat as bn with zero/one stats
+            yield "bn", prefix, g
+        # anything else (buffers, pos embeddings) has no generic torch
+        # counterpart here and is left to arch-specific handling
+
+
+# ---------------------------------------------------------------------------
+# flax side: walk params/batch_stats in insertion (definition) order
+# ---------------------------------------------------------------------------
+
+
+def _is_leaf_dict(d) -> bool:
+    return isinstance(d, Mapping) and all(
+        not isinstance(v, Mapping) for v in d.values()
+    )
+
+
+def _unwrap(v):
+    """Strip flax AxisMetadata boxes (nn.with_partitioning wraps kernels in
+    Partitioned, whose array lives in ``.value``)."""
+    return v.value if hasattr(v, "value") and not isinstance(v, np.ndarray) else v
+
+
+def _flax_slots(params: Mapping, batch_stats: Mapping):
+    """Yield ('conv'|'linear'|'bn', path, leaves) in definition order.
+
+    ``leaves`` maps leaf name → array for shape reference. Walks the params
+    dict in insertion order (flax init preserves module-definition order);
+    batch_stats are joined by path for BN modules.
+    """
+
+    def stats_at(path):
+        node = batch_stats
+        for p in path:
+            if not isinstance(node, Mapping) or p not in node:
+                return None
+            node = node[p]
+        return node
+
+    def walk(node, path):
+        if _is_leaf_dict(node):
+            node = {k: _unwrap(v) for k, v in node.items()}
+            names = set(node.keys())
+            if "scale" in names or (names == {"bias"} and stats_at(path)):
+                st = stats_at(path) or {}
+                yield "bn", path, {**node, **{k: _unwrap(v) for k, v in st.items()}}
+                return
+            if "kernel" in names:
+                kind = "conv" if np.ndim(node["kernel"]) == 4 else "linear"
+                yield kind, path, dict(node)
+                return
+            # e.g. learned position embeddings — arch-specific, skipped here
+            yield "other", path, dict(node)
+            return
+        for key, child in node.items():
+            if isinstance(child, Mapping):
+                yield from walk(child, path + (key,))
+            else:
+                yield "other", path + (key,), {key: _unwrap(child)}
+
+    yield from walk(params, ())
+
+
+# ---------------------------------------------------------------------------
+# conversion
+# ---------------------------------------------------------------------------
+
+
+def _set_in(tree: dict, path: tuple, leaf: str, value: np.ndarray):
+    node = tree
+    for p in path:
+        node = node.setdefault(p, {})
+    node[leaf] = value
+
+
+def convert_state_dict(
+    state_dict: Mapping[str, np.ndarray],
+    variables: Mapping[str, Any],
+) -> dict[str, Any]:
+    """Convert a torch ``state_dict`` to ``{"params", "batch_stats"}`` trees
+    shaped like ``variables`` (a flax ``model.init`` result or its
+    ``eval_shape``). Raises ``ValueError`` on any kind/shape mismatch.
+    """
+    params = variables["params"]
+    batch_stats = variables.get("batch_stats", {})
+
+    queues: dict[str, list] = {"conv": [], "linear": [], "bn": []}
+    for kind, prefix, group in _torch_slots(state_dict):
+        queues[kind].append((prefix, group))
+
+    counts = {k: 0 for k in queues}
+    new_params: dict = {}
+    new_stats: dict = {}
+
+    for kind, path, leaves in _flax_slots(params, batch_stats):
+        if kind == "other":
+            raise ValueError(
+                f"flax module at {'/'.join(path)} has no torch equivalent "
+                f"(leaves: {list(leaves)}); arch not ingestible generically"
+            )
+        if counts[kind] >= len(queues[kind]):
+            raise ValueError(
+                f"torch checkpoint ran out of {kind} modules at flax path "
+                f"{'/'.join(path)} (needed >{counts[kind]})"
+            )
+        prefix, group = queues[kind][counts[kind]]
+        counts[kind] += 1
+
+        def check(name, got, want_shape):
+            if tuple(got.shape) != tuple(want_shape):
+                raise ValueError(
+                    f"shape mismatch at flax {'/'.join(path)} ↔ torch "
+                    f"'{prefix}' [{name}]: torch {tuple(got.shape)} vs flax "
+                    f"{tuple(want_shape)} — architecture/order mismatch"
+                )
+
+        if kind == "conv":
+            w = np.transpose(group["weight"], (2, 3, 1, 0))  # OIHW → HWIO
+            check("weight", w, np.shape(leaves["kernel"]))
+            _set_in(new_params, path, "kernel", np.ascontiguousarray(w))
+            if "bias" in leaves:
+                check("bias", group["bias"], np.shape(leaves["bias"]))
+                _set_in(new_params, path, "bias", group["bias"])
+        elif kind == "linear":
+            w = np.transpose(group["weight"], (1, 0))  # OI → IO
+            check("weight", w, np.shape(leaves["kernel"]))
+            _set_in(new_params, path, "kernel", np.ascontiguousarray(w))
+            if "bias" in leaves:
+                check("bias", group["bias"], np.shape(leaves["bias"]))
+                _set_in(new_params, path, "bias", group["bias"])
+        else:  # bn
+            n = group.get("weight", group.get("scale"))
+            if "scale" in leaves:
+                check("weight", n, np.shape(leaves["scale"]))
+                _set_in(new_params, path, "scale", n)
+            check("bias", group["bias"], np.shape(leaves["bias"]))
+            _set_in(new_params, path, "bias", group["bias"])
+            if "mean" in leaves:
+                mean = group.get("running_mean", np.zeros_like(group["bias"]))
+                var = group.get("running_var", np.ones_like(group["bias"]))
+                check("running_mean", mean, np.shape(leaves["mean"]))
+                check("running_var", var, np.shape(leaves["var"]))
+                _set_in(new_stats, path, "mean", mean)
+                _set_in(new_stats, path, "var", var)
+
+    leftovers = {k: len(q) - counts[k] for k, q in queues.items() if len(q) > counts[k]}
+    if leftovers:
+        detail = {
+            k: [p for p, _ in queues[k][counts[k] : counts[k] + 3]]
+            for k in leftovers
+        }
+        raise ValueError(
+            f"torch checkpoint has unconsumed modules {leftovers} "
+            f"(first unmatched: {detail}) — architecture mismatch"
+        )
+    return {"params": new_params, "batch_stats": new_stats}

@@ -1,0 +1,214 @@
+"""Torch-checkpoint ingestion: numerics oracles + structural round trips.
+
+The converter aligns torch modules to flax modules by kind and definition
+order (utils/torch_ingest.py). These tests check (a) exact numerics of each
+layer-kind conversion against torch's own forward (torch CPU is the oracle),
+(b) full-model structural round trips for the archs the reference ships
+pretrained weights for (ResNet/DenseNet families), and (c) loud failure on
+architecture mismatch.
+"""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from distribuuuu_tpu import models
+from distribuuuu_tpu.utils import torch_ingest
+
+torch = pytest.importorskip("torch")
+
+
+# ---------------------------------------------------------------------------
+# helpers: inverse transform (flax → torch state_dict) for round trips
+# ---------------------------------------------------------------------------
+
+
+def randomize(tree, seed=0):
+    """Replace every leaf with random values (so round trips are meaningful:
+    init leaves BN scales at 1, biases at 0, which would hide swaps).
+
+    Order-preserving manual walk — jax.tree.map would rebuild dicts with
+    sorted keys and destroy the definition order the converter aligns on.
+    Also unwraps flax Partitioned boxes."""
+    rng = np.random.default_rng(seed)
+
+    def walk(node):
+        if isinstance(node, dict):
+            return {k: walk(v) for k, v in node.items()}
+        v = torch_ingest._unwrap(node)
+        return np.asarray(rng.standard_normal(np.shape(v)) * 0.5 + 0.1, np.float32)
+
+    return walk(tree)
+
+
+def flax_to_torch_sd(variables) -> dict:
+    """Build a torch-convention state_dict from definition-ordered flax
+    variables — the exact inverse of the converter's layout mapping."""
+    sd = {}
+    idx = 0
+    for kind, path, leaves in torch_ingest._flax_slots(
+        variables["params"], variables["batch_stats"]
+    ):
+        prefix = f"m{idx:03d}"
+        idx += 1
+        if kind == "conv":
+            sd[f"{prefix}.weight"] = np.transpose(
+                np.asarray(leaves["kernel"]), (3, 2, 0, 1)
+            )
+            if "bias" in leaves:
+                sd[f"{prefix}.bias"] = np.asarray(leaves["bias"])
+        elif kind == "linear":
+            sd[f"{prefix}.weight"] = np.transpose(np.asarray(leaves["kernel"]))
+            sd[f"{prefix}.bias"] = np.asarray(leaves["bias"])
+        elif kind == "bn":
+            sd[f"{prefix}.weight"] = np.asarray(leaves["scale"])
+            sd[f"{prefix}.bias"] = np.asarray(leaves["bias"])
+            sd[f"{prefix}.running_mean"] = np.asarray(leaves["mean"])
+            sd[f"{prefix}.running_var"] = np.abs(np.asarray(leaves["var"])) + 0.5
+            sd[f"{prefix}.num_batches_tracked"] = np.asarray(7)
+        else:
+            raise AssertionError(f"unexpected slot kind {kind} at {path}")
+    return sd
+
+
+def assert_trees_equal(a, b):
+    la = jax.tree_util.tree_leaves_with_path(a)
+    lb = jax.tree_util.tree_leaves_with_path(b)
+    assert [k for k, _ in la] == [k for k, _ in lb]
+    for (k, x), (_, y) in zip(la, lb):
+        np.testing.assert_array_equal(
+            np.asarray(x), np.asarray(y), err_msg=jax.tree_util.keystr(k)
+        )
+
+
+# ---------------------------------------------------------------------------
+# numerics oracles vs torch forward
+# ---------------------------------------------------------------------------
+
+
+def test_convbn_numerics_match_torch():
+    """Converted conv+BN weights reproduce torch's eval-mode forward."""
+    from distribuuuu_tpu.models.layers import ConvBN
+
+    tconv = torch.nn.Conv2d(3, 8, 3, stride=2, padding=1, bias=False)
+    tbn = torch.nn.BatchNorm2d(8)
+    with torch.no_grad():
+        tbn.weight.copy_(torch.rand(8) + 0.5)
+        tbn.bias.copy_(torch.rand(8) - 0.5)
+        tbn.running_mean.copy_(torch.rand(8))
+        tbn.running_var.copy_(torch.rand(8) + 0.5)
+    tconv.eval(), tbn.eval()
+
+    x = np.random.default_rng(0).standard_normal((2, 10, 10, 3)).astype(np.float32)
+    with torch.no_grad():
+        want = (
+            tbn(tconv(torch.from_numpy(np.transpose(x, (0, 3, 1, 2)))))
+            .numpy()
+            .transpose(0, 2, 3, 1)
+        )
+
+    model = ConvBN(8, (3, 3), 2, dtype=jnp.float32)
+    variables = model.init(jax.random.key(0), jnp.asarray(x), train=False)
+    sd = {
+        "conv.weight": tconv.weight.detach().numpy(),
+        "bn.weight": tbn.weight.detach().numpy(),
+        "bn.bias": tbn.bias.detach().numpy(),
+        "bn.running_mean": tbn.running_mean.numpy(),
+        "bn.running_var": tbn.running_var.numpy(),
+    }
+    conv = torch_ingest.convert_state_dict(sd, variables)
+    got = model.apply(
+        {"params": conv["params"], "batch_stats": conv["batch_stats"]},
+        jnp.asarray(x),
+        train=False,
+    )
+    np.testing.assert_allclose(np.asarray(got), want, rtol=1e-4, atol=1e-5)
+
+
+def test_linear_numerics_match_torch():
+    from distribuuuu_tpu.models.layers import Dense
+
+    tfc = torch.nn.Linear(12, 5)
+    x = np.random.default_rng(1).standard_normal((3, 12)).astype(np.float32)
+    with torch.no_grad():
+        want = tfc(torch.from_numpy(x)).numpy()
+
+    model = Dense(5, dtype=jnp.float32)
+    variables = model.init(jax.random.key(0), jnp.asarray(x))
+    sd = {
+        "fc.weight": tfc.weight.detach().numpy(),
+        "fc.bias": tfc.bias.detach().numpy(),
+    }
+    conv = torch_ingest.convert_state_dict(sd, variables)
+    got = model.apply({"params": conv["params"]}, jnp.asarray(x))
+    np.testing.assert_allclose(np.asarray(got), want, rtol=1e-4, atol=1e-5)
+
+
+# ---------------------------------------------------------------------------
+# full-model round trips (the archs with reference pretrained weights)
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.parametrize("arch", ["resnet18", "resnet50", "densenet121"])
+def test_full_model_roundtrip(arch):
+    model = models.build_model(arch, num_classes=10, dtype=jnp.float32)
+    variables = torch_ingest.ordered_variables(model)
+    variables = {
+        "params": randomize(variables["params"], seed=3),
+        "batch_stats": randomize(variables["batch_stats"], seed=4),
+    }
+    sd = flax_to_torch_sd(variables)
+    conv = torch_ingest.convert_state_dict(sd, variables)
+    # abs() in the inverse keeps var positive; compare through the same map
+    want_stats = jax.tree.map(np.asarray, variables["batch_stats"])
+    for (k, x), (_, y) in zip(
+        jax.tree_util.tree_leaves_with_path(conv["batch_stats"]),
+        jax.tree_util.tree_leaves_with_path(want_stats),
+    ):
+        if jax.tree_util.keystr(k).endswith("['var']"):
+            continue  # var was abs+0.5'd in the inverse; skip exact check
+        np.testing.assert_array_equal(np.asarray(x), y)
+    assert_trees_equal(conv["params"], variables["params"])
+
+    # the converted tree must actually run
+    out = model.apply(
+        {"params": conv["params"], "batch_stats": conv["batch_stats"]},
+        jnp.ones((1, 64, 64, 3)),
+        train=False,
+    )
+    assert out.shape == (1, 10)
+
+
+def test_reference_checkpoint_format_and_module_prefix(tmp_path):
+    """torch.save'd reference-style checkpoints ({'state_dict': ...} with DDP
+    'module.' prefixes) load through the file path."""
+    model = models.build_model("resnet18", num_classes=10, dtype=jnp.float32)
+    variables = torch_ingest.ordered_variables(model)
+    sd = flax_to_torch_sd(variables)
+    wrapped = {
+        "epoch": 3,
+        "state_dict": {f"module.{k}": torch.from_numpy(np.asarray(v)) for k, v in sd.items()},
+    }
+    path = str(tmp_path / "ckpt_ep_003.pth.tar")
+    torch.save(wrapped, path)
+
+    assert torch_ingest.is_torch_checkpoint(path)
+    loaded = torch_ingest.load_torch_state_dict(path)
+    assert list(loaded) == list(sd)  # order preserved, prefix stripped
+    from flax.linen import meta
+
+    conv = torch_ingest.convert_state_dict(loaded, variables)
+    assert_trees_equal(
+        conv["params"],
+        jax.tree.map(np.asarray, meta.unbox(variables["params"])),
+    )
+
+
+def test_arch_mismatch_raises():
+    r18 = models.build_model("resnet18", num_classes=10, dtype=jnp.float32)
+    r34 = models.build_model("resnet34", num_classes=10, dtype=jnp.float32)
+    sd = flax_to_torch_sd(torch_ingest.ordered_variables(r18))
+    with pytest.raises(ValueError):
+        torch_ingest.convert_state_dict(sd, torch_ingest.ordered_variables(r34))
