@@ -264,6 +264,17 @@ _C.DATA = CfgNode()
 # builds, else PIL; "native" requires it; "pil" forces pure Python.
 _C.DATA.BACKEND = "auto"
 
+# ------------------------------- profiler ------------------------------------
+# jax.profiler trace capture (TensorBoard/XProf format). When enabled, the
+# primary process traces NUM_STEPS train steps starting at START_STEP of
+# epoch 0 into {OUT_DIR}/profile (or DIR when set). The reference offers
+# wall-clock meters only (SURVEY.md §5.1); this is the TPU-idiomatic upgrade.
+_C.PROF = CfgNode()
+_C.PROF.ENABLED = False
+_C.PROF.DIR = ""
+_C.PROF.START_STEP = 10
+_C.PROF.NUM_STEPS = 5
+
 # ------------------------------- misc ---------------------------------------
 _C.OUT_DIR = "./output"
 _C.CFG_DEST = "config.yaml"

@@ -189,7 +189,55 @@ def effective_topk() -> int:
     return min(cfg.TRAIN.TOPK, cfg.MODEL.NUM_CLASSES)
 
 
-def train_epoch(loader, mesh, state, train_step, epoch: int, logger):
+class _ProfilerWindow:
+    """jax.profiler capture over steps [START, START+NUM) of the first
+    *executed* epoch (auto-resumed runs profile their first epoch too)."""
+
+    def __init__(self, epoch: int, first_epoch: int):
+        self.active = False
+        self.enabled = (
+            cfg.PROF.ENABLED and epoch == first_epoch and mesh_lib.is_primary()
+        )
+        if self.enabled and cfg.PROF.NUM_STEPS < 1:
+            get_logger().warning(
+                "PROF.NUM_STEPS=%d < 1; profiling disabled", cfg.PROF.NUM_STEPS
+            )
+            self.enabled = False
+        if self.enabled:
+            import os
+
+            self.trace_dir = cfg.PROF.DIR or os.path.join(cfg.OUT_DIR, "profile")
+            self.first = cfg.PROF.START_STEP
+            self.last = cfg.PROF.START_STEP + cfg.PROF.NUM_STEPS
+
+    def begin(self, it):
+        if self.enabled and it == self.first:
+            jax.profiler.start_trace(self.trace_dir)
+            self.active = True
+
+    def _stop(self, state):
+        # drain the async dispatch queue so the trace holds real device work
+        jax.block_until_ready(state.params)
+        jax.profiler.stop_trace()
+        self.active = False
+        get_logger().info("profiler trace written to %s", self.trace_dir)
+
+    def end(self, it, state):
+        if self.active and it + 1 == self.last:
+            self._stop(state)
+
+    def finish(self, state):
+        """Epoch ended before the window did — close the trace anyway."""
+        if self.active:
+            get_logger().warning(
+                "profiler window truncated by epoch end (wanted steps "
+                "[%d, %d))", self.first, self.last,
+            )
+            self._stop(state)
+
+
+def train_epoch(loader, mesh, state, train_step, epoch: int, logger,
+                first_epoch: int = 0):
     """One epoch of the hot loop (ref: trainer.py:14-64)."""
     lr = get_epoch_lr(epoch)
     set_lr(state.opt_state, lr)  # epoch-granular LR (ref: trainer.py:25-26)
@@ -198,12 +246,15 @@ def train_epoch(loader, mesh, state, train_step, epoch: int, logger):
     batch_time, data_time, losses, top1, topk_m, progress = construct_meters(
         num_batches, f"Epoch[{epoch + 1}/{cfg.OPTIM.MAX_EPOCH}]", effective_topk()
     )
+    prof = _ProfilerWindow(epoch, first_epoch)
     pending = []  # (step_idx, device metrics) awaiting async fetch
     end = time.perf_counter()
     for it, host_batch in enumerate(loader):
         data_time.update(time.perf_counter() - end)
         batch = sharding_lib.shard_batch(mesh, host_batch)
+        prof.begin(it)
         state, metrics = train_step(state, batch)
+        prof.end(it, state)
         pending.append(metrics)
         batch_time.update(time.perf_counter() - end)
         end = time.perf_counter()
@@ -221,6 +272,7 @@ def train_epoch(loader, mesh, state, train_step, epoch: int, logger):
                     + (cfg.OPTIM.MAX_EPOCH - epoch - 1) * num_batches,
                 )
                 logger.info("%s  LR %.5f  ETA %s", progress.display(it + 1), lr, eta)
+    prof.finish(state)
     return state
 
 
@@ -377,7 +429,8 @@ def train_model():
 
     for epoch in range(start_epoch, cfg.OPTIM.MAX_EPOCH):
         state = train_epoch(loader=train_loader, mesh=mesh, state=state,
-                            train_step=train_step, epoch=epoch, logger=logger)
+                            train_step=train_step, epoch=epoch, logger=logger,
+                            first_epoch=start_epoch)
         acc1, _ = validate(val_loader, mesh, state, eval_step, epoch, logger)
         is_best = acc1 > best_acc1
         best_acc1 = max(acc1, best_acc1)

@@ -34,6 +34,10 @@ def test_train_model_end_to_end(tmp_path):
     from distribuuuu_tpu import trainer
 
     _tiny_cfg(tmp_path)
+    # profiler capture rides along: trace steps [1, 3) of epoch 0
+    cfg.PROF.ENABLED = True
+    cfg.PROF.START_STEP = 1
+    cfg.PROF.NUM_STEPS = 2
     best = trainer.train_model()
     # dummy labels are constant → the model should overfit immediately
     assert best > 50.0
@@ -43,6 +47,9 @@ def test_train_model_end_to_end(tmp_path):
     assert os.path.isdir(os.path.join(str(tmp_path), "checkpoints", "ckpt_ep_000"))
     # best checkpoint written
     assert os.path.isdir(os.path.join(str(tmp_path), "checkpoints", "best"))
+    # profiler trace captured (jax.profiler writes plugins/profile/<ts>/*)
+    prof_dir = os.path.join(str(tmp_path), "profile")
+    assert os.path.isdir(prof_dir) and any(os.scandir(prof_dir))
 
 
 def test_auto_resume_continues_from_last(tmp_path):
