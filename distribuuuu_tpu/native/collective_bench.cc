@@ -1,0 +1,334 @@
+// Native collective micro-benchmark against the TPU runtime's PJRT C API —
+// the nccl-tests analogue for ICI/DCN (the reference's NCCL role is described
+// in SURVEY.md §2.2; this tool measures what those collectives cost here).
+//
+// Talks to the accelerator runtime with no Python in the path: dlopens a
+// PJRT plugin (libtpu.so by default), compiles a StableHLO all-reduce across
+// every addressable device, then times chained executions per buffer size and
+// reports latency + algorithm bandwidth, nccl-tests style.
+//
+//   g++ -O2 -std=c++17 collective_bench.cc -o collective_bench -ldl
+//   ./collective_bench --plugin /path/to/libtpu.so --max-mb 64 --iters 50
+//
+// (Build via CMakeLists.txt in this directory. On machines without a TPU the
+// tool reports the plugin error and exits 2 — exercised by tests as the
+// graceful-failure path.)
+
+#include <dlfcn.h>
+#include <getopt.h>
+
+#include <chrono>
+#include <cstdint>
+#include <cstdio>
+#include <cstdlib>
+#include <cstring>
+#include <string>
+#include <vector>
+
+#include "xla/pjrt/c/pjrt_c_api.h"
+
+namespace {
+
+const PJRT_Api* g_api = nullptr;
+
+// Abort with the PJRT error message (frees the error).
+void CheckPjrt(PJRT_Error* err, const char* what) {
+  if (err == nullptr) return;
+  PJRT_Error_Message_Args msg{};
+  msg.struct_size = PJRT_Error_Message_Args_STRUCT_SIZE;
+  msg.error = err;
+  g_api->PJRT_Error_Message(&msg);
+  std::fprintf(stderr, "PJRT error in %s: %.*s\n", what,
+               static_cast<int>(msg.message_size), msg.message);
+  PJRT_Error_Destroy_Args d{};
+  d.struct_size = PJRT_Error_Destroy_Args_STRUCT_SIZE;
+  d.error = err;
+  g_api->PJRT_Error_Destroy(&d);
+  std::exit(1);
+}
+
+void AwaitEvent(PJRT_Event* ev, const char* what) {
+  PJRT_Event_Await_Args aw{};
+  aw.struct_size = PJRT_Event_Await_Args_STRUCT_SIZE;
+  aw.event = ev;
+  CheckPjrt(g_api->PJRT_Event_Await(&aw), what);
+  PJRT_Event_Destroy_Args ed{};
+  ed.struct_size = PJRT_Event_Destroy_Args_STRUCT_SIZE;
+  ed.event = ev;
+  g_api->PJRT_Event_Destroy(&ed);
+}
+
+void DestroyBuffer(PJRT_Buffer* b) {
+  PJRT_Buffer_Destroy_Args d{};
+  d.struct_size = PJRT_Buffer_Destroy_Args_STRUCT_SIZE;
+  d.buffer = b;
+  CheckPjrt(g_api->PJRT_Buffer_Destroy(&d), "Buffer_Destroy");
+}
+
+// ---------------------------------------------------------------------------
+// Minimal protobuf wire-format encoding of xla's CompileOptionsProto:
+//   CompileOptionsProto.executable_build_options = 3 (message)
+//   ExecutableBuildOptionsProto.device_ordinal   = 1 (int64, -1)
+//   ExecutableBuildOptionsProto.num_replicas     = 4 (int64)
+//   ExecutableBuildOptionsProto.num_partitions   = 5 (int64)
+// Field numbers from xla/pjrt/proto/compile_options.pb.h; the wire format is
+// stable by protobuf's compatibility rules, so hand-encoding avoids linking
+// a protobuf runtime into this tool.
+// ---------------------------------------------------------------------------
+
+void PutVarint(std::string* out, uint64_t v) {
+  while (v >= 0x80) {
+    out->push_back(static_cast<char>((v & 0x7f) | 0x80));
+    v >>= 7;
+  }
+  out->push_back(static_cast<char>(v));
+}
+
+std::string EncodeCompileOptions(int64_t num_replicas) {
+  std::string build;  // ExecutableBuildOptionsProto
+  build.push_back(0x08);  // field 1, varint (device_ordinal)
+  PutVarint(&build, static_cast<uint64_t>(int64_t{-1}));
+  build.push_back(0x20);  // field 4, varint (num_replicas)
+  PutVarint(&build, static_cast<uint64_t>(num_replicas));
+  build.push_back(0x28);  // field 5, varint (num_partitions)
+  PutVarint(&build, 1);
+
+  std::string opts;  // CompileOptionsProto
+  opts.push_back(0x1a);  // field 3, length-delimited
+  PutVarint(&opts, build.size());
+  opts += build;
+  return opts;
+}
+
+// StableHLO all-reduce (sum ÷ n, i.e. the framework's pmean) over one
+// replica group [0..n), cross-replica semantics (no channel_handle) —
+// exactly what XLA emits for a mean-allreduce over a mesh axis. The ÷n keeps
+// a ones input at 1.0 through any number of chained iterations, making the
+// end-of-run correctness check exact.
+std::string AllReduceModule(int64_t n, int64_t elems) {
+  std::string groups = "[[";
+  for (int64_t i = 0; i < n; ++i) {
+    groups += std::to_string(i);
+    if (i + 1 < n) groups += ", ";
+  }
+  groups += "]]";
+  const std::string T = "tensor<" + std::to_string(elems) + "xf32>";
+  std::string m;
+  m += "module @allreduce attributes {mhlo.num_replicas = " +
+       std::to_string(n) + " : i32, mhlo.num_partitions = 1 : i32} {\n";
+  m += "  func.func public @main(%arg0: " + T + ") -> " + T + " {\n";
+  m += "    %0 = \"stablehlo.all_reduce\"(%arg0) ({\n";
+  m += "    ^bb0(%a: tensor<f32>, %b: tensor<f32>):\n";
+  m += "      %s = stablehlo.add %a, %b : tensor<f32>\n";
+  m += "      stablehlo.return %s : tensor<f32>\n";
+  m += "    }) {replica_groups = dense<" + groups + "> : tensor<1x" +
+       std::to_string(n) + "xi64>} : (" + T + ") -> " + T + "\n";
+  m += "    %c = stablehlo.constant dense<" + std::to_string(n) +
+       ".0> : " + T + "\n";
+  m += "    %1 = stablehlo.divide %0, %c : " + T + "\n";
+  m += "    func.return %1 : " + T + "\n";
+  m += "  }\n}\n";
+  return m;
+}
+
+struct Options {
+  const char* plugin = "libtpu.so";
+  double min_kb = 4.0;
+  double max_mb = 64.0;
+  int iters = 50;
+};
+
+}  // namespace
+
+int main(int argc, char** argv) {
+  Options opt;
+  static option longopts[] = {
+      {"plugin", required_argument, nullptr, 'p'},
+      {"min-kb", required_argument, nullptr, 'k'},
+      {"max-mb", required_argument, nullptr, 'm'},
+      {"iters", required_argument, nullptr, 'i'},
+      {nullptr, 0, nullptr, 0},
+  };
+  int c;
+  while ((c = getopt_long(argc, argv, "p:k:m:i:", longopts, nullptr)) != -1) {
+    switch (c) {
+      case 'p': opt.plugin = optarg; break;
+      case 'k': opt.min_kb = std::atof(optarg); break;
+      case 'm': opt.max_mb = std::atof(optarg); break;
+      case 'i': opt.iters = std::atoi(optarg); break;
+      default:
+        std::fprintf(stderr,
+                     "usage: %s [--plugin lib] [--min-kb N] [--max-mb N] "
+                     "[--iters N]\n",
+                     argv[0]);
+        return 64;  // EX_USAGE — distinct from the no-TPU exit code 2
+    }
+  }
+
+  void* lib = dlopen(opt.plugin, RTLD_NOW | RTLD_GLOBAL);
+  if (lib == nullptr) {
+    std::fprintf(stderr, "cannot dlopen PJRT plugin '%s': %s\n", opt.plugin,
+                 dlerror());
+    return 2;
+  }
+  using GetPjrtApiFn = const PJRT_Api* (*)();
+  auto get_api =
+      reinterpret_cast<GetPjrtApiFn>(dlsym(lib, "GetPjrtApi"));
+  if (get_api == nullptr) {
+    std::fprintf(stderr, "plugin '%s' exports no GetPjrtApi\n", opt.plugin);
+    return 2;
+  }
+  g_api = get_api();
+
+  PJRT_Plugin_Initialize_Args init{};
+  init.struct_size = PJRT_Plugin_Initialize_Args_STRUCT_SIZE;
+  CheckPjrt(g_api->PJRT_Plugin_Initialize(&init), "Plugin_Initialize");
+
+  PJRT_Client_Create_Args cc{};
+  cc.struct_size = PJRT_Client_Create_Args_STRUCT_SIZE;
+  if (PJRT_Error* err = g_api->PJRT_Client_Create(&cc)) {
+    PJRT_Error_Message_Args msg{};
+    msg.struct_size = PJRT_Error_Message_Args_STRUCT_SIZE;
+    msg.error = err;
+    g_api->PJRT_Error_Message(&msg);
+    std::fprintf(stderr,
+                 "no usable accelerator behind plugin '%s': %.*s\n",
+                 opt.plugin, static_cast<int>(msg.message_size), msg.message);
+    return 2;  // graceful: machine has no TPU attached
+  }
+  PJRT_Client* client = cc.client;
+
+  PJRT_Client_AddressableDevices_Args ad{};
+  ad.struct_size = PJRT_Client_AddressableDevices_Args_STRUCT_SIZE;
+  ad.client = client;
+  CheckPjrt(g_api->PJRT_Client_AddressableDevices(&ad), "AddressableDevices");
+  const int64_t n = static_cast<int64_t>(ad.num_addressable_devices);
+  std::printf("# PJRT plugin %s: %lld addressable device(s)\n", opt.plugin,
+              static_cast<long long>(n));
+  std::printf("# %-12s%14s%14s%14s\n", "op", "size", "time/iter", "algbw GB/s");
+
+  std::string copts = EncodeCompileOptions(n);
+
+  for (double kb = opt.min_kb; kb * 1024 <= opt.max_mb * 1024 * 1024;
+       kb *= 8) {
+    const int64_t elems = std::max<int64_t>(1, static_cast<int64_t>(kb * 1024 / 4));
+    std::string mlir = AllReduceModule(n, elems);
+
+    PJRT_Program prog{};
+    prog.struct_size = PJRT_Program_STRUCT_SIZE;
+    prog.code = mlir.data();
+    prog.code_size = mlir.size();
+    prog.format = "mlir";
+    prog.format_size = 4;
+
+    PJRT_Client_Compile_Args comp{};
+    comp.struct_size = PJRT_Client_Compile_Args_STRUCT_SIZE;
+    comp.client = client;
+    comp.program = &prog;
+    comp.compile_options = copts.data();
+    comp.compile_options_size = copts.size();
+    CheckPjrt(g_api->PJRT_Client_Compile(&comp), "Compile");
+    PJRT_LoadedExecutable* exec = comp.executable;
+
+    // one input buffer per device, value 1.0 everywhere
+    std::vector<float> host(static_cast<size_t>(elems), 1.0f);
+    int64_t dims[1] = {elems};
+    std::vector<PJRT_Buffer*> inputs(n);
+    for (int64_t d = 0; d < n; ++d) {
+      PJRT_Client_BufferFromHostBuffer_Args bh{};
+      bh.struct_size = PJRT_Client_BufferFromHostBuffer_Args_STRUCT_SIZE;
+      bh.client = client;
+      bh.data = host.data();
+      bh.type = PJRT_Buffer_Type_F32;
+      bh.dims = dims;
+      bh.num_dims = 1;
+      bh.host_buffer_semantics =
+          PJRT_HostBufferSemantics_kImmutableUntilTransferCompletes;
+      bh.device = ad.addressable_devices[d];
+      CheckPjrt(g_api->PJRT_Client_BufferFromHostBuffer(&bh),
+                "BufferFromHostBuffer");
+      AwaitEvent(bh.done_with_host_buffer, "host transfer");
+      inputs[d] = bh.buffer;
+    }
+
+    PJRT_ExecuteOptions eopts{};
+    eopts.struct_size = PJRT_ExecuteOptions_STRUCT_SIZE;
+
+    auto run_once = [&](std::vector<PJRT_Buffer*>& bufs, bool fence) {
+      std::vector<PJRT_Buffer*> out(n, nullptr);
+      std::vector<PJRT_Buffer**> out_lists(n);
+      std::vector<PJRT_Buffer* const*> arg_lists(n);
+      for (int64_t d = 0; d < n; ++d) {
+        out_lists[d] = &out[d];
+        arg_lists[d] = &bufs[d];
+      }
+      std::vector<PJRT_Event*> done(fence ? n : 0, nullptr);
+      PJRT_LoadedExecutable_Execute_Args ex{};
+      ex.struct_size = PJRT_LoadedExecutable_Execute_Args_STRUCT_SIZE;
+      ex.executable = exec;
+      ex.options = &eopts;
+      ex.argument_lists = arg_lists.data();
+      ex.num_devices = static_cast<size_t>(n);
+      ex.num_args = 1;
+      ex.output_lists = out_lists.data();
+      ex.device_complete_events = fence ? done.data() : nullptr;
+      CheckPjrt(g_api->PJRT_LoadedExecutable_Execute(&ex), "Execute");
+      for (int64_t d = 0; d < n; ++d) {
+        DestroyBuffer(bufs[d]);
+        bufs[d] = out[d];
+      }
+      for (PJRT_Event* ev : done) AwaitEvent(ev, "execute fence");
+    };
+
+    run_once(inputs, /*fence=*/true);  // warmup + compile-cache touch
+    auto t0 = std::chrono::steady_clock::now();
+    for (int i = 0; i < opt.iters; ++i) {
+      run_once(inputs, /*fence=*/i + 1 == opt.iters);
+    }
+    double dt = std::chrono::duration<double>(
+                    std::chrono::steady_clock::now() - t0)
+                    .count() /
+                opt.iters;
+
+    // correctness: the kernel is mean(allreduce of ones) == 1.0 at every
+    // element after any number of chained iterations
+    PJRT_Buffer_ToHostBuffer_Args th{};
+    th.struct_size = PJRT_Buffer_ToHostBuffer_Args_STRUCT_SIZE;
+    th.src = inputs[0];
+    std::vector<float> back(static_cast<size_t>(elems));
+    th.dst = back.data();
+    th.dst_size = back.size() * sizeof(float);
+    CheckPjrt(g_api->PJRT_Buffer_ToHostBuffer(&th), "ToHostBuffer");
+    AwaitEvent(th.event, "readback");
+    for (int64_t i = 0; i < elems; ++i) {
+      if (back[static_cast<size_t>(i)] < 0.999f ||
+          back[static_cast<size_t>(i)] > 1.001f) {
+        std::fprintf(stderr,
+                     "CORRECTNESS FAILURE: element %lld = %f (want 1.0) — "
+                     "all-reduce result is wrong\n",
+                     static_cast<long long>(i),
+                     back[static_cast<size_t>(i)]);
+        return 1;
+      }
+    }
+
+    double bytes = static_cast<double>(elems) * 4;
+    char label[32];
+    std::snprintf(label, sizeof(label), "%.3fMB", bytes / (1 << 20));
+    std::printf("  %-12s%14s%12.1fus%14.2f\n", "all_reduce", label,
+                dt * 1e6, bytes / dt / 1e9);
+
+    for (int64_t d = 0; d < n; ++d) DestroyBuffer(inputs[d]);
+    PJRT_LoadedExecutable_Destroy_Args xd{};
+    xd.struct_size = PJRT_LoadedExecutable_Destroy_Args_STRUCT_SIZE;
+    xd.executable = exec;
+    CheckPjrt(g_api->PJRT_LoadedExecutable_Destroy(&xd), "Executable_Destroy");
+  }
+
+  PJRT_Client_Destroy_Args cd{};
+  cd.struct_size = PJRT_Client_Destroy_Args_STRUCT_SIZE;
+  cd.client = client;
+  CheckPjrt(g_api->PJRT_Client_Destroy(&cd), "Client_Destroy");
+  std::printf("# done\n");
+  return 0;
+}

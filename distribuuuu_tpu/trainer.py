@@ -195,6 +195,7 @@ class _ProfilerWindow:
 
     def __init__(self, epoch: int, first_epoch: int):
         self.active = False
+        self.started = False
         self.enabled = (
             cfg.PROF.ENABLED and epoch == first_epoch and mesh_lib.is_primary()
         )
@@ -213,7 +214,7 @@ class _ProfilerWindow:
     def begin(self, it):
         if self.enabled and it == self.first:
             jax.profiler.start_trace(self.trace_dir)
-            self.active = True
+            self.active = self.started = True
 
     def _stop(self, state):
         # drain the async dispatch queue so the trace holds real device work
@@ -227,13 +228,19 @@ class _ProfilerWindow:
             self._stop(state)
 
     def finish(self, state):
-        """Epoch ended before the window did — close the trace anyway."""
+        """Epoch ended before the window did — close the trace anyway, and
+        diagnose a window that never started (START_STEP past the epoch)."""
         if self.active:
             get_logger().warning(
                 "profiler window truncated by epoch end (wanted steps "
                 "[%d, %d))", self.first, self.last,
             )
             self._stop(state)
+        elif self.enabled and not self.started:
+            get_logger().warning(
+                "profiler never started: PROF.START_STEP=%d not reached "
+                "(epoch has fewer batches?) — no trace written", self.first,
+            )
 
 
 def train_epoch(loader, mesh, state, train_step, epoch: int, logger,

@@ -1,0 +1,84 @@
+"""Collective micro-benchmarks: the Python sweep runs real collectives on the
+fake mesh; the native PJRT tool is built from source and must degrade
+gracefully on machines without an attached TPU."""
+
+import os
+import shutil
+import subprocess
+import sys
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def test_python_collective_bench_runs():
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["XLA_FLAGS"] = (
+        env.get("XLA_FLAGS", "") + " --xla_force_host_platform_device_count=8"
+    ).strip()
+    out = subprocess.run(
+        [
+            sys.executable,
+            os.path.join(REPO, "tools", "collective_bench.py"),
+            "--max-mb", "0.002", "--iters", "2", "--ops", "psum,ppermute",
+        ],
+        env=env, capture_output=True, text=True, timeout=400,
+    )
+    assert out.returncode == 0, out.stderr[-2000:]
+    assert "psum" in out.stdout and "ppermute" in out.stdout
+    assert "# done" in out.stdout
+    # ops filter respected
+    assert "all_gather" not in out.stdout
+
+
+@pytest.fixture(scope="module")
+def bench_binary(tmp_path_factory):
+    if shutil.which("g++") is None:
+        pytest.skip("no g++")
+    try:
+        import tensorflow  # noqa: F401  — ships the PJRT C API header
+    except ImportError:
+        pytest.skip("no pjrt_c_api.h source (tensorflow include dir)")
+    import tensorflow
+
+    inc = os.path.join(os.path.dirname(tensorflow.__file__), "include")
+    if not os.path.isfile(os.path.join(inc, "xla", "pjrt", "c", "pjrt_c_api.h")):
+        pytest.skip("pjrt_c_api.h missing from tensorflow include")
+    binary = str(tmp_path_factory.mktemp("native") / "collective_bench")
+    build = subprocess.run(
+        [
+            "g++", "-O1", "-std=c++17",
+            os.path.join(REPO, "distribuuuu_tpu", "native", "collective_bench.cc"),
+            "-o", binary, "-I", inc, "-ldl",
+        ],
+        capture_output=True, text=True, timeout=300,
+    )
+    assert build.returncode == 0, build.stderr[-3000:]
+    return binary
+
+
+def test_native_bench_builds_and_fails_gracefully_without_tpu(bench_binary):
+    """Missing plugin → exit 2 with a clear message (not a crash)."""
+    out = subprocess.run(
+        [bench_binary, "--plugin", "/nonexistent/libtpu.so"],
+        capture_output=True, text=True, timeout=60,
+    )
+    assert out.returncode == 2
+    assert "cannot dlopen" in out.stderr
+
+
+def test_native_bench_rejects_non_pjrt_plugin(bench_binary):
+    """A real .so without GetPjrtApi → exit 2 with a clear message."""
+    import ctypes.util
+
+    libm = ctypes.util.find_library("m")
+    if libm is None:
+        pytest.skip("no libm to use as a decoy")
+    out = subprocess.run(
+        [bench_binary, "--plugin", libm],
+        capture_output=True, text=True, timeout=60,
+    )
+    assert out.returncode == 2
+    assert "GetPjrtApi" in out.stderr

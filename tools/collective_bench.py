@@ -1,0 +1,130 @@
+"""Collective micro-benchmark over the live mesh (the nccl-tests analogue).
+
+Sweeps buffer sizes through the collectives the framework actually uses —
+psum (gradient/metric allreduce), all_gather, ppermute (ring shifts),
+reduce_scatter — over the ``data`` axis of the current device topology, and
+reports per-size latency plus algorithm bandwidth the way NCCL's
+``all_reduce_perf`` does. XLA compiles each collective exactly as it would
+inside a train step, so the numbers reflect the real ICI/DCN path (or the
+host-interconnect on a forced CPU mesh).
+
+Usage:
+    python tools/collective_bench.py [--min-mb 0.001] [--max-mb 64] [--iters 20]
+    # simulated topology:
+    JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8 \
+        python tools/collective_bench.py --max-mb 4
+
+For the native (C-API-level) equivalent that talks to the TPU runtime
+directly, see native/collective_bench.cc.
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import time
+
+import jax
+
+if os.environ.get("JAX_PLATFORMS"):
+    jax.config.update("jax_platforms", os.environ["JAX_PLATFORMS"])
+
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+
+def make_ops(mesh, n):
+    """name → shard_map'd collective taking/returning a sharded buffer."""
+
+    def wrap(fn, out_specs=P("data")):
+        return jax.jit(
+            jax.shard_map(
+                fn, mesh=mesh, in_specs=P("data"), out_specs=out_specs,
+                check_vma=False,
+            )
+        )
+
+    # Each op is written shape-preserving so iterations chain (out feeds in),
+    # which keeps the timed loop free of host dispatch gaps.
+
+    def ag_slice(x):  # full all_gather cost; keep own shard to preserve shape
+        g = jax.lax.all_gather(x, "data", tiled=True)
+        i = jax.lax.axis_index("data")
+        return jax.lax.dynamic_slice_in_dim(g, i * x.shape[0], x.shape[0])
+
+    def rs_ag(x):  # reduce_scatter + all_gather (the allreduce decomposition)
+        s = jax.lax.psum_scatter(x, "data", tiled=True) / n
+        return jax.lax.all_gather(s, "data", tiled=True)
+
+    return {
+        # allreduce: every chip ends with the sum (the DDP-gradient op)
+        "psum": wrap(lambda x: jax.lax.psum(x, "data") / n),
+        # allgather: every chip ends with the concatenation
+        "all_gather": wrap(ag_slice),
+        # ring shift: neighbor exchange (the ring-attention hop)
+        "ppermute": wrap(
+            lambda x: jax.lax.ppermute(
+                x, "data", [(i, (i + 1) % n) for i in range(n)]
+            )
+        ),
+        # reduce_scatter then all_gather (ZeRO-style allreduce split)
+        "rs+ag": wrap(rs_ag),
+    }
+
+
+def bench_one(fn, buf, iters: int) -> float:
+    out = fn(buf)
+    jax.block_until_ready(out)
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        out = fn(out)  # chain so iterations cannot overlap-collapse
+    jax.block_until_ready(out)
+    return (time.perf_counter() - t0) / iters
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--min-mb", type=float, default=0.001)
+    ap.add_argument("--max-mb", type=float, default=64.0)
+    ap.add_argument("--iters", type=int, default=20)
+    ap.add_argument("--ops", default="", help="comma-separated subset to run")
+    args = ap.parse_args()
+
+    devices = jax.devices()
+    n = len(devices)
+    mesh = Mesh(np.asarray(devices), ("data",))
+    shard = NamedSharding(mesh, P("data"))
+    ops = make_ops(mesh, n)
+    if args.ops:
+        want = set(args.ops.split(","))
+        unknown = want - set(ops)
+        if unknown:
+            ap.error(f"unknown ops {sorted(unknown)}; have {sorted(ops)}")
+        ops = {k: v for k, v in ops.items() if k in want}
+    print(
+        f"# devices: {n} × {devices[0].device_kind}  "
+        f"(platform {devices[0].platform})"
+    )
+    print(f"# {'op':<15}{'size':>12}{'time/iter':>14}{'algbw GB/s':>12}")
+
+    size = args.min_mb * 2**20
+    while size <= args.max_mb * 2**20:
+        # f32 elements, divisible by n² (reduce_scatter shards the shard)
+        el = max(n * n, int(size // 4) // (n * n) * (n * n))
+        host = np.ones((el,), np.float32)
+        buf = jax.device_put(host, shard)
+        for name, fn in ops.items():
+            dt = bench_one(fn, buf, args.iters)
+            # algorithm bandwidth, nccl-tests convention: full buffer bytes
+            # divided by time
+            algbw = el * 4 / dt / 1e9
+            label = f"{el * 4 / 2**20:.3f}MB"
+            print(f"  {name:<15}{label:>12}{dt * 1e6:>12.1f}us{algbw:>12.2f}")
+        size *= 8
+
+    print("# done")
+
+
+if __name__ == "__main__":
+    main()
