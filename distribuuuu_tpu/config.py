@@ -253,10 +253,11 @@ _C.DEVICE.ATTN_IMPL = "auto"
 
 _C.MESH = CfgNode()
 # Logical mesh axis sizes; -1 means "all remaining devices" on that axis.
-# Axes: data (DP), model (TP), seq (SP/CP). Pipeline is expressed via stages.
+# Axes: data (DP), model (TP), seq (SP/CP), pipe (PP — parallel/pp.py).
 _C.MESH.DATA = -1
 _C.MESH.MODEL = 1
 _C.MESH.SEQ = 1
+_C.MESH.PIPE = 1
 
 # ------------------------------- data pipeline -------------------------------
 _C.DATA = CfgNode()

@@ -32,19 +32,7 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh, PartitionSpec as P
 
-try:  # jax >= 0.8
-    from jax import shard_map as _shard_map
-except ImportError:  # pragma: no cover - older jax
-    from jax.experimental.shard_map import shard_map as _shard_map
-
-
-def shard_map(fn, *, mesh, in_specs, out_specs):
-    try:  # jax >= 0.8 spells the kwarg check_vma; older spells it check_rep
-        return _shard_map(fn, mesh=mesh, in_specs=in_specs,
-                          out_specs=out_specs, check_vma=False)
-    except TypeError:  # pragma: no cover - older jax
-        return _shard_map(fn, mesh=mesh, in_specs=in_specs,
-                          out_specs=out_specs, check_rep=False)
+from distribuuuu_tpu.parallel.compat import shard_map
 
 _NEG_BIG = -0.7 * float(jnp.finfo(jnp.float32).max)  # safe additive -inf
 

@@ -1,0 +1,26 @@
+"""Version-compat wrapper for ``jax.shard_map`` with manual-collective
+semantics (no varying-axes checking).
+
+One shim for every shard_map user in the framework (ring/Ulysses attention,
+pipeline parallelism, benches): jax >= 0.8 spells the API ``jax.shard_map``
+with ``check_vma``; older releases spell it
+``jax.experimental.shard_map.shard_map`` with ``check_rep``. All call sites
+here want the classic per-device semantics where collectives are written
+explicitly, so the check is always disabled.
+"""
+
+from __future__ import annotations
+
+try:  # jax >= 0.8
+    from jax import shard_map as _shard_map
+except ImportError:  # pragma: no cover - older jax
+    from jax.experimental.shard_map import shard_map as _shard_map
+
+
+def shard_map(fn, *, mesh, in_specs, out_specs):
+    try:  # jax >= 0.8 spells the kwarg check_vma; older spells it check_rep
+        return _shard_map(fn, mesh=mesh, in_specs=in_specs,
+                          out_specs=out_specs, check_vma=False)
+    except TypeError:  # pragma: no cover - older jax
+        return _shard_map(fn, mesh=mesh, in_specs=in_specs,
+                          out_specs=out_specs, check_rep=False)

@@ -29,7 +29,7 @@ from jax.sharding import Mesh
 _initialized = False
 _DEFAULT_COORD_PORT = 29566  # matches the reference's default port (utils.py:35)
 
-MESH_AXES = ("data", "model", "seq")
+MESH_AXES = ("data", "model", "seq", "pipe")
 
 
 def _slurm_env():
@@ -131,9 +131,9 @@ def is_primary() -> bool:
 
 
 def build_mesh(
-    data: int = -1, model: int = 1, seq: int = 1, devices=None
+    data: int = -1, model: int = 1, seq: int = 1, pipe: int = 1, devices=None
 ) -> Mesh:
-    """Build the global device mesh with axes ``(data, model, seq)``.
+    """Build the global device mesh with axes ``(data, model, seq, pipe)``.
 
     ``-1`` on exactly one axis means "all remaining devices". The total must
     divide the device count evenly. With defaults this is pure data
@@ -141,7 +141,7 @@ def build_mesh(
     """
     devices = jax.devices() if devices is None else devices
     n = len(devices)
-    sizes = [data, model, seq]
+    sizes = [data, model, seq, pipe]
     n_auto = sum(1 for s in sizes if s == -1)
     if n_auto > 1:
         raise ValueError(f"At most one mesh axis may be -1, got {sizes}")
@@ -163,5 +163,9 @@ def build_mesh(
 def mesh_from_cfg(cfg, devices=None) -> Mesh:
     """Build the mesh described by ``cfg.MESH``."""
     return build_mesh(
-        data=cfg.MESH.DATA, model=cfg.MESH.MODEL, seq=cfg.MESH.SEQ, devices=devices
+        data=cfg.MESH.DATA,
+        model=cfg.MESH.MODEL,
+        seq=cfg.MESH.SEQ,
+        pipe=cfg.MESH.PIPE,
+        devices=devices,
     )

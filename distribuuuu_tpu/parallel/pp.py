@@ -1,0 +1,168 @@
+"""Pipeline parallelism: GPipe-style microbatch schedule over the ``pipe``
+mesh axis.
+
+Beyond the reference's capability set (it is DDP-only, SURVEY.md §2.3) —
+pipeline parallelism is first-class here because multi-host scale is a core
+goal. The design is the TPU-idiomatic SPMD pipeline: every device runs the
+SAME compiled program; stage identity comes from ``lax.axis_index("pipe")``;
+activations hop stage→stage+1 with ``ppermute`` inside one ``lax.scan`` over
+schedule ticks. Differentiating straight through the schedule yields the
+reverse pipeline (autodiff transposes ppermute to the opposite shift and the
+scan to its reverse), so one ``jax.grad`` gives correct pipeline-parallel
+training with no hand-written backward schedule.
+
+Scope: stages must share one parameter structure and one activation shape —
+the repeated-block regime PP is used for in practice (transformer stacks,
+MLP towers). Stage params are a stacked pytree with leading dim S sharded
+over ``pipe``; the heterogeneous-stage case (e.g. a CNN's shrinking
+pyramid) is served by the framework's DP/TP/SP axes instead.
+
+The schedule is plain GPipe (fill, steady state, drain): T = M + S - 1 ticks
+for M microbatches over S stages. Bubble fraction (S-1)/T shrinks as M
+grows; there is no interleaving — keep stages coarse.
+"""
+
+from __future__ import annotations
+
+from typing import Callable
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from distribuuuu_tpu.parallel.compat import shard_map
+
+
+def stack_stage_params(param_list):
+    """Stack per-stage param pytrees (same structure) into one pytree with a
+    leading stage dim — shard that dim over ``pipe``."""
+    return jax.tree.map(lambda *xs: jnp.stack(xs), *param_list)
+
+
+def stage_params_sharding(mesh, stacked):
+    """NamedSharding pinning the leading (stage) dim to the pipe axis."""
+    return jax.tree.map(
+        lambda x: NamedSharding(
+            mesh, P("pipe", *([None] * (np.ndim(x) - 1)))
+        ),
+        stacked,
+    )
+
+
+def pipeline_apply(
+    stage_fn: Callable,
+    stacked_params,
+    microbatches: jax.Array,
+    *,
+    axis: str = "pipe",
+):
+    """Run the GPipe schedule. Call INSIDE shard_map/jit with ``axis`` bound.
+
+    Args:
+      stage_fn: ``(params_for_one_stage, x) -> y`` with ``y.shape == x.shape``
+        (uniform activation contract; see module docstring).
+      stacked_params: per-device slice of the stacked stage params — inside
+        shard_map each device sees leading dim 1: its own stage's params.
+      microbatches: ``[M, mb, ...]`` input microbatches (replicated over the
+        pipe axis; only stage 0 reads them).
+    Returns:
+      ``[M, mb, ...]`` outputs of the LAST stage, valid on every device
+      (broadcast via psum so the loss can be computed anywhere).
+    """
+    S = jax.lax.axis_size(axis)
+    s = jax.lax.axis_index(axis)
+    M = microbatches.shape[0]
+    T = M + S - 1
+    my_params = jax.tree.map(lambda x: x[0], stacked_params)
+    mb_shape = microbatches.shape[1:]
+
+    perm = [(i, (i + 1) % S) for i in range(S)]  # stage i → i+1 ring
+
+    def tick(carry, t):
+        incoming, outputs = carry
+        # stage 0 consumes microbatch t (clamped into range during drain);
+        # other stages consume what arrived from the previous stage
+        mb_idx = jnp.clip(t, 0, M - 1)
+        x0 = jax.lax.dynamic_index_in_dim(
+            microbatches, mb_idx, axis=0, keepdims=False
+        )
+        x = jnp.where(s == 0, x0, incoming)
+        y = stage_fn(my_params, x)
+        # the last stage finished microbatch t-(S-1) at this tick
+        out_idx = t - (S - 1)
+        valid = jnp.logical_and(s == S - 1, out_idx >= 0)
+        outputs = jax.lax.cond(
+            valid,
+            lambda o: jax.lax.dynamic_update_index_in_dim(
+                o, y, jnp.clip(out_idx, 0, M - 1), axis=0
+            ),
+            lambda o: o,
+            outputs,
+        )
+        # hop to the next stage (the wrap S-1 → 0 carries garbage that stage
+        # 0 never reads — it always selects the microbatch path)
+        incoming = jax.lax.ppermute(y, axis, perm)
+        return (incoming, outputs), None
+
+    init = (
+        jnp.zeros(mb_shape, microbatches.dtype),
+        jnp.zeros((M,) + mb_shape, microbatches.dtype),
+    )
+    (_, outputs), _ = jax.lax.scan(tick, init, jnp.arange(T))
+
+    # broadcast last-stage outputs to every pipe rank so downstream loss /
+    # metrics code is position-independent
+    outputs = jnp.where(s == S - 1, outputs, jnp.zeros_like(outputs))
+    return jax.lax.psum(outputs, axis)
+
+
+def pipelined(
+    stage_fn: Callable,
+    *,
+    mesh,
+    num_microbatches: int,
+    axis: str = "pipe",
+    data_axis: str | None = "data",
+):
+    """Wrap ``stage_fn`` into ``fn(stacked_params, batch) -> outputs`` that
+    runs the pipeline over ``mesh`` under jit (shard_map inside).
+
+    ``batch`` is ``[B, ...]`` (global); it is split into ``num_microbatches``
+    equal microbatches. When ``data_axis`` is present in the mesh the batch
+    dim is additionally sharded over it (PP × DP composition).
+    """
+    S = mesh.shape[axis]
+    M = num_microbatches
+
+    def per_device(stacked_params, batch):
+        mb = batch.reshape((M, batch.shape[0] // M) + batch.shape[1:])
+        return pipeline_apply(stage_fn, stacked_params, mb, axis=axis)
+
+    data_sharded = bool(data_axis) and mesh.shape.get(data_axis, 1) > 1
+    batch_spec = P(data_axis) if data_sharded else P()
+    # per-device output is [M, mb, ...]: microbatch index replicated, the
+    # per-microbatch batch dim sharded over data (when present)
+    out_spec = P(None, data_axis) if data_sharded else P()
+
+    fn = shard_map(
+        per_device,
+        mesh=mesh,
+        in_specs=(P(axis), batch_spec),
+        out_specs=out_spec,
+    )
+
+    def apply(stacked_params, batch):
+        out = fn(stacked_params, batch)  # [M, mb_global, ...]
+        if data_sharded:
+            # each data shard microbatched its OWN contiguous slice of the
+            # batch, so the gathered dim 1 is [dp × mb]; restore the original
+            # row order (shard-major) before flattening
+            dp = mesh.shape[data_axis]
+            out = out.reshape((M, dp, -1) + out.shape[2:])
+            out = jnp.moveaxis(out, 1, 0)
+        return out.reshape((-1,) + out.shape[out.ndim - (batch.ndim - 1):])
+
+    apply.num_stages = S
+    apply.num_microbatches = M
+    return apply

@@ -57,6 +57,19 @@ class TrainState:
     key: Any  # base PRNG key (not checkpointed; re-derived from RNG_SEED)
 
 
+def check_trainer_mesh():
+    """The CNN trainer shards over data/model/seq; a pipe axis would be
+    silently replicated by GSPMD (N× redundant work) — refuse instead.
+    Pipeline parallelism is the parallel/pp.py API for repeated-block
+    workloads."""
+    if cfg.MESH.PIPE not in (0, 1):
+        raise ValueError(
+            f"MESH.PIPE={cfg.MESH.PIPE}: the classification trainer does not "
+            "pipeline CNN stages; use MESH.DATA/MODEL/SEQ here, and "
+            "parallel.pp.pipelined for pipeline-parallel workloads"
+        )
+
+
 def build_model_from_cfg():
     """Build the configured arch (≙ models.build_model + timm fallback,
     ref: trainer.py:117-128 — the zoo here is closed, no fallback needed)."""
@@ -392,6 +405,7 @@ def train_model():
     mesh_lib.apply_backend_flags(cfg.DEVICE.DETERMINISTIC or cfg.CUDNN.DETERMINISTIC)
     mesh_lib.apply_platform(cfg.DEVICE.PLATFORM)
     mesh_lib.setup_distributed()
+    check_trainer_mesh()
     setup_env()
     logger = setup_logger()
     mesh = mesh_lib.mesh_from_cfg(cfg)
@@ -454,6 +468,7 @@ def test_model():
     mesh_lib.apply_backend_flags(cfg.DEVICE.DETERMINISTIC or cfg.CUDNN.DETERMINISTIC)
     mesh_lib.apply_platform(cfg.DEVICE.PLATFORM)
     mesh_lib.setup_distributed()
+    check_trainer_mesh()
     logger = setup_logger()
     mesh = mesh_lib.mesh_from_cfg(cfg)
     model = build_model_from_cfg()

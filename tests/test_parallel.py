@@ -25,14 +25,16 @@ def test_virtual_mesh_has_8_devices():
 
 def test_build_mesh_default_all_data():
     mesh = build_mesh()
-    assert mesh.shape == {"data": 8, "model": 1, "seq": 1}
+    assert mesh.shape == {"data": 8, "model": 1, "seq": 1, "pipe": 1}
 
 
 def test_build_mesh_2d():
     mesh = build_mesh(data=-1, model=2)
-    assert mesh.shape == {"data": 4, "model": 2, "seq": 1}
+    assert mesh.shape == {"data": 4, "model": 2, "seq": 1, "pipe": 1}
     mesh = build_mesh(data=2, model=2, seq=2)
-    assert mesh.shape == {"data": 2, "model": 2, "seq": 2}
+    assert mesh.shape == {"data": 2, "model": 2, "seq": 2, "pipe": 1}
+    mesh = build_mesh(data=1, model=1, seq=1, pipe=8)
+    assert mesh.shape == {"data": 1, "model": 1, "seq": 1, "pipe": 8}
 
 
 def test_build_mesh_rejects_bad_sizes():

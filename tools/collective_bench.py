@@ -26,6 +26,9 @@ import time
 
 import jax
 
+# NOT redundant with jax's own env handling: sitecustomize hooks (e.g.
+# tunneled-TPU dev machines) pin jax_platforms via jax.config, which beats
+# the env var — re-assert the user's choice.
 if os.environ.get("JAX_PLATFORMS"):
     jax.config.update("jax_platforms", os.environ["JAX_PLATFORMS"])
 
@@ -33,16 +36,15 @@ import jax.numpy as jnp
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+from distribuuuu_tpu.parallel.compat import shard_map
+
 
 def make_ops(mesh, n):
     """name → shard_map'd collective taking/returning a sharded buffer."""
 
     def wrap(fn, out_specs=P("data")):
         return jax.jit(
-            jax.shard_map(
-                fn, mesh=mesh, in_specs=P("data"), out_specs=out_specs,
-                check_vma=False,
-            )
+            shard_map(fn, mesh=mesh, in_specs=P("data"), out_specs=out_specs)
         )
 
     # Each op is written shape-preserving so iterations chain (out feeds in),
