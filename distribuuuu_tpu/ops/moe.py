@@ -1,0 +1,239 @@
+"""Expert parallelism: mixture-of-experts FFN over a mesh axis.
+
+Beyond the reference's capability set (DDP-only, SURVEY.md §2.3) — expert
+parallelism completes the framework's parallelism matrix (DP/TP/SP/PP/EP)
+because distributed scale is a first-class goal here.
+
+Two execution strategies over the same parameters:
+
+- ``moe_ffn_partial``: every rank runs its LOCAL experts over all tokens and
+  the gate-weighted partial outputs are summed with one ``psum`` over the
+  expert axis. Exact (no token dropping, no capacity), communication = one
+  allreduce of the output — the right choice when tokens-per-expert is dense
+  (small expert counts, top-k close to E).
+- ``moe_ffn_dispatch``: classic switch-style routing. Tokens are dispatched
+  to their top-k experts' ranks with ``all_to_all``, processed by the local
+  experts at a fixed capacity, and combined back. Communication = 2
+  all_to_alls of the routed tokens — the scalable path when E is large and
+  top-k small. Over-capacity tokens are dropped (standard switch semantics),
+  so it matches the exact path only when capacity is ample.
+
+Gating is top-k softmax (renormalized over the selected experts), the
+standard switch/mixtral formulation.
+
+Parameters (functional, like ops/ring_attention.py):
+  gate  [d, E]              (replicated)
+  w_in  [E, d, f], b_in  [E, f]   (sharded over the expert axis, dim 0)
+  w_out [E, f, d], b_out [E, d]   (sharded over the expert axis, dim 0)
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from distribuuuu_tpu.parallel.compat import shard_map
+
+
+def init_moe_params(key, d_model: int, d_ff: int, num_experts: int):
+    """Reference initializer: returns the param dict described above."""
+    k1, k2, k3 = jax.random.split(key, 3)
+    scale_in = 1.0 / np.sqrt(d_model)
+    scale_out = 1.0 / np.sqrt(d_ff)
+    return {
+        "gate": jax.random.normal(k1, (d_model, num_experts), jnp.float32)
+        * scale_in,
+        "w_in": jax.random.normal(k2, (num_experts, d_model, d_ff), jnp.float32)
+        * scale_in,
+        "b_in": jnp.zeros((num_experts, d_ff), jnp.float32),
+        "w_out": jax.random.normal(k3, (num_experts, d_ff, d_model), jnp.float32)
+        * scale_out,
+        "b_out": jnp.zeros((num_experts, d_model), jnp.float32),
+    }
+
+
+def moe_params_sharding(mesh, params, axis: str = "model"):
+    """Expert-dim-0 sharding for the expert tensors; gate replicated."""
+
+    def spec(path_leaf, x):
+        if path_leaf == "gate":
+            return NamedSharding(mesh, P())
+        return NamedSharding(mesh, P(axis, *([None] * (np.ndim(x) - 1))))
+
+    return {k: spec(k, v) for k, v in params.items()}
+
+
+def top_k_gating(x, gate_w, top_k: int):
+    """Softmax-renormalized top-k gate.
+
+    Returns (weights [T, k] f32, indices [T, k] i32).
+    """
+    logits = x.astype(jnp.float32) @ gate_w.astype(jnp.float32)  # [T, E]
+    gates = jax.nn.softmax(logits, axis=-1)
+    weights, indices = jax.lax.top_k(gates, top_k)
+    weights = weights / jnp.maximum(
+        weights.sum(axis=-1, keepdims=True), 1e-9
+    )
+    return weights, indices.astype(jnp.int32)
+
+
+def _expert_ffn(w_in, b_in, w_out, b_out, x):
+    """One expert's FFN on [T, d] tokens: gelu(x@w_in+b)@w_out+b."""
+    h = jax.nn.gelu(x @ w_in.astype(x.dtype) + b_in.astype(x.dtype))
+    return h @ w_out.astype(x.dtype) + b_out.astype(x.dtype)
+
+
+def moe_ffn_reference(params, x, top_k: int = 2):
+    """Dense single-device reference: loop over ALL experts, weighted sum.
+    The oracle the parallel paths are tested against."""
+    T = x.shape[0]
+    weights, indices = top_k_gating(x, params["gate"], top_k)
+    E = params["gate"].shape[-1]
+    out = jnp.zeros_like(x)
+    for e in range(E):
+        y = _expert_ffn(
+            params["w_in"][e], params["b_in"][e],
+            params["w_out"][e], params["b_out"][e], x,
+        )
+        # weight of expert e for each token (0 when not in its top-k)
+        w_e = (weights * (indices == e)).sum(axis=-1)  # [T]
+        out = out + y * w_e[:, None].astype(x.dtype)
+    return out
+
+
+def moe_ffn_partial(params, x, *, mesh, axis: str = "model", top_k: int = 2):
+    """Exact expert-parallel MoE: local experts over all tokens + one psum.
+
+    ``x``: [T, d] tokens (replicated over ``axis``; shard T over ``data``
+    outside if desired). Expert params sharded over ``axis`` dim 0.
+    """
+    n = mesh.shape[axis]
+    E = params["gate"].shape[-1]
+    assert E % n == 0, f"num_experts {E} must divide expert-axis size {n}"
+
+    def per_rank(params, x):
+        r = jax.lax.axis_index(axis)
+        local_E = params["w_in"].shape[0]  # E / n
+        weights, indices = top_k_gating(x, params["gate"], top_k)
+        out = jnp.zeros_like(x)
+        for le in range(local_E):
+            ge = r * local_E + le  # global expert id
+            y = _expert_ffn(
+                params["w_in"][le], params["b_in"][le],
+                params["w_out"][le], params["b_out"][le], x,
+            )
+            w_e = (weights * (indices == ge)).sum(axis=-1)
+            out = out + y * w_e[:, None].astype(x.dtype)
+        return jax.lax.psum(out, axis)
+
+    return shard_map(
+        per_rank,
+        mesh=mesh,
+        in_specs=(
+            {
+                "gate": P(),
+                "w_in": P(axis), "b_in": P(axis),
+                "w_out": P(axis), "b_out": P(axis),
+            },
+            P(),
+        ),
+        out_specs=P(),
+    )(params, x)
+
+
+def moe_ffn_dispatch(
+    params,
+    x,
+    *,
+    mesh,
+    axis: str = "model",
+    top_k: int = 2,
+    capacity_factor: float = 2.0,
+):
+    """Switch-style routed MoE: all_to_all dispatch → local experts → return.
+
+    Tokens are SHARDED over ``axis`` (each rank routes its own T/n tokens),
+    experts are sharded over the same axis — the DeepSpeed-MoE layout where
+    the expert group doubles as the token group. Per (token, k) assignment
+    the token rides an ``all_to_all`` to the rank owning that expert; each
+    expert processes at most C = ceil(T_local·k/E × capacity_factor) slots
+    per source rank (assignments beyond C are dropped — standard switch
+    semantics). Matches ``moe_ffn_partial`` exactly when nothing drops.
+    """
+    n = mesh.shape[axis]
+    E = params["gate"].shape[-1]
+    assert E % n == 0, f"expert-axis size {n} must divide num_experts {E}"
+    local_E = E // n
+    T = x.shape[0]
+    assert T % n == 0, f"expert-axis size {n} must divide token count {T}"
+    C = max(1, int(np.ceil(T // n * top_k / E * capacity_factor)))
+
+    def per_rank(params, x):
+        # x: [T_local, d] — this rank's token shard
+        T_local, d = x.shape
+        weights, indices = top_k_gating(x, params["gate"], top_k)  # [Tl,k]
+        flat_e = indices.reshape(-1)          # [Tl*k] global expert ids
+        flat_w = weights.reshape(-1)          # [Tl*k]
+        flat_tok = jnp.repeat(jnp.arange(T_local), top_k)
+
+        # slot of each assignment within its expert's per-source capacity
+        one_hot = jax.nn.one_hot(flat_e, E, dtype=jnp.int32)      # [Tl*k, E]
+        pos_in_e = jnp.cumsum(one_hot, axis=0) * one_hot - 1      # [Tl*k, E]
+        pos = pos_in_e.max(axis=-1)                               # [Tl*k]
+        keep = pos < C
+
+        # dispatch buffer [E, C, d]: my tokens, slotted per target expert
+        disp = jnp.zeros((E, C, d), x.dtype)
+        disp = disp.at[
+            jnp.where(keep, flat_e, 0),
+            jnp.where(keep, pos, 0),
+        ].add(jnp.where(keep[:, None], x[flat_tok], 0), mode="drop")
+
+        # all_to_all #1: chunk p (= experts owned by rank p) goes to rank p;
+        # I receive, from every source rank s, the slots for MY experts.
+        disp = disp.reshape(n, local_E, C, d)
+        recv = jax.lax.all_to_all(disp, axis, split_axis=0, concat_axis=0)
+        # recv: [n, local_E, C, d], recv[s, le] = rank s's tokens for my
+        # local expert le → flatten source into the slot dim per expert
+        recv = jnp.moveaxis(recv, 0, 1).reshape(local_E, n * C, d)
+
+        # local expert compute
+        y = jnp.stack(
+            [
+                _expert_ffn(
+                    params["w_in"][le], params["b_in"][le],
+                    params["w_out"][le], params["b_out"][le], recv[le],
+                )
+                for le in range(local_E)
+            ]
+        )  # [local_E, n*C, d]
+
+        # all_to_all #2 (return trip): chunk s goes back to source rank s
+        y = jnp.moveaxis(y.reshape(local_E, n, C, d), 1, 0)  # [n, local_E, C, d]
+        back = jax.lax.all_to_all(y, axis, split_axis=0, concat_axis=0)
+        # back: [n, local_E, C, d], back[p, le] = output of global expert
+        # (p*local_E + le) for MY tokens' slots → [E, C, d]
+        back = back.reshape(E, C, d)
+
+        # combine: weighted gather of each kept assignment's output
+        gathered = back[
+            jnp.where(keep, flat_e, 0), jnp.where(keep, pos, 0)
+        ]  # [Tl*k, d]
+        contrib = gathered * jnp.where(keep, flat_w, 0.0)[:, None].astype(x.dtype)
+        return jnp.zeros_like(x).at[flat_tok].add(contrib)
+
+    return shard_map(
+        per_rank,
+        mesh=mesh,
+        in_specs=(
+            {
+                "gate": P(),
+                "w_in": P(axis), "b_in": P(axis),
+                "w_out": P(axis), "b_out": P(axis),
+            },
+            P(axis),
+        ),
+        out_specs=P(axis),
+    )(params, x)
