@@ -111,7 +111,7 @@ def moe_ffn_partial(params, x, *, mesh, axis: str = "model", top_k: int = 2):
     """
     n = mesh.shape[axis]
     E = params["gate"].shape[-1]
-    assert E % n == 0, f"num_experts {E} must divide expert-axis size {n}"
+    assert E % n == 0, f"expert-axis size {n} must divide num_experts {E}"
 
     def per_rank(params, x):
         r = jax.lax.axis_index(axis)

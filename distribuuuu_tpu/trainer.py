@@ -42,7 +42,7 @@ from distribuuuu_tpu.parallel import (
 from distribuuuu_tpu.utils import checkpoint as ckpt
 from distribuuuu_tpu.utils.logger import get_logger, setup_logger
 from distribuuuu_tpu.utils.meters import construct_meters
-from distribuuuu_tpu.utils.metrics import accuracy, cross_entropy
+from distribuuuu_tpu.utils.metrics import accuracy, count_parameters, cross_entropy
 from distribuuuu_tpu.utils.optim import construct_optimizer, set_lr
 from distribuuuu_tpu.utils.schedules import get_epoch_lr
 from distribuuuu_tpu.utils.seed import setup_env, setup_seed
@@ -413,10 +413,10 @@ def train_model():
 
     model = build_model_from_cfg()
     state = create_train_state(model, key, mesh, cfg.TRAIN.IM_SIZE)
-    n_params = sum(x.size for x in jax.tree.leaves(state.params))
+    m_params, mb = count_parameters(state.params)
     logger.info(
         "model %s: %.3fM params (%.2f MB fp32), mesh %s",
-        cfg.MODEL.ARCH, n_params / 1e6, n_params * 4 / 2**20, dict(mesh.shape),
+        cfg.MODEL.ARCH, m_params, mb, dict(mesh.shape),
     )
 
     optimizer = construct_optimizer()

@@ -12,6 +12,7 @@ from __future__ import annotations
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 
 
 def accuracy(logits, targets, topk=(1,)):
@@ -43,3 +44,9 @@ def cross_entropy(logits, targets):
     logp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
     nll = -jnp.take_along_axis(logp, targets[:, None], axis=-1)[:, 0]
     return nll.mean()
+
+
+def count_parameters(params):
+    """(params in millions, fp32 megabytes) — ref: utils.py:353-357."""
+    n = sum(int(np.prod(np.shape(x))) for x in jax.tree.leaves(params))
+    return n / 1e6, n * 4 / 2**20

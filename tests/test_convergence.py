@@ -1,0 +1,59 @@
+"""Convergence smoke: the compiled train step actually LEARNS.
+
+The e2e tests use the dummy dataset (constant label 0), which a model can
+satisfy through the classifier bias alone. Here labels are a nontrivial
+deterministic function of the pixels, so loss can only fall if real feature
+learning happens — the offline stand-in for the reference's embedded
+convergence transcripts (ref: tutorial/snsc.py:92-111, SURVEY.md §6).
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+import distribuuuu_tpu.config as config
+from distribuuuu_tpu.config import cfg
+from distribuuuu_tpu import trainer
+from distribuuuu_tpu.parallel import mesh as mesh_lib, sharding as sharding_lib
+from distribuuuu_tpu.utils.optim import construct_optimizer
+
+
+def synthetic_batch(rng, n):
+    images = rng.standard_normal((n, 32, 32, 3)).astype(np.float32)
+    labels = ((images.mean(axis=(1, 2, 3)) * 40.0).astype(np.int64) % 10).astype(
+        np.int32
+    )
+    images += labels[:, None, None, None] * 0.1
+    return {
+        "image": images,
+        "label": labels,
+        "mask": np.ones((n,), np.float32),
+    }
+
+
+def test_train_step_learns_nontrivial_labels():
+    config.reset_cfg()
+    cfg.MODEL.ARCH = "resnet18"
+    cfg.MODEL.NUM_CLASSES = 10
+    cfg.OPTIM.BASE_LR = 0.05
+    cfg.DEVICE.COMPUTE_DTYPE = "float32"
+    cfg.RNG_SEED = 0
+
+    mesh = mesh_lib.build_mesh()
+    model = trainer.build_model_from_cfg()
+    state = trainer.create_train_state(model, jax.random.key(0), mesh, 32)
+    step = trainer.make_train_step(model, construct_optimizer(), topk=5)
+
+    rng = np.random.default_rng(0)
+    losses = []
+    for _ in range(40):
+        batch = sharding_lib.shard_batch(mesh, synthetic_batch(rng, 64))
+        state, metrics = step(state, batch)
+        losses.append(float(metrics["loss"]))
+
+    start = np.mean(losses[:5])
+    end = np.mean(losses[-5:])
+    # chance is ln(10) ≈ 2.30; real learning must at least halve the loss
+    assert start > 1.5, f"unexpectedly easy start: {losses[:5]}"
+    assert end < start * 0.5, f"no learning: start {start:.3f} → end {end:.3f}"
+    assert np.isfinite(losses).all()

@@ -51,33 +51,48 @@ print(f"WORKER_RESULT rank={jax.process_index()} nproc={jax.process_count()} "
 """
 
 
+def _free_port() -> int:
+    import socket
+
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
 @pytest.mark.slow
 def test_two_process_training(tmp_path):
     out_dir = str(tmp_path / "run")
     script = tmp_path / "worker.py"
     script.write_text(WORKER)
+    port = _free_port()  # avoid collisions with concurrent runs
 
-    procs = []
+    # Worker output goes to files, not pipes: a full 64KB pipe would block a
+    # rank mid-collective and deadlock the pair.
+    procs, logs = [], []
     for rank in range(2):
         env = dict(os.environ)
         env.pop("JAX_PLATFORMS", None)
         env.update(
             MASTER_ADDR="127.0.0.1",
-            COORDINATOR_PORT="29641",
+            COORDINATOR_PORT=str(port),
             WORLD_SIZE="2",
             RANK=str(rank),
         )
+        log = open(tmp_path / f"rank{rank}.log", "w+")
+        logs.append(log)
         procs.append(
             subprocess.Popen(
                 [sys.executable, str(script), out_dir],
-                env=env, stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+                env=env, stdout=log, stderr=subprocess.STDOUT,
                 text=True, cwd=REPO,
             )
         )
     outs = []
-    for p in procs:
-        out, _ = p.communicate(timeout=900)
-        outs.append(out)
+    for p, log in zip(procs, logs):
+        p.wait(timeout=900)
+        log.seek(0)
+        outs.append(log.read())
+        log.close()
     for rank, (p, out) in enumerate(zip(procs, outs)):
         assert p.returncode == 0, f"rank {rank} failed:\n{out[-3000:]}"
 
