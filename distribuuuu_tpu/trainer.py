@@ -298,9 +298,13 @@ def train_epoch(loader, mesh, state, train_step, epoch: int, logger,
 
 def validate(loader, mesh, state, eval_step, epoch: int, logger):
     """Full evaluation pass; returns (top1, topk) percentages
-    (ref: trainer.py:67-103)."""
+    (ref: trainer.py:67-103). Per-batch progress at TEST.PRINT_FREQ
+    (≙ ref validate's meter display, trainer.py:91-95) — totals stay on
+    device between prints so batches dispatch asynchronously."""
     totals = None
-    for host_batch in loader:
+    num_batches = len(loader)
+    end = time.perf_counter()
+    for it, host_batch in enumerate(loader):
         batch = sharding_lib.shard_batch(mesh, host_batch)
         m = eval_step(state, batch)
         totals = (
@@ -308,6 +312,19 @@ def validate(loader, mesh, state, eval_step, epoch: int, logger):
             if totals is None
             else jax.tree.map(jnp.add, totals, m)
         )
+        if (it + 1) % cfg.TEST.PRINT_FREQ == 0 and mesh_lib.is_primary():
+            # fetch first (blocks on all queued eval work), then time the
+            # window so device compute is attributed to it
+            acc1_so_far = (
+                float(totals["correct1"]) / max(float(totals["count"]), 1.0) * 100.0
+            )
+            window = time.perf_counter() - end
+            logger.info(
+                "Eval[%d][%d/%d]  Time %6.3f (%.3f/batch)  Acc@1 %.3f (so far)",
+                epoch + 1, it + 1, num_batches,
+                window, window / cfg.TEST.PRINT_FREQ, acc1_so_far,
+            )
+            end = time.perf_counter()
     totals = jax.tree.map(float, totals)
     n = max(totals["count"], 1.0)
     top1 = totals["correct1"] / n * 100.0
