@@ -56,6 +56,11 @@ def bench_backend(root: str, backend: str, epochs: int, im_size: int,
         dataset, batch_size=batch_size, shuffle=True, drop_last=True,
         workers=workers, seed=0,
     )
+    if len(loader) == 0:
+        raise SystemExit(
+            f"dataset at {root} has fewer than batch_size={batch_size} images "
+            "per host; nothing to measure (drop_last)"
+        )
     # Warm epoch 0 (thread-pool spin-up, native lib build, page cache), then
     # time WHOLE epochs — background prefetch makes partial-epoch timing
     # meaningless (the first batches are pre-assembled before the clock
@@ -90,6 +95,11 @@ def main():
     if not root:
         tmp = tempfile.TemporaryDirectory(prefix="data_bench_")
         root = tmp.name
+        if args.n_images < args.batch_size:
+            ap.error(
+                f"--n-images {args.n_images} < --batch-size {args.batch_size}: "
+                "drop_last would leave zero full batches to measure"
+            )
         make_corpus(root, args.n_images)
 
     backends = ["pil"] + (["native"] if native.available() else [])
