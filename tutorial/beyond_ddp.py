@@ -69,7 +69,7 @@ def demo_sp():
     )
     out = ra.ring_attention(q, k, v, mesh, data_axis=None, causal=True)
     # dense reference
-    logits = jnp.einsum("bhqd,bhkd->bhqk", q, v * 0 + k) / np.sqrt(D)
+    logits = jnp.einsum("bhqd,bhkd->bhqk", q, k) / np.sqrt(D)
     mask = jnp.tril(jnp.ones((S, S), bool))
     logits = jnp.where(mask, logits, -jnp.inf)
     want = jnp.einsum("bhqk,bhkd->bhqd", jax.nn.softmax(logits, -1), v)
