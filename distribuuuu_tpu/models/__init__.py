@@ -33,6 +33,7 @@ from distribuuuu_tpu.models.regnet import (  # noqa: F401
     regnety_320,
 )
 from distribuuuu_tpu.models.efficientnet import efficientnet_b0  # noqa: F401
+from distribuuuu_tpu.models.vit import vit_small, vit_tiny  # noqa: F401
 
 _REGISTRY = {}
 
@@ -61,6 +62,9 @@ for _fn in (
     regnety_160,
     regnety_320,
     efficientnet_b0,
+    # TPU-native extensions (no reference analogue): seq-parallel-capable ViT
+    vit_tiny,
+    vit_small,
 ):
     register_model(_fn)
 

@@ -1,0 +1,180 @@
+"""Vision Transformer — TPU-native extension (no reference analogue).
+
+The reference zoo is CNNs + one hybrid (BoTNet). ViT is added because it is
+the workload the framework's sequence-parallel machinery exists for: token
+count scales quadratically with resolution, and the attention can run
+**sequence-sharded** — ``attn_impl="ring"`` / ``"ulysses"`` route through
+ops/ring_attention.py over the mesh's ``seq`` axis, so high-resolution /
+long-sequence training distributes without restructuring the model. With
+``attn_impl="xla"`` (default) attention is a dense einsum and the model is a
+standard data/tensor-parallel citizen.
+
+Architecture follows the ViT paper (arXiv:2010.11929) with global average
+pooling instead of a class token (keeps the token count a clean multiple of
+the seq-axis size for sharding; accuracy-equivalent per the paper's
+appendix) and pre-norm blocks.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from distribuuuu_tpu.models.layers import Dense
+
+
+class Mlp(nn.Module):
+    hidden: int
+    out: int
+    dropout: float
+    dtype: Any
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        x = Dense(self.hidden, dtype=self.dtype)(x)
+        x = nn.gelu(x)
+        x = nn.Dropout(self.dropout, deterministic=not train)(x)
+        x = Dense(self.out, dtype=self.dtype)(x)
+        return nn.Dropout(self.dropout, deterministic=not train)(x)
+
+
+class Attention(nn.Module):
+    dim: int
+    num_heads: int
+    dropout: float
+    dtype: Any
+    attn_impl: str = "xla"  # "xla" | "ring" | "ulysses"
+    mesh: Any = None        # required for ring/ulysses
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        if self.attn_impl not in ("xla", "ring", "ulysses"):
+            raise ValueError(
+                f"vit attn_impl must be 'xla', 'ring', or 'ulysses'; "
+                f"got {self.attn_impl!r}"
+            )
+        if self.attn_impl != "xla" and self.dropout > 0:
+            raise ValueError(
+                "attention-probability dropout is not supported under "
+                "sequence-sharded attention (ring/ulysses); set dropout=0 or "
+                "use attn_impl='xla'"
+            )
+        B, S, _ = x.shape
+        H = self.num_heads
+        D = self.dim // H
+        qkv = Dense(3 * self.dim, dtype=self.dtype)(x)
+        qkv = qkv.reshape(B, S, 3, H, D).transpose(2, 0, 3, 1, 4)  # [3,B,H,S,D]
+        q, k, v = qkv[0], qkv[1], qkv[2]
+
+        if self.attn_impl in ("ring", "ulysses"):
+            from distribuuuu_tpu.ops import ring_attention as ra
+
+            assert self.mesh is not None, "seq-parallel attention needs a mesh"
+            fn = (
+                ra.ring_attention
+                if self.attn_impl == "ring"
+                else ra.ulysses_attention
+            )
+            out = fn(q, k, v, self.mesh, causal=False)
+        else:
+            scale = D ** -0.5
+            s = jnp.einsum(
+                "bhqd,bhkd->bhqk",
+                q.astype(jnp.float32), k.astype(jnp.float32),
+            ) * scale
+            w = jax.nn.softmax(s, axis=-1)
+            w = nn.Dropout(self.dropout, deterministic=not train)(w)
+            out = jnp.einsum("bhqk,bhkd->bhqd", w, v.astype(jnp.float32))
+
+        out = out.astype(self.dtype).transpose(0, 2, 1, 3).reshape(B, S, self.dim)
+        out = Dense(self.dim, dtype=self.dtype)(out)
+        return nn.Dropout(self.dropout, deterministic=not train)(out)
+
+
+class Block(nn.Module):
+    dim: int
+    num_heads: int
+    mlp_ratio: float
+    dropout: float
+    dtype: Any
+    attn_impl: str
+    mesh: Any
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        y = nn.LayerNorm(dtype=self.dtype, param_dtype=jnp.float32)(x)
+        x = x + Attention(
+            self.dim, self.num_heads, self.dropout, self.dtype,
+            self.attn_impl, self.mesh,
+        )(y, train=train)
+        y = nn.LayerNorm(dtype=self.dtype, param_dtype=jnp.float32)(x)
+        x = x + Mlp(
+            int(self.dim * self.mlp_ratio), self.dim, self.dropout, self.dtype
+        )(y, train=train)
+        return x
+
+
+class ViT(nn.Module):
+    """Patch embed → pre-norm transformer blocks → LN → GAP → head."""
+
+    num_classes: int = 1000
+    patch: int = 16
+    dim: int = 192
+    depth: int = 12
+    num_heads: int = 3
+    mlp_ratio: float = 4.0
+    dropout: float = 0.0
+    dtype: Any = jnp.bfloat16
+    attn_impl: str = "xla"
+    mesh: Any = None
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        B, H, W, _ = x.shape
+        assert H % self.patch == 0 and W % self.patch == 0, (
+            f"input {H}x{W} not divisible by patch {self.patch}"
+        )
+        x = x.astype(self.dtype)
+        x = nn.Conv(
+            self.dim, (self.patch, self.patch), strides=self.patch,
+            dtype=self.dtype, param_dtype=jnp.float32,
+        )(x)
+        S = (H // self.patch) * (W // self.patch)
+        x = x.reshape(B, S, self.dim)
+        pos = self.param(
+            "pos_embed",
+            nn.initializers.normal(0.02),
+            (1, S, self.dim), jnp.float32,
+        )
+        x = x + pos.astype(self.dtype)
+        x = nn.Dropout(self.dropout, deterministic=not train)(x)
+        for _ in range(self.depth):
+            x = Block(
+                self.dim, self.num_heads, self.mlp_ratio, self.dropout,
+                self.dtype, self.attn_impl, self.mesh,
+            )(x, train=train)
+        x = nn.LayerNorm(dtype=self.dtype, param_dtype=jnp.float32)(x)
+        x = x.mean(axis=1)  # GAP over tokens
+        return Dense(self.num_classes, dtype=jnp.float32)(
+            x.astype(jnp.float32)
+        )
+
+
+def vit_tiny(num_classes=1000, **kw):
+    """ViT-Ti/16: 192 dim, 12 blocks, 3 heads (~5.5M params at 1000 cls)."""
+    kw.setdefault("dim", 192)
+    kw.setdefault("depth", 12)
+    kw.setdefault("num_heads", 3)
+    return ViT(num_classes=num_classes, **kw)
+
+
+def vit_small(num_classes=1000, **kw):
+    """ViT-S/16: 384 dim, 12 blocks, 6 heads (~21.7M params at 1000 cls)."""
+    kw.setdefault("dim", 384)
+    kw.setdefault("depth", 12)
+    kw.setdefault("num_heads", 6)
+    return ViT(num_classes=num_classes, **kw)

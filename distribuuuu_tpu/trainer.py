@@ -58,15 +58,20 @@ class TrainState:
 
 
 def check_trainer_mesh():
-    """The CNN trainer shards over data/model/seq; a pipe axis would be
-    silently replicated by GSPMD (N× redundant work) — refuse instead.
-    Pipeline parallelism is the parallel/pp.py API for repeated-block
-    workloads."""
+    """Refuse mesh axes the configured arch cannot use — GSPMD would
+    silently replicate the whole computation over an unused axis (N×
+    redundant work) rather than erroring."""
     if cfg.MESH.PIPE not in (0, 1):
         raise ValueError(
             f"MESH.PIPE={cfg.MESH.PIPE}: the classification trainer does not "
             "pipeline CNN stages; use MESH.DATA/MODEL/SEQ here, and "
             "parallel.pp.pipelined for pipeline-parallel workloads"
+        )
+    if cfg.MESH.SEQ not in (0, 1, -1) and not cfg.MODEL.ARCH.startswith("vit"):
+        raise ValueError(
+            f"MESH.SEQ={cfg.MESH.SEQ}: only the ViT archs route attention "
+            "over the seq axis; CNN archs have no sequence dimension to "
+            "shard (the axis would be silently replicated)"
         )
 
 
@@ -84,6 +89,12 @@ def build_model_from_cfg():
         fmap = max(1, -(-cfg.TRAIN.IM_SIZE // 16))
         kwargs["fmap_size"] = (fmap, fmap)
         kwargs["attn_impl"] = cfg.DEVICE.ATTN_IMPL
+    if cfg.MODEL.ARCH.startswith("vit"):
+        # MESH.SEQ>1 means sequence-sharded attention: route through ring
+        # attention over the seq axis (dense XLA attention otherwise)
+        if cfg.MESH.SEQ not in (0, 1, -1):
+            kwargs["attn_impl"] = "ring"
+            kwargs["mesh"] = mesh_lib.mesh_from_cfg(cfg)
     return models.build_model(cfg.MODEL.ARCH, **kwargs)
 
 
@@ -113,9 +124,10 @@ def create_train_state(model, key, mesh, im_size: int) -> TrainState:
         params = jax.lax.with_sharding_constraint(
             variables["params"], shardings["params"]
         )
+        # stats-free models (e.g. ViT — LayerNorm only) have no batch_stats
+        bs = variables.get("batch_stats", {})
         stats = jax.lax.with_sharding_constraint(
-            variables["batch_stats"],
-            jax.tree.map(lambda _: repl, variables["batch_stats"]),
+            bs, jax.tree.map(lambda _: repl, bs)
         )
         opt_state = tp.constrain_like(
             optimizer.init(params), params, shardings["params"]
@@ -147,7 +159,7 @@ def make_train_step(model, optimizer, topk: int):
                 rngs={"dropout": step_key},
             )
             loss = cross_entropy(logits, batch["label"])
-            return loss, (logits, mutated["batch_stats"])
+            return loss, (logits, mutated.get("batch_stats", {}))
 
         (loss, (logits, new_stats)), grads = jax.value_and_grad(
             loss_fn, has_aux=True

@@ -1,0 +1,53 @@
+"""Smoke tests for the tools/ CLIs (zoo_check, data_bench)."""
+
+import os
+import subprocess
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _run(args, timeout=400):
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["XLA_FLAGS"] = (
+        env.get("XLA_FLAGS", "") + " --xla_force_host_platform_device_count=8"
+    ).strip()
+    return subprocess.run(
+        [sys.executable] + args, env=env, capture_output=True, text=True,
+        timeout=timeout, cwd=REPO,
+    )
+
+
+def test_zoo_check_single_arch():
+    out = _run(
+        ["tools/zoo_check.py", "--arch", "resnet18", "--batch", "2",
+         "--im-size", "32"]
+    )
+    assert out.returncode == 0, out.stdout[-2000:] + out.stderr[-1000:]
+    assert "1/1 archs passed" in out.stdout
+
+
+def test_zoo_check_reports_failure():
+    out = _run(
+        ["tools/zoo_check.py", "--arch", "nosuch_arch", "--batch", "2",
+         "--im-size", "32"]
+    )
+    assert out.returncode == 1
+    assert "FAIL nosuch_arch" in out.stdout
+    assert "0/1 archs passed" in out.stdout
+
+
+def test_data_bench_tiny_corpus():
+    out = _run(
+        ["tools/data_bench.py", "--n-images", "32", "--batch-size", "8",
+         "--epochs", "1", "--im-size", "64", "--workers", "2"]
+    )
+    assert out.returncode == 0, out.stdout[-2000:] + out.stderr[-1000:]
+    assert "input_pipeline_pil_images_per_sec" in out.stdout
+
+
+def test_data_bench_rejects_empty_measurement():
+    out = _run(["tools/data_bench.py", "--n-images", "4"])
+    assert out.returncode != 0
+    assert "drop_last" in out.stderr + out.stdout
