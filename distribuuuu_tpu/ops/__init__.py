@@ -1,7 +1,18 @@
-"""Custom ops: attention kernels and their pure-jax references."""
+"""Custom ops: attention kernels (dense, Pallas-fused, sequence-parallel),
+mixture-of-experts, and their pure-jax references."""
 
 from distribuuuu_tpu.ops.attention import (  # noqa: F401
     mhsa_2d,
     rel_to_abs,
     relative_logits_1d,
+)
+from distribuuuu_tpu.ops.moe import (  # noqa: F401
+    moe_ffn_dispatch,
+    moe_ffn_partial,
+    moe_ffn_reference,
+)
+from distribuuuu_tpu.ops.ring_attention import (  # noqa: F401
+    reference_attention,
+    ring_attention,
+    ulysses_attention,
 )
