@@ -79,6 +79,25 @@ def top_k_gating(x, gate_w, top_k: int):
     return weights, indices.astype(jnp.int32)
 
 
+def load_balancing_loss(x, gate_w, top_k: int):
+    """Switch-transformer auxiliary loss (arXiv:2101.03961 eq. 4-6).
+
+    ``E · Σ_e f_e · P_e`` where ``f_e`` is the fraction of tokens whose
+    top-k includes expert e and ``P_e`` the mean router probability of e.
+    Minimized (=1.0) at a uniform assignment; add ``λ·aux`` (λ≈0.01) to the
+    task loss to keep routed experts balanced — without it top-k routing
+    collapses onto a few experts and the dispatch path drops tokens.
+    """
+    E = gate_w.shape[-1]
+    logits = x.astype(jnp.float32) @ gate_w.astype(jnp.float32)
+    probs = jax.nn.softmax(logits, axis=-1)                    # [T, E]
+    _, indices = jax.lax.top_k(probs, top_k)
+    assigned = jax.nn.one_hot(indices, E).sum(axis=1)          # [T, E] 0/1
+    f = assigned.mean(axis=0) / top_k                          # Σf = 1
+    p = probs.mean(axis=0)
+    return E * jnp.sum(f * p)
+
+
 def _expert_ffn(w_in, b_in, w_out, b_out, x):
     """One expert's FFN on [T, d] tokens: gelu(x@w_in+b)@w_out+b."""
     h = jax.nn.gelu(x @ w_in.astype(x.dtype) + b_in.astype(x.dtype))

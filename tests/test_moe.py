@@ -121,3 +121,22 @@ def test_params_sharding_places_expert_dim(setup):
     shapes = {s.data.shape for s in placed["w_in"].addressable_shards}
     assert shapes == {(1, D, F)}
     assert placed["gate"].sharding.spec == ()
+
+
+def test_load_balancing_loss_uniform_vs_collapsed(setup):
+    params, x, _ = setup
+    # near-uniform router: loss ≈ 1
+    uniform_gate = jnp.zeros_like(params["gate"])
+    near = float(moe.load_balancing_loss(x, uniform_gate, top_k=2))
+    assert abs(near - 1.0) < 0.05, near
+    # collapsed router: all-ones input with a strong positive column 0 gate
+    # routes every token to expert 0 → loss → E
+    strong = jnp.zeros_like(params["gate"]).at[:, 0].set(1.0)
+    ones = jnp.ones_like(x)
+    bad = float(moe.load_balancing_loss(ones, strong, top_k=1))
+    assert bad > E * 0.9, bad
+    # differentiable w.r.t. the gate
+    g = jax.grad(lambda gw: moe.load_balancing_loss(x, gw, top_k=2))(
+        params["gate"]
+    )
+    assert float(jnp.linalg.norm(g)) > 0
