@@ -226,6 +226,10 @@ _C.CUDNN.DETERMINISTIC = False
 
 # ------------------------------- optimizer ----------------------------------
 _C.OPTIM = CfgNode()
+# "sgd" (the reference's recipe) or "adamw" (typical for the ViT archs).
+_C.OPTIM.OPTIMIZER = "sgd"
+_C.OPTIM.BETA1 = 0.9
+_C.OPTIM.BETA2 = 0.999
 _C.OPTIM.BASE_LR = 0.1
 _C.OPTIM.LR_POLICY = "cos"
 _C.OPTIM.LR_MULT = 0.1

@@ -19,17 +19,38 @@ from distribuuuu_tpu.config import cfg
 
 
 def construct_optimizer() -> optax.GradientTransformation:
-    """SGD + momentum + nesterov + uniform weight decay, torch-ordered."""
+    """Build the configured optimizer (``OPTIM.OPTIMIZER``).
+
+    ``sgd`` (default, the reference's only choice): momentum + nesterov +
+    uniform L2 decay, torch-ordered. ``adamw``: decoupled weight decay —
+    the usual recipe for the ViT extension archs.
+    """
+    kind = cfg.OPTIM.OPTIMIZER
+    if kind not in ("sgd", "adamw"):
+        raise ValueError(
+            f"OPTIM.OPTIMIZER must be 'sgd' or 'adamw'; got {kind!r}"
+        )
 
     @optax.inject_hyperparams
     def _make(learning_rate):
-        return optax.chain(
-            optax.add_decayed_weights(cfg.OPTIM.WEIGHT_DECAY),
-            optax.sgd(
+        if kind == "sgd":
+            return optax.chain(
+                optax.add_decayed_weights(cfg.OPTIM.WEIGHT_DECAY),
+                optax.sgd(
+                    learning_rate=learning_rate,
+                    momentum=cfg.OPTIM.MOMENTUM or None,
+                    nesterov=cfg.OPTIM.NESTEROV,
+                ),
+            )
+        if kind == "adamw":
+            return optax.adamw(
                 learning_rate=learning_rate,
-                momentum=cfg.OPTIM.MOMENTUM or None,
-                nesterov=cfg.OPTIM.NESTEROV,
-            ),
+                b1=cfg.OPTIM.BETA1,
+                b2=cfg.OPTIM.BETA2,
+                weight_decay=cfg.OPTIM.WEIGHT_DECAY,
+            )
+        raise ValueError(
+            f"OPTIM.OPTIMIZER must be 'sgd' or 'adamw'; got {kind!r}"
         )
 
     return _make(learning_rate=cfg.OPTIM.BASE_LR)
