@@ -11,8 +11,11 @@ from distribuuuu_tpu.ops.moe import (  # noqa: F401
     moe_ffn_partial,
     moe_ffn_reference,
 )
-from distribuuuu_tpu.ops.ring_attention import (  # noqa: F401
-    reference_attention,
-    ring_attention,
-    ulysses_attention,
-)
+
+# NOTE: the sequence-parallel entry points live in the ring_attention
+# SUBMODULE (ops.ring_attention.ring_attention / .ulysses_attention /
+# .reference_attention). They are deliberately NOT re-exported here: the
+# function names collide with the submodule name, and a package-level
+# `ring_attention` function would shadow the module for every
+# `from distribuuuu_tpu.ops import ring_attention as ra` call site.
+from distribuuuu_tpu.ops import ring_attention  # noqa: F401  (the module)
