@@ -36,19 +36,27 @@ def main():
     per_chip_batch = 128
     batch = per_chip_batch * n_chips
 
+    # The framework's folded dispatch mode (≙ TRAIN.STEPS_PER_CALL in the
+    # trainer): FOLD optimizer steps per compiled call via lax.scan,
+    # removing the per-step host dispatch (~4 ms on tunneled transports)
+    # from the critical path. Same train-step math.
+    fold = 4
+
     mesh = mesh_lib.build_mesh()
     model = trainer.build_model_from_cfg()
     state = trainer.create_train_state(model, jax.random.key(0), mesh, 224)
     optimizer = construct_optimizer()
-    train_step = trainer.make_train_step(model, optimizer, topk=5)
+    train_step = trainer.make_scan_train_step(model, optimizer, topk=5, fold=fold)
 
     rng = np.random.default_rng(0)
     host_batch = {
-        "image": rng.standard_normal((batch, 224, 224, 3)).astype(np.float32),
-        "label": rng.integers(0, 1000, size=(batch,)).astype(np.int32),
-        "mask": np.ones((batch,), np.float32),
+        "image": rng.standard_normal(
+            (fold, batch, 224, 224, 3)
+        ).astype(np.float32),
+        "label": rng.integers(0, 1000, size=(fold, batch)).astype(np.int32),
+        "mask": np.ones((fold, batch), np.float32),
     }
-    gbatch = sharding_lib.shard_batch(mesh, host_batch)
+    gbatch = sharding_lib.shard_stacked_batch(mesh, host_batch)
 
     # The timed window must end with a *value fetch* that depends on the last
     # step's parameter update: on remote-tunnel transports (axon)
@@ -68,10 +76,10 @@ def main():
         state, metrics = train_step(state, gbatch)
     fence(state)
 
-    # timed steady state — best of two windows (tunnel jitter is ±3%)
-    iters = 20
+    # timed steady state — best of three windows (tunnel jitter is ±3%)
+    iters = 10  # calls; fold steps each
     best_dt = float("inf")
-    for _ in range(2):
+    for _ in range(3):
         t0 = time.perf_counter()
         for _ in range(iters):
             state, metrics = train_step(state, gbatch)
@@ -79,7 +87,7 @@ def main():
         best_dt = min(best_dt, time.perf_counter() - t0)
     dt = best_dt
 
-    img_per_sec = batch * iters / dt
+    img_per_sec = batch * fold * iters / dt
     img_per_sec_per_chip = img_per_sec / n_chips
     print(
         json.dumps(

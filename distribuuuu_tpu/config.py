@@ -208,6 +208,12 @@ _C.TRAIN.WORKERS = 4
 _C.TRAIN.PIN_MEMORY = True
 _C.TRAIN.PRINT_FREQ = 30
 _C.TRAIN.TOPK = 5
+# Fold this many optimizer steps into ONE compiled call (lax.scan over the
+# step body). >1 removes the per-step host dispatch from the critical path —
+# worth ~4 ms/step on tunneled transports (PERF.md) — at the cost of
+# metric/profiler granularity rounding up to the fold size. 1 = the
+# reference's one-dispatch-per-step behavior.
+_C.TRAIN.STEPS_PER_CALL = 1
 
 # ------------------------------- testing -----------------------------------
 _C.TEST = CfgNode()

@@ -24,21 +24,39 @@ def replicate(mesh: Mesh) -> NamedSharding:
     return NamedSharding(mesh, P())
 
 
-def shard_batch(mesh: Mesh, batch):
-    """Place a host-local batch pytree as global device arrays sharded on
-    ``data``.
+def _put_tree(mesh: Mesh, tree, batch_dim: int):
+    """Place a host-local pytree with the dim ``batch_dim`` of every leaf
+    sharded over ``data`` (dims before it unsharded).
 
-    In multi-host runs each process holds its own shard (DistributedSampler
-    semantics, ref: utils.py:141-143) and this assembles the global array
-    from per-host shards; single-host it is a plain sharded device_put.
+    In multi-host runs each process holds its own shard of the batch dim
+    (DistributedSampler semantics, ref: utils.py:141-143) and this assembles
+    the global array from per-host shards; single-host it is a plain sharded
+    device_put.
     """
-    sharding = batch_sharding(mesh)
+    spec = P(*([None] * batch_dim + ["data"]))
+    sharding = NamedSharding(mesh, spec)
 
     def _put(x):
         x = np.asarray(x)
         if jax.process_count() == 1:
             return jax.device_put(x, sharding)
-        global_shape = (x.shape[0] * jax.process_count(),) + x.shape[1:]
+        global_shape = tuple(
+            d * jax.process_count() if i == batch_dim else d
+            for i, d in enumerate(x.shape)
+        )
         return jax.make_array_from_process_local_data(sharding, x, global_shape)
 
-    return jax.tree.map(_put, batch)
+    return jax.tree.map(_put, tree)
+
+
+def shard_batch(mesh: Mesh, batch):
+    """Place a host-local batch pytree as global device arrays sharded on
+    ``data``."""
+    return _put_tree(mesh, batch, batch_dim=0)
+
+
+def shard_stacked_batch(mesh: Mesh, stacked):
+    """Place a host-local *stack* of batches (leading dim = fold size,
+    second dim = batch) sharded on ``data`` along the batch dim — the input
+    layout for the folded ``lax.scan`` train step."""
+    return _put_tree(mesh, stacked, batch_dim=1)

@@ -143,9 +143,8 @@ def create_train_state(model, key, mesh, im_size: int) -> TrainState:
     return jax.jit(init_all)(key)
 
 
-def make_train_step(model, optimizer, topk: int):
-    """Compile-once train step: fwd + CE loss + bwd + SGD + metrics
-    (≙ the hot loop body, ref: trainer.py:37-58)."""
+def _train_step_body(model, optimizer, topk: int):
+    """The pure step function shared by the per-step and folded paths."""
 
     def train_step(state: TrainState, batch):
         step_key = jax.random.fold_in(state.key, state.step)
@@ -179,7 +178,32 @@ def make_train_step(model, optimizer, topk: int):
         )
         return new_state, metrics
 
-    return jax.jit(train_step, donate_argnums=0)
+    return train_step
+
+
+def make_train_step(model, optimizer, topk: int):
+    """Compile-once train step: fwd + CE loss + bwd + SGD + metrics
+    (≙ the hot loop body, ref: trainer.py:37-58)."""
+    return jax.jit(_train_step_body(model, optimizer, topk), donate_argnums=0)
+
+
+def make_scan_train_step(model, optimizer, topk: int, fold: int):
+    """``fold`` optimizer steps in ONE compiled call via ``lax.scan``.
+
+    Same math as ``fold`` sequential ``make_train_step`` calls (same body,
+    same per-step RNG folding via ``state.step``; results agree up to XLA
+    fusion-order float drift). The difference is dispatch: one host→device
+    launch per ``fold`` steps, so the per-step host overhead (~4 ms on
+    tunneled transports, PERF.md) amortizes away.
+    Takes a stacked batch pytree with leading dim ``fold`` (leaf shape
+    ``(fold, batch, ...)``) and returns stacked per-step metrics ``(fold,)``.
+    """
+    body = _train_step_body(model, optimizer, topk)
+
+    def scan_steps(state: TrainState, stacked_batch):
+        return jax.lax.scan(body, state, stacked_batch, length=fold)
+
+    return jax.jit(scan_steps, donate_argnums=0)
 
 
 def make_eval_step(model, topk: int):
@@ -237,7 +261,9 @@ class _ProfilerWindow:
             self.last = cfg.PROF.START_STEP + cfg.PROF.NUM_STEPS
 
     def begin(self, it):
-        if self.enabled and it == self.first:
+        # >= not ==: in folded mode ``it`` advances in fold-sized jumps, so
+        # the window opens at the first call boundary at/after START_STEP
+        if self.enabled and not self.started and it >= self.first:
             jax.profiler.start_trace(self.trace_dir)
             self.active = self.started = True
 
@@ -249,7 +275,8 @@ class _ProfilerWindow:
         get_logger().info("profiler trace written to %s", self.trace_dir)
 
     def end(self, it, state):
-        if self.active and it + 1 == self.last:
+        # >= not ==: close at the first call boundary covering the window end
+        if self.active and it + 1 >= self.last:
             self._stop(state)
 
     def finish(self, state):
@@ -269,41 +296,121 @@ class _ProfilerWindow:
 
 
 def train_epoch(loader, mesh, state, train_step, epoch: int, logger,
-                first_epoch: int = 0):
-    """One epoch of the hot loop (ref: trainer.py:14-64)."""
+                first_epoch: int = 0, scan_step=None):
+    """One epoch of the hot loop (ref: trainer.py:14-64).
+
+    With ``TRAIN.STEPS_PER_CALL > 1`` (``scan_step`` provided) full groups of
+    batches dispatch as one compiled ``lax.scan`` call; the ragged tail falls
+    back to ``train_step``. Metric fetch still happens at PRINT_FREQ batch
+    granularity (rounded up to the fold size); the profiler window rounds to
+    call boundaries.
+    """
     lr = get_epoch_lr(epoch)
     set_lr(state.opt_state, lr)  # epoch-granular LR (ref: trainer.py:25-26)
     loader.set_epoch(epoch)  # reshuffle shards (ref: trainer.py:33)
     num_batches = len(loader)
+    fold = max(1, cfg.TRAIN.STEPS_PER_CALL) if scan_step is not None else 1
     batch_time, data_time, losses, top1, topk_m, progress = construct_meters(
         num_batches, f"Epoch[{epoch + 1}/{cfg.OPTIM.MAX_EPOCH}]", effective_topk()
     )
     prof = _ProfilerWindow(epoch, first_epoch)
-    pending = []  # (step_idx, device metrics) awaiting async fetch
-    end = time.perf_counter()
-    for it, host_batch in enumerate(loader):
-        data_time.update(time.perf_counter() - end)
-        batch = sharding_lib.shard_batch(mesh, host_batch)
-        prof.begin(it)
-        state, metrics = train_step(state, batch)
-        prof.end(it, state)
-        pending.append(metrics)
-        batch_time.update(time.perf_counter() - end)
-        end = time.perf_counter()
-        if (it + 1) % cfg.TRAIN.PRINT_FREQ == 0 or (it + 1) == num_batches:
-            # fetch everything dispatched since the last print (async until here)
-            for m in pending:
+    pending = []  # (n_steps, device metrics) awaiting async fetch
+    n_buffered = 0  # fold slots filled since the last dispatch
+    done = 0  # batches whose step has been dispatched
+
+    def flush_pending():
+        for n, m in pending:
+            if n == 1:
                 losses.update(float(m["loss"]))
                 top1.update(float(m["top1"]))
                 topk_m.update(float(m["topk"]))
-            pending.clear()
+            else:  # stacked (fold,) metrics from a scan call
+                for ls, t1, tk in zip(
+                    np.asarray(m["loss"]), np.asarray(m["top1"]),
+                    np.asarray(m["topk"]),
+                ):
+                    losses.update(float(ls))
+                    top1.update(float(t1))
+                    topk_m.update(float(tk))
+        pending.clear()
+
+    def maybe_print():
+        if done % cfg.TRAIN.PRINT_FREQ < fold or done == num_batches:
+            flush_pending()
             if mesh_lib.is_primary():
                 eta = progress.get_eta(
-                    it + 1,
-                    (num_batches - it - 1)
+                    done,
+                    (num_batches - done)
                     + (cfg.OPTIM.MAX_EPOCH - epoch - 1) * num_batches,
                 )
-                logger.info("%s  LR %.5f  ETA %s", progress.display(it + 1), lr, eta)
+                logger.info("%s  LR %.5f  ETA %s", progress.display(done), lr, eta)
+
+    # Two preallocated (fold, batch, ...) host buffers, ping-ponged per
+    # dispatch: device_put may still be reading buffer A asynchronously
+    # while the next fold fills buffer B.
+    stack_bufs, buf_idx = None, 0
+    end = time.perf_counter()
+    win_start = end  # start of the current fold window (covers buffering too)
+    for it, host_batch in enumerate(loader):
+        data_time.update(time.perf_counter() - end)
+        is_last = it + 1 == num_batches
+        if fold > 1:
+            # copy into the preallocated fold slot NOW (spreads the host
+            # memcpy across the fold window, overlapped with the device
+            # executing the previous call) instead of np.stack-ing the
+            # whole fold on the dispatch iteration
+            if stack_bufs is None:
+                stack_bufs = [
+                    jax.tree.map(
+                        lambda x: np.empty(
+                            (fold,) + np.shape(x), np.asarray(x).dtype
+                        ),
+                        host_batch,
+                    )
+                    for _ in range(2)
+                ]
+            stack_buf = stack_bufs[buf_idx]
+            jax.tree.map(
+                lambda buf, x: buf.__setitem__(n_buffered, x),
+                stack_buf, host_batch,
+            )
+            n_buffered += 1
+            if n_buffered < fold and not is_last:
+                end = time.perf_counter()
+                continue
+            n = n_buffered
+            if n == fold:
+                batch = sharding_lib.shard_stacked_batch(mesh, stack_buf)
+                prof.begin(done)
+                state, metrics = scan_step(state, batch)
+                prof.end(done + fold - 1, state)
+                pending.append((fold, metrics))
+            else:  # ragged tail: per-step dispatch
+                for i in range(n):
+                    hb = jax.tree.map(lambda buf: buf[i], stack_buf)
+                    b = sharding_lib.shard_batch(mesh, hb)
+                    prof.begin(done + i)
+                    state, metrics = train_step(state, b)
+                    prof.end(done + i, state)
+                    pending.append((1, metrics))
+            done += n
+            n_buffered = 0
+            buf_idx ^= 1
+            # per-BATCH time over the whole window (incl. the buffering
+            # iterations) so display/ETA keep their per-batch meaning
+            now = time.perf_counter()
+            batch_time.update((now - win_start) / n, n=n)
+            win_start = now
+        else:
+            batch = sharding_lib.shard_batch(mesh, host_batch)
+            prof.begin(it)
+            state, metrics = train_step(state, batch)
+            prof.end(it, state)
+            pending.append((1, metrics))
+            done += 1
+            batch_time.update(time.perf_counter() - end)
+        end = time.perf_counter()
+        maybe_print()
     prof.finish(state)
     return state
 
@@ -452,6 +559,11 @@ def train_model():
     train_loader = construct_train_loader()
     val_loader = construct_val_loader()
     train_step = make_train_step(model, optimizer, effective_topk())
+    scan_step = None
+    if cfg.TRAIN.STEPS_PER_CALL > 1:
+        scan_step = make_scan_train_step(
+            model, optimizer, effective_topk(), cfg.TRAIN.STEPS_PER_CALL
+        )
     eval_step = make_eval_step(model, effective_topk())
 
     start_epoch, best_acc1 = 0, 0.0
@@ -480,7 +592,7 @@ def train_model():
     for epoch in range(start_epoch, cfg.OPTIM.MAX_EPOCH):
         state = train_epoch(loader=train_loader, mesh=mesh, state=state,
                             train_step=train_step, epoch=epoch, logger=logger,
-                            first_epoch=start_epoch)
+                            first_epoch=start_epoch, scan_step=scan_step)
         acc1, _ = validate(val_loader, mesh, state, eval_step, epoch, logger)
         is_best = acc1 > best_acc1
         best_acc1 = max(acc1, best_acc1)

@@ -1,0 +1,11 @@
+"""Importing this module puts the repo root on sys.path, so the tools
+scripts can ``import distribuuuu_tpu`` when run as ``python tools/x.py``
+(where sys.path[0] is tools/, not the repo root) without requiring
+``pip install -e .``."""
+
+import os
+import sys
+
+_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if _ROOT not in sys.path:
+    sys.path.insert(0, _ROOT)

@@ -24,6 +24,7 @@ import argparse
 import os
 import time
 
+import _path  # noqa: F401  — repo root onto sys.path for the package import
 import jax
 
 # NOT redundant with jax's own env handling: sitecustomize hooks (e.g.

@@ -22,6 +22,7 @@ import os
 import tempfile
 import time
 
+import _path  # noqa: F401  — repo root onto sys.path for the package import
 import numpy as np
 
 

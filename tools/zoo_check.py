@@ -17,6 +17,7 @@ import argparse
 import time
 import traceback
 
+import _path  # noqa: F401  — repo root onto sys.path for the package import
 import jax
 import jax.numpy as jnp
 import numpy as np
