@@ -260,6 +260,13 @@ _C.DEVICE.DETERMINISTIC = False
 # Attention implementation for attention archs: "auto" | "xla" | "pallas".
 # "auto" resolves per measurement (see ops/pallas_attention.use_pallas).
 _C.DEVICE.ATTN_IMPL = "auto"
+# Space-to-depth stem for the 7x7/s2-stem archs (resnet/resnext/wide_resnet/
+# botnet): compute the stem as a 4x4/s1 conv over 2x2-block-folded input
+# (models/layers.StemConv7x7). Exact same math and the SAME params/
+# checkpoints either way. Measured NEUTRAL on v5e (XLA already lays the stem
+# out well there — PERF.md); kept as a knob for TPU generations where the
+# classic MLPerf gain applies.
+_C.DEVICE.S2D_STEM = False
 
 _C.MESH = CfgNode()
 # Logical mesh axis sizes; -1 means "all remaining devices" on that axis.

@@ -145,12 +145,14 @@ class BoTNet50(nn.Module):
     fmap_size: tuple[int, int] = (14, 14)
     attn_impl: str = "auto"
     dtype: Any = jnp.bfloat16
+    s2d_stem: bool = False
 
     @nn.compact
     def __call__(self, x, train: bool = False):
         x = x.astype(self.dtype)
         x = ConvBN(
-            64, (7, 7), 2, padding=[(3, 3), (3, 3)], dtype=self.dtype, act=nn.relu
+            64, (7, 7), 2, padding=[(3, 3), (3, 3)], dtype=self.dtype,
+            act=nn.relu, s2d_stem=self.s2d_stem,
         )(x, train=train)
         x = max_pool_3x3_s2(x)
         for stage, (feats, n_blocks) in enumerate(zip((64, 128, 256), (3, 4, 6))):

@@ -21,6 +21,7 @@ import jax.numpy as jnp
 from distribuuuu_tpu.models.layers import (
     BatchNorm,
     Dense,
+    StemConv7x7,
     global_avg_pool,
     conv_kernel_init,
     max_pool_3x3_s2,
@@ -63,14 +64,16 @@ class DenseNet(nn.Module):
     num_classes: int = 1000
     memory_efficient: bool = False
     dtype: Any = jnp.bfloat16
+    s2d_stem: bool = False
 
     @nn.compact
     def __call__(self, x, train: bool = False):
         x = x.astype(self.dtype)
-        x = nn.Conv(
-            self.num_init_features, (7, 7), strides=2, padding=[(3, 3), (3, 3)],
-            use_bias=False, dtype=self.dtype, param_dtype=jnp.float32,
-            kernel_init=conv_kernel_init,
+        # 7x7/s2 stem; the explicit name keeps the param at Conv_0/kernel in
+        # both stem modes (StemConv7x7 computes the plain conv at s2d=False)
+        x = StemConv7x7(
+            self.num_init_features, s2d=self.s2d_stem, dtype=self.dtype,
+            name="Conv_0",
         )(x)
         x = BatchNorm(dtype=self.dtype)(x, train=train)
         x = nn.relu(x)
@@ -100,9 +103,12 @@ class DenseNet(nn.Module):
                 x = BatchNorm(dtype=self.dtype)(x, train=train)
                 x = nn.relu(x)
                 num_features = num_features // 2
+                # explicit Conv_{i+1}: the stem occupies the "Conv_0" name,
+                # which would otherwise collide with flax auto-numbering
                 x = nn.Conv(
                     num_features, (1, 1), use_bias=False, dtype=self.dtype,
                     param_dtype=jnp.float32, kernel_init=conv_kernel_init,
+                    name=f"Conv_{i + 1}",
                 )(x)
                 x = nn.avg_pool(x, (2, 2), strides=(2, 2))
 

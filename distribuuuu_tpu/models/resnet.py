@@ -110,14 +110,17 @@ class ResNet(nn.Module):
     width_per_group: int = 64
     zero_init_residual: bool = False
     dtype: Any = jnp.bfloat16
+    s2d_stem: bool = False
     stage_features = (64, 128, 256, 512)
 
     @nn.compact
     def __call__(self, x, train: bool = False):
         x = x.astype(self.dtype)
-        # stem: 7x7/s2 conv + BN + relu + 3x3/s2 maxpool (ref: resnet.py:194-199)
+        # stem: 7x7/s2 conv + BN + relu + 3x3/s2 maxpool (ref: resnet.py:194-199);
+        # s2d_stem selects the space-to-depth compute path (layers.StemConv7x7)
         x = ConvBN(
-            64, (7, 7), 2, padding=[(3, 3), (3, 3)], dtype=self.dtype, act=nn.relu
+            64, (7, 7), 2, padding=[(3, 3), (3, 3)], dtype=self.dtype,
+            act=nn.relu, s2d_stem=self.s2d_stem,
         )(x, train=train)
         x = max_pool_3x3_s2(x)
         in_features = 64

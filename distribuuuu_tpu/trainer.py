@@ -82,6 +82,10 @@ def build_model_from_cfg():
         num_classes=cfg.MODEL.NUM_CLASSES,
         dtype=resolve_dtype(cfg.DEVICE.COMPUTE_DTYPE),
     )
+    if cfg.MODEL.ARCH.startswith(
+        ("resnet", "resnext", "wide_resnet", "botnet", "densenet")
+    ):
+        kwargs["s2d_stem"] = cfg.DEVICE.S2D_STEM
     if cfg.MODEL.ARCH == "botnet50":
         # the attention grid follows the input size; each stride-2 op maps
         # n → ceil(n/2), so the stride-16 backbone gives ceil(IM_SIZE/16).

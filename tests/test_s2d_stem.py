@@ -1,0 +1,120 @@
+"""Space-to-depth stem (DEVICE.S2D_STEM / models.layers.StemConv7x7): the
+folded 4x4/s1 compute path must be an exact reformulation of the 7x7/s2 stem
+— same params at the same tree paths, same outputs, odd-size fallback."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+import distribuuuu_tpu.config as config
+from distribuuuu_tpu.config import cfg
+
+
+def _stem_pair():
+    from distribuuuu_tpu.models.layers import StemConv7x7
+
+    return (
+        StemConv7x7(64, s2d=False, dtype=jnp.float32),
+        StemConv7x7(64, s2d=True, dtype=jnp.float32),
+    )
+
+
+def test_s2d_stem_matches_plain_conv():
+    plain, s2d = _stem_pair()
+    x = jnp.asarray(
+        np.random.default_rng(0).standard_normal((2, 224, 224, 3)), jnp.float32
+    )
+    variables = plain.init(jax.random.key(0), x)
+    ref = plain.apply(variables, x)
+    out = s2d.apply(variables, x)  # SAME variables — the param is shared
+    assert out.shape == ref.shape == (2, 112, 112, 64)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), rtol=1e-4, atol=1e-4)
+
+
+def test_s2d_stem_param_tree_identical():
+    plain, s2d = _stem_pair()
+    x = jnp.ones((1, 32, 32, 3), jnp.float32)
+    va = jax.tree.map(np.shape, jax.eval_shape(plain.init, jax.random.key(0), x))
+    vb = jax.tree.map(np.shape, jax.eval_shape(s2d.init, jax.random.key(0), x))
+    assert jax.tree.structure(va) == jax.tree.structure(vb)
+    # same SHAPES too: the s2d mode must keep the canonical (7,7,in,out)
+    # kernel, not a folded one (leaves flatten through the Partitioned box
+    # to the shape-tuple elements)
+    assert jax.tree.leaves(va) == jax.tree.leaves(vb) == [7, 7, 3, 64]
+
+
+def test_s2d_stem_gradients_match():
+    plain, s2d = _stem_pair()
+    x = jnp.asarray(
+        np.random.default_rng(1).standard_normal((1, 64, 64, 3)), jnp.float32
+    )
+    variables = plain.init(jax.random.key(0), x)
+
+    def loss(v, mod):
+        return jnp.sum(mod.apply(v, x) ** 2)
+
+    ga = jax.grad(loss)(variables, plain)
+    gb = jax.grad(loss)(variables, s2d)
+    for a, b in zip(jax.tree.leaves(ga), jax.tree.leaves(gb)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), rtol=1e-3, atol=1e-2)
+
+
+def test_s2d_stem_odd_input_falls_back():
+    _, s2d = _stem_pair()
+    x = jnp.ones((1, 225, 225, 3), jnp.float32)
+    variables = s2d.init(jax.random.key(0), x)
+    out = s2d.apply(variables, x)
+    # torch conv output size: floor((225 + 6 - 7)/2) + 1 = 113
+    assert out.shape == (1, 113, 113, 64)
+
+
+def test_resnet_checkpoint_compatible_across_modes():
+    """A model initialized with the plain stem evaluates identically under
+    the s2d stem — the checkpoint-compatibility guarantee."""
+    from distribuuuu_tpu import models
+
+    x = jnp.asarray(
+        np.random.default_rng(2).standard_normal((2, 64, 64, 3)), jnp.float32
+    )
+    plain = models.build_model(
+        "resnet18", num_classes=10, dtype=jnp.float32, s2d_stem=False
+    )
+    folded = models.build_model(
+        "resnet18", num_classes=10, dtype=jnp.float32, s2d_stem=True
+    )
+    variables = plain.init(jax.random.key(0), x, train=False)
+    a = plain.apply(variables, x, train=False)
+    b = folded.apply(variables, x, train=False)
+    np.testing.assert_allclose(np.asarray(a), np.asarray(b), rtol=5e-4, atol=5e-4)
+
+
+def test_densenet_checkpoint_compatible_across_modes():
+    from distribuuuu_tpu import models
+
+    x = jnp.asarray(
+        np.random.default_rng(3).standard_normal((1, 64, 64, 3)), jnp.float32
+    )
+    plain = models.build_model(
+        "densenet121", num_classes=10, dtype=jnp.float32, s2d_stem=False
+    )
+    folded = models.build_model(
+        "densenet121", num_classes=10, dtype=jnp.float32, s2d_stem=True
+    )
+    variables = plain.init(jax.random.key(0), x, train=False)
+    a = plain.apply(variables, x, train=False)
+    b = folded.apply(variables, x, train=False)
+    np.testing.assert_allclose(np.asarray(a), np.asarray(b), rtol=5e-4, atol=5e-4)
+
+
+def test_trainer_wires_s2d_from_cfg():
+    from distribuuuu_tpu import trainer
+
+    config.reset_cfg()
+    cfg.MODEL.ARCH = "resnet18"
+    cfg.DEVICE.S2D_STEM = True
+    assert trainer.build_model_from_cfg().s2d_stem is True
+    cfg.DEVICE.S2D_STEM = False
+    assert trainer.build_model_from_cfg().s2d_stem is False
+    # archs without a 7x7 stem must not receive the kwarg
+    cfg.MODEL.ARCH = "efficientnet_b0"
+    trainer.build_model_from_cfg()
