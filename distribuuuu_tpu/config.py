@@ -214,6 +214,15 @@ _C.TRAIN.TOPK = 5
 # metric/profiler granularity rounding up to the fold size. 1 = the
 # reference's one-dispatch-per-step behavior.
 _C.TRAIN.STEPS_PER_CALL = 1
+# Split each optimizer step's batch into this many sequential micro-batches,
+# summing gradients in-graph before the (single) update. Runs the
+# reference's large-global-batch recipes (README.md:210-211 — 8192/16384
+# over 64 GPUs) on far fewer chips: BATCH_SIZE stays the *optimizer* batch
+# per chip; HBM holds only BATCH_SIZE/GRAD_ACCUM_STEPS activations at once.
+# Gradient math is exact (mean-CE grads average over equal micro-batches);
+# BN batch stats are per-micro-batch — the same semantics torch DDP +
+# gradient accumulation has (stats over what the device sees per forward).
+_C.TRAIN.GRAD_ACCUM_STEPS = 1
 
 # ------------------------------- testing -----------------------------------
 _C.TEST = CfgNode()

@@ -147,51 +147,102 @@ def create_train_state(model, key, mesh, im_size: int) -> TrainState:
     return jax.jit(init_all)(key)
 
 
-def _train_step_body(model, optimizer, topk: int):
-    """The pure step function shared by the per-step and folded paths."""
+def _train_step_body(model, optimizer, topk: int, accum_steps: int = 1):
+    """The pure step function shared by the per-step and folded paths.
 
-    def train_step(state: TrainState, batch):
-        step_key = jax.random.fold_in(state.key, state.step)
+    ``accum_steps > 1`` runs that many sequential micro-batches, summing
+    gradients in-graph before ONE optimizer update (config:
+    ``TRAIN.GRAD_ACCUM_STEPS``). The batch must arrive pre-split as
+    ``(accum, micro_batch, ...)`` with the micro_batch dim sharded on
+    ``data`` (sharding.shard_micro_batch) — splitting on the host is a
+    zero-copy view, whereas an in-graph reshape of the data-sharded batch
+    dim would make GSPMD redistribute the whole batch over ICI every step.
+    Gradients are exact (the mean-CE micro-grads average to the full-batch
+    grad); BN stats are per-micro-batch — torch-DDP-with-accumulation
+    semantics. HBM holds one micro-batch of activations at a time.
+    """
 
-        def loss_fn(params):
-            logits, mutated = model.apply(
-                {"params": params, "batch_stats": state.batch_stats},
-                batch["image"],
-                train=True,
-                mutable=["batch_stats"],
-                rngs={"dropout": step_key},
-            )
-            loss = cross_entropy(logits, batch["label"])
-            return loss, (logits, mutated.get("batch_stats", {}))
-
-        (loss, (logits, new_stats)), grads = jax.value_and_grad(
-            loss_fn, has_aux=True
-        )(state.params)
+    def apply_grads(state, grads, new_stats, metrics):
         updates, new_opt_state = optimizer.update(
             grads, state.opt_state, state.params
         )
         new_params = optax.apply_updates(state.params, updates)
-        acc1, acck = accuracy(logits, batch["label"], topk=(1, topk))
-        metrics = {"loss": loss, "top1": acc1, "topk": acck}
-        new_state = TrainState(
+        return TrainState(
             params=new_params,
             batch_stats=new_stats,
             opt_state=new_opt_state,
             step=state.step + 1,
             key=state.key,
+        ), metrics
+
+    def loss_fn(params, stats, images, labels, key):
+        logits, mutated = model.apply(
+            {"params": params, "batch_stats": stats},
+            images,
+            train=True,
+            mutable=["batch_stats"],
+            rngs={"dropout": key},
         )
-        return new_state, metrics
+        loss = cross_entropy(logits, labels)
+        return loss, (logits, mutated.get("batch_stats", {}))
 
-    return train_step
+    grad_fn = jax.value_and_grad(loss_fn, has_aux=True)
+
+    def train_step(state: TrainState, batch):
+        step_key = jax.random.fold_in(state.key, state.step)
+        (loss, (logits, new_stats)), grads = grad_fn(
+            state.params, state.batch_stats, batch["image"], batch["label"],
+            step_key,
+        )
+        acc1, acck = accuracy(logits, batch["label"], topk=(1, topk))
+        return apply_grads(
+            state, grads, new_stats, {"loss": loss, "top1": acc1, "topk": acck}
+        )
+
+    def accum_train_step(state: TrainState, micro):
+        step_key = jax.random.fold_in(state.key, state.step)
+        if micro["image"].shape[0] != accum_steps:
+            raise ValueError(
+                f"accum train step wants a pre-split (accum={accum_steps}, "
+                f"micro_batch, ...) input, got leading dim "
+                f"{micro['image'].shape[0]} — use sharding.shard_micro_batch"
+            )
+
+        def body(carry, mb):
+            stats, gsum, i = carry
+            mkey = jax.random.fold_in(step_key, i)
+            (loss, (logits, new_stats)), grads = grad_fn(
+                state.params, stats, mb["image"], mb["label"], mkey
+            )
+            acc1, acck = accuracy(logits, mb["label"], topk=(1, topk))
+            gsum = jax.tree.map(jnp.add, gsum, grads)
+            return (new_stats, gsum, i + 1), {
+                "loss": loss, "top1": acc1, "topk": acck,
+            }
+
+        zeros = jax.tree.map(jnp.zeros_like, state.params)
+        (new_stats, gsum, _), micro_metrics = jax.lax.scan(
+            body, (state.batch_stats, zeros, jnp.int32(0)), micro,
+            length=accum_steps,
+        )
+        grads = jax.tree.map(lambda g: g / accum_steps, gsum)
+        metrics = jax.tree.map(jnp.mean, micro_metrics)
+        return apply_grads(state, grads, new_stats, metrics)
+
+    return accum_train_step if accum_steps > 1 else train_step
 
 
-def make_train_step(model, optimizer, topk: int):
+def make_train_step(model, optimizer, topk: int, accum_steps: int = 1):
     """Compile-once train step: fwd + CE loss + bwd + SGD + metrics
     (≙ the hot loop body, ref: trainer.py:37-58)."""
-    return jax.jit(_train_step_body(model, optimizer, topk), donate_argnums=0)
+    return jax.jit(
+        _train_step_body(model, optimizer, topk, accum_steps),
+        donate_argnums=0,
+    )
 
 
-def make_scan_train_step(model, optimizer, topk: int, fold: int):
+def make_scan_train_step(model, optimizer, topk: int, fold: int,
+                         accum_steps: int = 1):
     """``fold`` optimizer steps in ONE compiled call via ``lax.scan``.
 
     Same math as ``fold`` sequential ``make_train_step`` calls (same body,
@@ -202,7 +253,7 @@ def make_scan_train_step(model, optimizer, topk: int, fold: int):
     Takes a stacked batch pytree with leading dim ``fold`` (leaf shape
     ``(fold, batch, ...)``) and returns stacked per-step metrics ``(fold,)``.
     """
-    body = _train_step_body(model, optimizer, topk)
+    body = _train_step_body(model, optimizer, topk, accum_steps)
 
     def scan_steps(state: TrainState, stacked_batch):
         return jax.lax.scan(body, state, stacked_batch, length=fold)
@@ -314,6 +365,17 @@ def train_epoch(loader, mesh, state, train_step, epoch: int, logger,
     loader.set_epoch(epoch)  # reshuffle shards (ref: trainer.py:33)
     num_batches = len(loader)
     fold = max(1, cfg.TRAIN.STEPS_PER_CALL) if scan_step is not None else 1
+    accum = max(1, cfg.TRAIN.GRAD_ACCUM_STEPS)
+
+    def put_batch(hb):
+        if accum > 1:
+            return sharding_lib.shard_micro_batch(mesh, hb, accum)
+        return sharding_lib.shard_batch(mesh, hb)
+
+    def put_stacked(hb):
+        if accum > 1:
+            return sharding_lib.shard_stacked_micro_batch(mesh, hb, accum)
+        return sharding_lib.shard_stacked_batch(mesh, hb)
     batch_time, data_time, losses, top1, topk_m, progress = construct_meters(
         num_batches, f"Epoch[{epoch + 1}/{cfg.OPTIM.MAX_EPOCH}]", effective_topk()
     )
@@ -384,7 +446,7 @@ def train_epoch(loader, mesh, state, train_step, epoch: int, logger,
                 continue
             n = n_buffered
             if n == fold:
-                batch = sharding_lib.shard_stacked_batch(mesh, stack_buf)
+                batch = put_stacked(stack_buf)
                 prof.begin(done)
                 state, metrics = scan_step(state, batch)
                 prof.end(done + fold - 1, state)
@@ -392,7 +454,7 @@ def train_epoch(loader, mesh, state, train_step, epoch: int, logger,
             else:  # ragged tail: per-step dispatch
                 for i in range(n):
                     hb = jax.tree.map(lambda buf: buf[i], stack_buf)
-                    b = sharding_lib.shard_batch(mesh, hb)
+                    b = put_batch(hb)
                     prof.begin(done + i)
                     state, metrics = train_step(state, b)
                     prof.end(done + i, state)
@@ -406,7 +468,7 @@ def train_epoch(loader, mesh, state, train_step, epoch: int, logger,
             batch_time.update((now - win_start) / n, n=n)
             win_start = now
         else:
-            batch = sharding_lib.shard_batch(mesh, host_batch)
+            batch = put_batch(host_batch)
             prof.begin(it)
             state, metrics = train_step(state, batch)
             prof.end(it, state)
@@ -551,6 +613,16 @@ def train_model():
     mesh = mesh_lib.mesh_from_cfg(cfg)
     key = setup_seed()
 
+    accum = max(1, cfg.TRAIN.GRAD_ACCUM_STEPS)
+    per_host_batch = cfg.TRAIN.BATCH_SIZE * jax.local_device_count()
+    if per_host_batch % accum:
+        # fail before the expensive state init/compile, in the user's units
+        raise ValueError(
+            f"TRAIN.BATCH_SIZE={cfg.TRAIN.BATCH_SIZE} × "
+            f"{jax.local_device_count()} local chips = {per_host_batch} "
+            f"per host, not divisible by TRAIN.GRAD_ACCUM_STEPS={accum}"
+        )
+
     model = build_model_from_cfg()
     state = create_train_state(model, key, mesh, cfg.TRAIN.IM_SIZE)
     m_params, mb = count_parameters(state.params)
@@ -562,11 +634,14 @@ def train_model():
     optimizer = construct_optimizer()
     train_loader = construct_train_loader()
     val_loader = construct_val_loader()
-    train_step = make_train_step(model, optimizer, effective_topk())
+    train_step = make_train_step(
+        model, optimizer, effective_topk(), accum_steps=accum
+    )
     scan_step = None
     if cfg.TRAIN.STEPS_PER_CALL > 1:
         scan_step = make_scan_train_step(
-            model, optimizer, effective_topk(), cfg.TRAIN.STEPS_PER_CALL
+            model, optimizer, effective_topk(), cfg.TRAIN.STEPS_PER_CALL,
+            accum_steps=accum,
         )
     eval_step = make_eval_step(model, effective_topk())
 

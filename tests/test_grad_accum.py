@@ -1,0 +1,122 @@
+"""TRAIN.GRAD_ACCUM_STEPS: in-graph gradient accumulation must reproduce the
+full-batch optimizer step exactly on stat-free models (mean-CE micro-grads
+average to the full-batch grad), and run e2e through the trainer."""
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import distribuuuu_tpu.config as config
+from distribuuuu_tpu.config import cfg
+
+
+class _TinyMLP(nn.Module):
+    """BN-free, dropout-free model with the zoo's apply signature — isolates
+    the accumulation math from per-micro-batch BN-stat semantics."""
+
+    num_classes: int = 10
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        x = x.reshape((x.shape[0], -1))
+        x = nn.Dense(32)(x)
+        x = nn.relu(x)
+        return nn.Dense(self.num_classes)(x)
+
+
+def _state_for(trainer, model, mesh):
+    from distribuuuu_tpu.utils.optim import construct_optimizer
+
+    state = trainer.create_train_state(model, jax.random.key(0), mesh, 8)
+    return state, construct_optimizer()
+
+
+def test_accum_matches_full_batch_step():
+    from distribuuuu_tpu import trainer
+    from distribuuuu_tpu.parallel import mesh as mesh_lib, sharding as sharding_lib
+
+    config.reset_cfg()
+    mesh = mesh_lib.build_mesh()
+    model = _TinyMLP()
+
+    rng = np.random.default_rng(0)
+    batch = {
+        "image": rng.standard_normal((32, 8, 8, 3)).astype(np.float32),
+        "label": rng.integers(0, 10, size=(32,)).astype(np.int32),
+        "mask": np.ones((32,), np.float32),
+    }
+
+    state, optimizer = _state_for(trainer, model, mesh)
+    full = trainer.make_train_step(model, optimizer, topk=5)
+    state_full, m_full = full(state, sharding_lib.shard_batch(mesh, batch))
+
+    state2, _ = _state_for(trainer, model, mesh)
+    acc = trainer.make_train_step(model, optimizer, topk=5, accum_steps=4)
+    state_acc, m_acc = acc(
+        state2, sharding_lib.shard_micro_batch(mesh, batch, accum=4)
+    )
+
+    for a, b in zip(
+        jax.tree.leaves(jax.tree.map(np.asarray, state_full.params)),
+        jax.tree.leaves(jax.tree.map(np.asarray, state_acc.params)),
+    ):
+        np.testing.assert_allclose(a, b, rtol=1e-5, atol=1e-6)
+    # mean of micro losses == full-batch loss (equal micro sizes)
+    np.testing.assert_allclose(
+        float(m_acc["loss"]), float(m_full["loss"]), rtol=1e-5
+    )
+    np.testing.assert_allclose(
+        float(m_acc["top1"]), float(m_full["top1"]), rtol=1e-5
+    )
+
+
+def test_accum_rejects_indivisible_batch():
+    from distribuuuu_tpu.parallel import mesh as mesh_lib, sharding as sharding_lib
+
+    config.reset_cfg()
+    mesh = mesh_lib.build_mesh()
+    batch = {
+        "image": np.zeros((16, 8, 8, 3), np.float32),
+        "label": np.zeros((16,), np.int32),
+        "mask": np.ones((16,), np.float32),
+    }
+    with pytest.raises(ValueError, match="not divisible"):
+        sharding_lib.shard_micro_batch(mesh, batch, accum=3)
+
+
+def test_train_model_fails_fast_on_indivisible_accum(tmp_path):
+    from distribuuuu_tpu import trainer
+
+    config.reset_cfg()
+    cfg.MODEL.ARCH = "resnet18"
+    cfg.MODEL.NUM_CLASSES = 10
+    cfg.MODEL.DUMMY_INPUT = True
+    cfg.TRAIN.BATCH_SIZE = 2  # per-host 16 on the 8-device mesh
+    cfg.TRAIN.IM_SIZE = 32
+    cfg.TRAIN.GRAD_ACCUM_STEPS = 3  # 16 % 3 != 0 → refuse before compiling
+    cfg.OUT_DIR = str(tmp_path)
+    with pytest.raises(ValueError, match="GRAD_ACCUM_STEPS"):
+        trainer.train_model()
+
+
+def test_train_model_with_grad_accum(tmp_path):
+    from distribuuuu_tpu import trainer
+
+    config.reset_cfg()
+    cfg.MODEL.ARCH = "resnet18"
+    cfg.MODEL.NUM_CLASSES = 10
+    cfg.MODEL.DUMMY_INPUT = True
+    cfg.OPTIM.MAX_EPOCH = 1
+    cfg.TRAIN.BATCH_SIZE = 2
+    cfg.TRAIN.IM_SIZE = 32
+    cfg.TRAIN.PRINT_FREQ = 4
+    cfg.TRAIN.GRAD_ACCUM_STEPS = 2  # 16-sample global batch → 2 micro-batches
+    cfg.TEST.BATCH_SIZE = 4
+    cfg.TEST.IM_SIZE = 32
+    cfg.RNG_SEED = 1
+    cfg.DEVICE.COMPUTE_DTYPE = "float32"
+    cfg.OUT_DIR = str(tmp_path)
+    best = trainer.train_model()
+    assert best > 50.0
