@@ -622,6 +622,16 @@ def train_model():
             f"{jax.local_device_count()} local chips = {per_host_batch} "
             f"per host, not divisible by TRAIN.GRAD_ACCUM_STEPS={accum}"
         )
+    global_micro = per_host_batch * jax.process_count() // accum
+    data_size = dict(mesh.shape).get("data", 1)
+    if accum > 1 and global_micro % data_size:
+        raise ValueError(
+            f"micro-batch {global_micro} (global batch "
+            f"{per_host_batch * jax.process_count()} / "
+            f"TRAIN.GRAD_ACCUM_STEPS={accum}) does not shard over the "
+            f"data axis of size {data_size}; raise TRAIN.BATCH_SIZE or "
+            "lower GRAD_ACCUM_STEPS"
+        )
 
     model = build_model_from_cfg()
     state = create_train_state(model, key, mesh, cfg.TRAIN.IM_SIZE)

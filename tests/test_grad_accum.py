@@ -100,6 +100,11 @@ def test_train_model_fails_fast_on_indivisible_accum(tmp_path):
     with pytest.raises(ValueError, match="GRAD_ACCUM_STEPS"):
         trainer.train_model()
 
+    # divisible by accum but the micro-batch can't shard over data=8
+    cfg.TRAIN.GRAD_ACCUM_STEPS = 16  # micro = 16/16 = 1 sample < 8 shards
+    with pytest.raises(ValueError, match="data axis"):
+        trainer.train_model()
+
 
 def test_train_model_with_grad_accum(tmp_path):
     from distribuuuu_tpu import trainer

@@ -77,6 +77,9 @@ def test_two_process_training(tmp_path):
             COORDINATOR_PORT=str(port),
             WORLD_SIZE="2",
             RANK=str(rank),
+            # the worker script lives in tmp_path, so the repo root is not
+            # on its sys.path (script dir ≠ cwd); put the package in reach
+            PYTHONPATH=REPO + os.pathsep + os.environ.get("PYTHONPATH", ""),
         )
         log = open(tmp_path / f"rank{rank}.log", "w+")
         logs.append(log)
