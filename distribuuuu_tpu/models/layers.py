@@ -70,6 +70,7 @@ class StemConv7x7(nn.Module):
         kernel = self.param(
             "kernel", conv_kernel_init, (7, 7, cin, self.features), jnp.float32
         ).astype(self.dtype)
+        x = x.astype(self.dtype)  # lax.conv requires matching dtypes
         dn = ("NHWC", "HWIO", "NHWC")
         if not self.s2d or x.shape[1] % 2 or x.shape[2] % 2:
             return jax.lax.conv_general_dilated(
@@ -88,12 +89,65 @@ class StemConv7x7(nn.Module):
         )
 
 
+class UnrolledGroupConv(nn.Module):
+    """Grouped conv computed as per-group slices of ONE canonical kernel.
+
+    XLA:TPU lowers ``feature_group_count`` convs through physical
+    channel-retiling reshapes+copies — ~30% of a RegNetY-16GF train step
+    (PERF.md). Slicing into per-group convs on the SAME ``(kh, kw, in/G,
+    out)`` parameter avoids the retiling: measured 4.35→2.93 ms fwd+bwd on
+    the [64,14,14,1232]/G=11 stage-3 block, and identical math up to bf16
+    summation order. Only profitable when each group is MXU-wide — ConvBN
+    auto-selects this path at per-group width ≥ 64 (RegNets qualify,
+    ResNeXt's 4/8-wide groups do not).
+    """
+
+    features: int
+    kernel_size: tuple[int, int]
+    strides: Any
+    padding: Any
+    groups: int
+    dtype: Any = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x):
+        kh, kw = self.kernel_size
+        # the loud divisibility guard nn.Conv would otherwise provide
+        assert x.shape[-1] % self.groups == 0 and (
+            self.features % self.groups == 0
+        ), (
+            f"channels in={x.shape[-1]} out={self.features} must divide "
+            f"groups={self.groups}"
+        )
+        cg = x.shape[-1] // self.groups
+        fg = self.features // self.groups
+        kernel = self.param(
+            "kernel", conv_kernel_init, (kh, kw, cg, self.features), jnp.float32
+        ).astype(self.dtype)
+        x = x.astype(self.dtype)  # lax.conv requires matching dtypes
+        s = self.strides
+        strides = s if isinstance(s, (tuple, list)) else (s, s)
+        outs = [
+            jax.lax.conv_general_dilated(
+                x[..., g * cg : (g + 1) * cg],
+                kernel[..., g * fg : (g + 1) * fg],
+                strides,
+                self.padding,
+                dimension_numbers=("NHWC", "HWIO", "NHWC"),
+            )
+            for g in range(self.groups)
+        ]
+        return jnp.concatenate(outs, axis=-1)
+
+
 class ConvBN(nn.Module):
     """Conv2D (no bias) + BatchNorm, the zoo's basic unit.
 
     ``s2d_stem=True`` (7×7/s2 stems only) swaps the conv computation for the
-    space-to-depth path of :class:`StemConv7x7`; the explicit submodule name
-    keeps the param at the same ``ConvBN_*/Conv_0/kernel`` path either way.
+    space-to-depth path of :class:`StemConv7x7`; wide grouped convs route
+    through :class:`UnrolledGroupConv`. In every case the explicit submodule
+    name keeps the param at the same ``ConvBN_*/Conv_0/kernel`` path with
+    the same shape, so checkpoints are compute-path-independent.
     """
 
     features: int
@@ -122,6 +176,11 @@ class ConvBN(nn.Module):
                 and list(map(tuple, pad)) == [(3, 3), (3, 3)]
             ), "s2d_stem is specifically the 7x7/s2/pad-3 ungrouped stem"
             x = StemConv7x7(self.features, dtype=self.dtype, name="Conv_0")(x)
+        elif self.groups > 1 and x.shape[-1] // self.groups >= 64:
+            x = UnrolledGroupConv(
+                self.features, tuple(k), self.strides, pad, self.groups,
+                dtype=self.dtype, name="Conv_0",
+            )(x)
         else:
             x = nn.Conv(
                 self.features,

@@ -1,0 +1,100 @@
+"""UnrolledGroupConv (the TPU-friendly grouped-conv path in ConvBN): same
+canonical parameter as the fused feature_group_count lowering, same outputs,
+and the width-based auto-selection."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+from distribuuuu_tpu.models.layers import ConvBN
+
+
+def _conv_bn(groups, features=256):
+    return ConvBN(
+        features, (3, 3), 1, groups=groups, use_bn=False, dtype=jnp.float32
+    )
+
+
+def test_unrolled_matches_fused_lowering():
+    mod = _conv_bn(groups=4)  # 256/4 = 64 per group → unrolled path
+    x = jnp.asarray(
+        np.random.default_rng(0).standard_normal((2, 8, 8, 256)), jnp.float32
+    )
+    variables = mod.init(jax.random.key(0), x)
+    out = mod.apply(variables, x)
+
+    kernel = variables["params"]["Conv_0"]["kernel"]
+    kernel = getattr(kernel, "unbox", lambda: kernel)()
+    assert kernel.shape == (3, 3, 64, 256)  # (kh, kw, in/G, out) — fused shape
+    ref = lax.conv_general_dilated(
+        x, kernel, (1, 1), [(1, 1), (1, 1)], feature_group_count=4,
+        dimension_numbers=("NHWC", "HWIO", "NHWC"),
+    )
+    np.testing.assert_allclose(
+        np.asarray(out), np.asarray(ref), rtol=1e-5, atol=1e-5
+    )
+
+
+def test_width_gate_selects_the_right_path():
+    """The ≥64-per-group gate: narrow (ResNeXt-style) groups stay on
+    nn.Conv, wide (RegNet-style) groups go unrolled. Inspect the actual
+    submodule types — both paths share param path/shape/output by design,
+    so only the module tree reveals the selection."""
+    kw = dict(console_kwargs={"width": 400})
+    x_narrow = jnp.ones((1, 4, 4, 256), jnp.float32)
+    types_narrow = str(
+        _conv_bn(groups=32).tabulate(jax.random.key(0), x_narrow, **kw)
+    )  # 8 per group
+    assert "UnrolledGroupConv" not in types_narrow
+
+    x_wide = jnp.ones((1, 4, 4, 256), jnp.float32)
+    types_wide = str(
+        _conv_bn(groups=4).tabulate(jax.random.key(0), x_wide, **kw)
+    )
+    assert "UnrolledGroupConv" in types_wide
+
+    # and the narrow path still runs
+    mod = _conv_bn(groups=32)
+    variables = mod.init(jax.random.key(0), x_narrow)
+    kernel = variables["params"]["Conv_0"]["kernel"]
+    kernel = getattr(kernel, "unbox", lambda: kernel)()
+    assert kernel.shape == (3, 3, 8, 256)
+    assert mod.apply(variables, x_narrow).shape == (1, 4, 4, 256)
+
+
+def test_group_conv_checkpoint_compatible_across_widths():
+    """The same variables drive both paths — param tree does not depend on
+    which compute path ConvBN picks (verified by cross-applying)."""
+    wide = _conv_bn(groups=2)    # unrolled
+    x = jnp.asarray(
+        np.random.default_rng(1).standard_normal((2, 8, 8, 256)), jnp.float32
+    )
+    variables = wide.init(jax.random.key(0), x)
+    kernel = variables["params"]["Conv_0"]["kernel"]
+    kernel = getattr(kernel, "unbox", lambda: kernel)()
+    ref = lax.conv_general_dilated(
+        x, kernel, (1, 1), [(1, 1), (1, 1)], feature_group_count=2,
+        dimension_numbers=("NHWC", "HWIO", "NHWC"),
+    )
+    np.testing.assert_allclose(
+        np.asarray(wide.apply(variables, x)), np.asarray(ref),
+        rtol=1e-5, atol=1e-5,
+    )
+
+
+def test_regnet_forward_still_correct():
+    """RegNet (the arch the auto-selection targets) still runs and keeps its
+    published param count (oracle: SURVEY.md §6 — 83.590M for regnety_160)."""
+    from distribuuuu_tpu import models
+    from distribuuuu_tpu.utils.metrics import count_parameters
+
+    model = models.build_model(
+        "regnety_160", num_classes=1000, dtype=jnp.float32
+    )
+    x = jnp.ones((1, 64, 64, 3), jnp.float32)
+    variables = jax.eval_shape(
+        lambda k: model.init(k, x, train=False), jax.random.key(0)
+    )
+    m_params, _ = count_parameters(variables["params"])
+    assert abs(m_params - 83.590) < 0.01
