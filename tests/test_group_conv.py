@@ -83,6 +83,34 @@ def test_group_conv_checkpoint_compatible_across_widths():
     )
 
 
+def test_unrolled_group_conv_composes_with_tensor_parallel():
+    """The unrolled path slices the kernel's OUT dim, which TP shards over
+    `model` — GSPMD must resolve slice-across-shard without error."""
+    import distribuuuu_tpu.config as config
+    from distribuuuu_tpu import trainer
+    from distribuuuu_tpu.config import cfg
+    from distribuuuu_tpu.parallel import mesh as mesh_lib, sharding as sharding_lib
+    from distribuuuu_tpu.utils.optim import construct_optimizer
+
+    config.reset_cfg()
+    cfg.MODEL.ARCH = "regnety_160"
+    cfg.MODEL.NUM_CLASSES = 10
+    cfg.DEVICE.COMPUTE_DTYPE = "float32"
+    cfg.MESH.DATA, cfg.MESH.MODEL = 4, 2
+    mesh = mesh_lib.build_mesh(data=4, model=2)
+    model = trainer.build_model_from_cfg()
+    state = trainer.create_train_state(model, jax.random.key(0), mesh, 64)
+    step = trainer.make_train_step(model, construct_optimizer(), 5)
+    rng = np.random.default_rng(0)
+    hb = {
+        "image": rng.standard_normal((8, 64, 64, 3)).astype(np.float32),
+        "label": rng.integers(0, 10, size=(8,)).astype(np.int32),
+        "mask": np.ones((8,), np.float32),
+    }
+    state, m = step(state, sharding_lib.shard_batch(mesh, hb))
+    assert np.isfinite(float(m["loss"]))
+
+
 def test_regnet_forward_still_correct():
     """RegNet (the arch the auto-selection targets) still runs and keeps its
     published param count (oracle: SURVEY.md §6 — 83.590M for regnety_160)."""
