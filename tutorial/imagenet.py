@@ -45,6 +45,11 @@ dataset labels everything class 0, so the model learns it instantly):
 from __future__ import annotations
 
 import os
+import sys
+
+# repo root onto sys.path so `python tutorial/<name>.py` works from anywhere
+# (a script's sys.path[0] is tutorial/, not the repo root)
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 import shutil
 
 # Demo-friendly: when forced onto CPU (JAX_PLATFORMS=cpu), present a virtual

@@ -40,6 +40,11 @@ Expected output (8 virtual CPU devices, synthetic data, seed 0):
 from __future__ import annotations
 
 import os
+import sys
+
+# repo root onto sys.path so `python tutorial/<name>.py` works from anywhere
+# (a script's sys.path[0] is tutorial/, not the repo root)
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 import jax
 

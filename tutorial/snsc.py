@@ -35,6 +35,12 @@ the easy synthetic labels are learned almost immediately):
 
 from __future__ import annotations
 
+import os
+import sys
+
+# repo root onto sys.path so `python tutorial/<name>.py` works from anywhere
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
 import time
 
 import jax
