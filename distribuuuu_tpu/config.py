@@ -266,8 +266,11 @@ _C.DEVICE.PLATFORM = "auto"
 _C.DEVICE.COMPUTE_DTYPE = "bfloat16"
 # Deterministic XLA ops (maps CUDNN.DETERMINISTIC intent onto TPU).
 _C.DEVICE.DETERMINISTIC = False
-# Attention implementation for attention archs: "auto" | "xla" | "pallas".
-# "auto" resolves per measurement (see ops/pallas_attention.use_pallas).
+# Attention implementation for attention archs. BoTNet: "auto" | "xla" |
+# "pallas" ("auto" resolves per measurement, ops/pallas_attention.use_pallas).
+# ViT additionally accepts "blockwise": exact attention in O(L·chunk) memory
+# (ops/ring_attention.blockwise_attention) for high-resolution inputs on a
+# single chip; MESH.SEQ>1 overrides with ring attention over the mesh.
 _C.DEVICE.ATTN_IMPL = "auto"
 # Space-to-depth stem for the 7x7/s2-stem archs (resnet/resnext/wide_resnet/
 # botnet): compute the stem as a 4x4/s1 conv over 2x2-block-folded input

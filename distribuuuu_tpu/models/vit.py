@@ -47,20 +47,20 @@ class Attention(nn.Module):
     num_heads: int
     dropout: float
     dtype: Any
-    attn_impl: str = "xla"  # "xla" | "ring" | "ulysses"
+    attn_impl: str = "xla"  # "xla" | "blockwise" | "ring" | "ulysses"
     mesh: Any = None        # required for ring/ulysses
 
     @nn.compact
     def __call__(self, x, train: bool = False):
-        if self.attn_impl not in ("xla", "ring", "ulysses"):
+        if self.attn_impl not in ("xla", "blockwise", "ring", "ulysses"):
             raise ValueError(
-                f"vit attn_impl must be 'xla', 'ring', or 'ulysses'; "
-                f"got {self.attn_impl!r}"
+                f"vit attn_impl must be 'xla', 'blockwise', 'ring', or "
+                f"'ulysses'; got {self.attn_impl!r}"
             )
         if self.attn_impl != "xla" and self.dropout > 0:
             raise ValueError(
                 "attention-probability dropout is not supported under "
-                "sequence-sharded attention (ring/ulysses); set dropout=0 or "
+                "blockwise/sequence-sharded attention; set dropout=0 or "
                 "use attn_impl='xla'"
             )
         B, S, _ = x.shape
@@ -80,6 +80,11 @@ class Attention(nn.Module):
                 else ra.ulysses_attention
             )
             out = fn(q, k, v, self.mesh, causal=False)
+        elif self.attn_impl == "blockwise":
+            from distribuuuu_tpu.ops import ring_attention as ra
+
+            # O(L·chunk) memory — high-resolution single-chip training
+            out = ra.blockwise_attention(q, k, v, causal=False)
         else:
             scale = D ** -0.5
             s = jnp.einsum(

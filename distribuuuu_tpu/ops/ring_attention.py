@@ -191,6 +191,62 @@ def ulysses_attention(
     )(q, k, v)
 
 
+def blockwise_attention(q, k, v, *, chunk: int = 256, causal: bool = False,
+                        scale: float | None = None, remat: bool = True):
+    """Single-device flash-style attention: exact softmax in O(L·chunk)
+    memory instead of the dense path's O(L²) logits (Rabe & Staats,
+    arXiv:2112.05682; the single-chip sibling of ring attention — same
+    ``_block_update`` online-softmax math, ``lax.scan`` over local K/V
+    chunks instead of ``ppermute`` hops around a mesh ring).
+
+    This is what makes high-resolution ViT trainable on one chip: at
+    L=4096 the dense attention materializes ~L²·H·B bf16 logits per layer
+    (hundreds of MB) while this keeps only the running (m, l, o) state plus
+    one [L, chunk] block. ``remat=True`` recomputes each chunk's block in
+    the backward pass, so autodiff never stores the probabilities either.
+
+    q, k: [B, H, L, D]; v: [B, H, L, Dv]. Returns [B, H, L, Dv] in v.dtype.
+    """
+    b, h, L, d = q.shape
+    dv = v.shape[-1]
+    scale = d ** -0.5 if scale is None else scale
+    chunk = min(chunk, L)
+    nc = -(-L // chunk)
+    pad = nc * chunk - L
+    kp = jnp.pad(k, ((0, 0), (0, 0), (0, pad), (0, 0)))
+    vp = jnp.pad(v, ((0, 0), (0, 0), (0, pad), (0, 0)))
+    # [nc, B, H, chunk, D] so scan slices one K/V chunk per step
+    ks = jnp.moveaxis(kp.reshape(b, h, nc, chunk, d), 2, 0)
+    vs = jnp.moveaxis(vp.reshape(b, h, nc, chunk, dv), 2, 0)
+
+    qf = q.astype(jnp.float32)
+    q_pos = jnp.arange(L)
+    m0 = jnp.full((b, h, L), _NEG_BIG, jnp.float32)
+    l0 = jnp.zeros((b, h, L), jnp.float32)
+    o0 = jnp.zeros((b, h, L, dv), jnp.float32)
+    need_pad_mask = pad > 0
+
+    def step(carry, inp):
+        m, l, o = carry
+        idx, kb, vb = inp
+        k_pos = idx * chunk + jnp.arange(chunk)
+        mask = None
+        if causal or need_pad_mask:
+            mask = jnp.broadcast_to((k_pos < L)[None, :], (L, chunk))
+            if causal:
+                mask = mask & (q_pos[:, None] >= k_pos[None, :])
+        m, l, o = _block_update(
+            qf, kb.astype(jnp.float32), vb, m, l, o, scale, mask
+        )
+        return (m, l, o), None
+
+    step_fn = jax.checkpoint(step) if remat else step
+    (m, l, o), _ = jax.lax.scan(
+        step_fn, (m0, l0, o0), (jnp.arange(nc), ks, vs)
+    )
+    return (o / jnp.maximum(l, 1e-30)[..., None]).astype(v.dtype)
+
+
 def reference_attention(q, k, v, *, causal: bool = False,
                         scale: float | None = None):
     """Single-device exact attention — the numerics oracle for the tests."""

@@ -95,10 +95,26 @@ def build_model_from_cfg():
         kwargs["attn_impl"] = cfg.DEVICE.ATTN_IMPL
     if cfg.MODEL.ARCH.startswith("vit"):
         # MESH.SEQ>1 means sequence-sharded attention: route through ring
-        # attention over the seq axis (dense XLA attention otherwise)
+        # attention over the seq axis. On a single chip,
+        # DEVICE.ATTN_IMPL=blockwise selects O(L·chunk)-memory exact
+        # attention (ops.ring_attention.blockwise_attention) for
+        # high-resolution inputs. Dense XLA attention otherwise.
         if cfg.MESH.SEQ not in (0, 1, -1):
             kwargs["attn_impl"] = "ring"
             kwargs["mesh"] = mesh_lib.mesh_from_cfg(cfg)
+        elif cfg.DEVICE.ATTN_IMPL == "blockwise":
+            kwargs["attn_impl"] = "blockwise"
+        elif cfg.DEVICE.ATTN_IMPL in ("ring", "ulysses"):
+            raise ValueError(
+                f"DEVICE.ATTN_IMPL={cfg.DEVICE.ATTN_IMPL!r} needs a "
+                "sequence-sharded mesh: set MESH.SEQ > 1"
+            )
+        elif cfg.DEVICE.ATTN_IMPL not in ("auto", "xla"):
+            raise ValueError(
+                f"DEVICE.ATTN_IMPL={cfg.DEVICE.ATTN_IMPL!r}: ViT archs "
+                "accept 'auto'/'xla' (dense), 'blockwise', or MESH.SEQ>1 "
+                "for ring attention"
+            )
     return models.build_model(cfg.MODEL.ARCH, **kwargs)
 
 
