@@ -190,10 +190,29 @@ _C.MODEL = CfgNode()
 _C.MODEL.ARCH = "resnet18"
 _C.MODEL.NUM_CLASSES = 1000
 _C.MODEL.PRETRAINED = False
+# BatchNorm statistic regime. SYNCBN True ⇒ stats over the GLOBAL batch
+# (cross-replica, ≙ torch SyncBatchNorm, ref: trainer.py:131). False (the
+# reference default — every published baseline) ⇒ "ghost" BN: stats over
+# independent BN_GROUP-sample groups, reproducing the reference's per-GPU
+# statistics on any chip count.
 _C.MODEL.SYNCBN = False
+# Ghost-BN group size when SYNCBN is False. 0 ⇒ TRAIN.BATCH_SIZE (the
+# per-chip batch — exactly the reference's per-GPU BN batch). Must divide
+# the (micro-)batch each training forward sees.
+_C.MODEL.BN_GROUP = 0
 _C.MODEL.WEIGHTS = None
 # Use randomly generated fake data (no dataset on disk needed).
 _C.MODEL.DUMMY_INPUT = False
+# Mixture-of-experts knobs for the *_moe archs (ops/moe.py expert
+# parallelism over the ``model`` mesh axis).
+_C.MODEL.MOE = CfgNode()
+_C.MODEL.MOE.NUM_EXPERTS = 8
+_C.MODEL.MOE.TOP_K = 2
+# Every Nth block gets the MoE FFN (2 = the GShard/ViT-MoE placement).
+_C.MODEL.MOE.EVERY = 2
+# λ for the switch-transformer load-balancing aux loss added to the task
+# loss (0 disables; without it top-k routing collapses onto few experts).
+_C.MODEL.MOE.AUX_WEIGHT = 0.01
 
 # ------------------------------- training ----------------------------------
 _C.TRAIN = CfgNode()
@@ -287,6 +306,9 @@ _C.MESH.DATA = -1
 _C.MESH.MODEL = 1
 _C.MESH.SEQ = 1
 _C.MESH.PIPE = 1
+# GPipe microbatches per step when PIPE > 1 (parallel/pp.py schedule);
+# 0 → 2 × PIPE. The per-data-shard batch must divide by it.
+_C.MESH.MICROBATCH = 0
 
 # ------------------------------- data pipeline -------------------------------
 _C.DATA = CfgNode()

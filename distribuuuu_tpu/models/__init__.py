@@ -33,7 +33,11 @@ from distribuuuu_tpu.models.regnet import (  # noqa: F401
     regnety_320,
 )
 from distribuuuu_tpu.models.efficientnet import efficientnet_b0  # noqa: F401
-from distribuuuu_tpu.models.vit import vit_small, vit_tiny  # noqa: F401
+from distribuuuu_tpu.models.vit import (  # noqa: F401
+    vit_small,
+    vit_tiny,
+    vit_tiny_moe,
+)
 
 _REGISTRY = {}
 
@@ -65,6 +69,8 @@ for _fn in (
     # TPU-native extensions (no reference analogue): seq-parallel-capable ViT
     vit_tiny,
     vit_small,
+    # expert-parallel MoE variant (ops/moe.py over the model axis)
+    vit_tiny_moe,
 ):
     register_model(_fn)
 

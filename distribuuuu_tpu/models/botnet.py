@@ -106,17 +106,20 @@ class BoTBlock(nn.Module):
     downsample: bool = False
     attn_impl: str = "auto"
     dtype: Any = jnp.bfloat16
+    bn_group: int = 0
 
     @nn.compact
     def __call__(self, x, train: bool = False):
         if self.downsample:
             shortcut = ConvBN(
-                self.dim_out, (1, 1), self.strides, dtype=self.dtype, act=nn.relu
+                self.dim_out, (1, 1), self.strides, dtype=self.dtype,
+                act=nn.relu, bn_group=self.bn_group,
             )(x, train=train)
         else:
             shortcut = x
         width = self.dim_out // self.proj_factor
-        out = ConvBN(width, (1, 1), 1, dtype=self.dtype, act=nn.relu)(x, train=train)
+        out = ConvBN(width, (1, 1), 1, dtype=self.dtype, act=nn.relu,
+                     bn_group=self.bn_group)(x, train=train)
         out = MHSA2D(
             fmap_size=self.fmap_size,
             heads=self.heads,
@@ -128,12 +131,12 @@ class BoTBlock(nn.Module):
         )(out)
         if self.strides == 2:
             out = nn.avg_pool(out, (2, 2), strides=(2, 2))
-        out = BatchNorm(dtype=self.dtype)(out, train=train)
+        out = BatchNorm(dtype=self.dtype, group_size=self.bn_group)(out, train=train)
         out = nn.relu(out)
         # zero-γ last BN (ref: botnet.py:151-153)
         out = ConvBN(
             self.dim_out, (1, 1), 1, dtype=self.dtype,
-            bn_scale_init=nn.initializers.zeros,
+            bn_scale_init=nn.initializers.zeros, bn_group=self.bn_group,
         )(out, train=train)
         return nn.relu(out + shortcut)
 
@@ -145,6 +148,7 @@ class BoTNet50(nn.Module):
     fmap_size: tuple[int, int] = (14, 14)
     attn_impl: str = "auto"
     dtype: Any = jnp.bfloat16
+    bn_group: int = 0
     s2d_stem: bool = False
 
     @nn.compact
@@ -152,7 +156,7 @@ class BoTNet50(nn.Module):
         x = x.astype(self.dtype)
         x = ConvBN(
             64, (7, 7), 2, padding=[(3, 3), (3, 3)], dtype=self.dtype,
-            act=nn.relu, s2d_stem=self.s2d_stem,
+            act=nn.relu, s2d_stem=self.s2d_stem, bn_group=self.bn_group,
         )(x, train=train)
         x = max_pool_3x3_s2(x)
         for stage, (feats, n_blocks) in enumerate(zip((64, 128, 256), (3, 4, 6))):
@@ -164,6 +168,7 @@ class BoTNet50(nn.Module):
                     strides=s,
                     downsample=(i == 0),
                     dtype=self.dtype,
+                    bn_group=self.bn_group,
                 )(x, train=train)
         # BoTStack: dim 1024 -> 2048, stride 1, rel pos (ref: botnet.py:283)
         for i in range(3):
@@ -175,6 +180,7 @@ class BoTNet50(nn.Module):
                 downsample=(i == 0),
                 attn_impl=self.attn_impl,
                 dtype=self.dtype,
+                bn_group=self.bn_group,
             )(x, train=train)
         x = global_avg_pool(x)
         return Dense(self.num_classes, dtype=jnp.float32)(x.astype(jnp.float32))

@@ -34,17 +34,18 @@ class DenseLayer(nn.Module):
     growth_rate: int
     bn_size: int = 4
     dtype: Any = jnp.bfloat16
+    bn_group: int = 0
 
     @nn.compact
     def __call__(self, x, train: bool = False):
-        out = BatchNorm(dtype=self.dtype)(x, train=train)
+        out = BatchNorm(dtype=self.dtype, group_size=self.bn_group)(x, train=train)
         out = nn.relu(out)
         out = nn.Conv(
             self.bn_size * self.growth_rate, (1, 1), use_bias=False,
             dtype=self.dtype, param_dtype=jnp.float32,
             kernel_init=conv_kernel_init,
         )(out)
-        out = BatchNorm(dtype=self.dtype)(out, train=train)
+        out = BatchNorm(dtype=self.dtype, group_size=self.bn_group)(out, train=train)
         out = nn.relu(out)
         out = nn.Conv(
             self.growth_rate, (3, 3), padding=[(1, 1), (1, 1)], use_bias=False,
@@ -64,6 +65,7 @@ class DenseNet(nn.Module):
     num_classes: int = 1000
     memory_efficient: bool = False
     dtype: Any = jnp.bfloat16
+    bn_group: int = 0
     s2d_stem: bool = False
 
     @nn.compact
@@ -75,7 +77,7 @@ class DenseNet(nn.Module):
             self.num_init_features, s2d=self.s2d_stem, dtype=self.dtype,
             name="Conv_0",
         )(x)
-        x = BatchNorm(dtype=self.dtype)(x, train=train)
+        x = BatchNorm(dtype=self.dtype, group_size=self.bn_group)(x, train=train)
         x = nn.relu(x)
         x = max_pool_3x3_s2(x)
 
@@ -94,13 +96,14 @@ class DenseNet(nn.Module):
                     growth_rate=self.growth_rate,
                     bn_size=self.bn_size,
                     dtype=self.dtype,
+                    bn_group=self.bn_group,
                     name=f"block{i}_layer{j}",
                 )(x, train)
                 x = jnp.concatenate([x, new], axis=-1)
                 num_features += self.growth_rate
             if i != len(self.block_config) - 1:
                 # transition: BN→relu→1x1(half)→avgpool2 (ref: densenet.py:151-166)
-                x = BatchNorm(dtype=self.dtype)(x, train=train)
+                x = BatchNorm(dtype=self.dtype, group_size=self.bn_group)(x, train=train)
                 x = nn.relu(x)
                 num_features = num_features // 2
                 # explicit Conv_{i+1}: the stem occupies the "Conv_0" name,
@@ -112,7 +115,7 @@ class DenseNet(nn.Module):
                 )(x)
                 x = nn.avg_pool(x, (2, 2), strides=(2, 2))
 
-        x = BatchNorm(dtype=self.dtype)(x, train=train)
+        x = BatchNorm(dtype=self.dtype, group_size=self.bn_group)(x, train=train)
         x = nn.relu(x)
         x = global_avg_pool(x)
         return Dense(self.num_classes, dtype=jnp.float32)(x.astype(jnp.float32))

@@ -36,9 +36,10 @@ _B0_BLOCKS = (
 )
 
 
-def _BN(dtype):
+def _BN(dtype, bn_group=0):
     # torch momentum 0.01 ⇒ flax momentum 0.99; eps 1e-3 (EfficientNet BN)
-    return BatchNorm(dtype=dtype, momentum=0.99, epsilon=1e-3)
+    return BatchNorm(dtype=dtype, momentum=0.99, epsilon=1e-3,
+                     group_size=bn_group)
 
 
 def _conv(features, kernel, strides=1, groups=1, dtype=jnp.bfloat16):
@@ -58,6 +59,7 @@ class MBConv(nn.Module):
     strides: int
     kernel: int
     dtype: Any = jnp.bfloat16
+    bn_group: int = 0
 
     @nn.compact
     def __call__(self, x, train: bool = False):
@@ -65,16 +67,16 @@ class MBConv(nn.Module):
         ch = self.in_ch * self.expand_ratio
         if self.expand_ratio != 1:
             x = _conv(ch, 1, dtype=self.dtype)(x)
-            x = _BN(self.dtype)(x, train=train)
+            x = _BN(self.dtype, self.bn_group)(x, train=train)
             x = nn.silu(x)
         x = _conv(ch, self.kernel, self.strides, groups=ch, dtype=self.dtype)(x)
-        x = _BN(self.dtype)(x, train=train)
+        x = _BN(self.dtype, self.bn_group)(x, train=train)
         x = nn.silu(x)
         # SE, reduction relative to block input channels
         se_ch = max(1, self.in_ch // 4)
         x = SqueezeExcite(se_ch, act=nn.silu, dtype=self.dtype)(x)
         x = _conv(self.out_ch, 1, dtype=self.dtype)(x)
-        x = _BN(self.dtype)(x, train=train)
+        x = _BN(self.dtype, self.bn_group)(x, train=train)
         if self.strides == 1 and self.in_ch == self.out_ch:
             x = x + inp
         return x
@@ -87,12 +89,13 @@ class EfficientNet(nn.Module):
     num_classes: int = 1000
     dropout_rate: float = 0.2
     dtype: Any = jnp.bfloat16
+    bn_group: int = 0
 
     @nn.compact
     def __call__(self, x, train: bool = False):
         x = x.astype(self.dtype)
         x = _conv(self.stem_ch, 3, 2, dtype=self.dtype)(x)
-        x = _BN(self.dtype)(x, train=train)
+        x = _BN(self.dtype, self.bn_group)(x, train=train)
         x = nn.silu(x)
         in_ch = self.stem_ch
         for t, c, n, s, k in self.blocks:
@@ -104,10 +107,11 @@ class EfficientNet(nn.Module):
                     strides=s if i == 0 else 1,
                     kernel=k,
                     dtype=self.dtype,
+                    bn_group=self.bn_group,
                 )(x, train=train)
                 in_ch = c
         x = _conv(self.head_ch, 1, dtype=self.dtype)(x)
-        x = _BN(self.dtype)(x, train=train)
+        x = _BN(self.dtype, self.bn_group)(x, train=train)
         x = nn.silu(x)
         x = global_avg_pool(x)
         x = nn.Dropout(self.dropout_rate, deterministic=not train)(x)

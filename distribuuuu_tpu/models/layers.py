@@ -4,12 +4,17 @@ TPU-first conventions used throughout the zoo:
   - NHWC layout (XLA:TPU's native conv layout; torch reference is NCHW).
   - Params in fp32, compute in ``cfg.DEVICE.COMPUTE_DTYPE`` (bfloat16 by
     default) so matmuls/convs hit the MXU at full rate.
-  - BatchNorm statistics are computed over the *global* batch under jit:
+  - BatchNorm supports two statistic regimes (``MODEL.SYNCBN``):
+    ``group_size=0`` computes stats over the *global* batch under jit —
     with the batch sharded over the ``data`` mesh axis XLA inserts the
-    cross-replica reductions automatically, which makes BN behave as
-    SyncBatchNorm (ref: trainer.py:131) by construction. ``MODEL.SYNCBN``
-    therefore changes nothing on TPU; the flag is honored for config
-    compatibility.
+    cross-replica reductions automatically, i.e. SyncBatchNorm
+    (ref: trainer.py:131) by construction. ``group_size=g`` computes
+    "ghost" stats over independent g-sample groups, reproducing the
+    reference's default non-synced regime (every published baseline used
+    ``SYNCBN False`` ⇒ stats over one GPU's 32–64 samples,
+    ref: config/resnet50.yaml). When g equals the per-chip batch the group
+    dim lands on shard boundaries and ghost BN costs *zero* communication —
+    cheaper than the global path, not just different.
 """
 
 from __future__ import annotations
@@ -113,12 +118,12 @@ class UnrolledGroupConv(nn.Module):
     def __call__(self, x):
         kh, kw = self.kernel_size
         # the loud divisibility guard nn.Conv would otherwise provide
-        assert x.shape[-1] % self.groups == 0 and (
-            self.features % self.groups == 0
-        ), (
-            f"channels in={x.shape[-1]} out={self.features} must divide "
-            f"groups={self.groups}"
-        )
+        # (ValueError, not assert: must survive python -O)
+        if x.shape[-1] % self.groups or self.features % self.groups:
+            raise ValueError(
+                f"channels in={x.shape[-1]} out={self.features} must divide "
+                f"groups={self.groups}"
+            )
         cg = x.shape[-1] // self.groups
         fg = self.features // self.groups
         kernel = self.param(
@@ -158,6 +163,7 @@ class ConvBN(nn.Module):
     dtype: Any = jnp.bfloat16
     use_bn: bool = True
     bn_scale_init: Callable = nn.initializers.ones
+    bn_group: int = 0  # ghost-BN group size; 0 = global-batch stats
     act: Callable | None = None
     s2d_stem: bool = False
 
@@ -194,35 +200,127 @@ class ConvBN(nn.Module):
                 kernel_init=conv_kernel_init,
             )(x)
         if self.use_bn:
-            x = BatchNorm(dtype=self.dtype, scale_init=self.bn_scale_init)(
-                x, train=train
-            )
+            x = BatchNorm(
+                dtype=self.dtype,
+                scale_init=self.bn_scale_init,
+                group_size=self.bn_group,
+            )(x, train=train)
         if self.act is not None:
             x = self.act(x)
         return x
 
 
+class _BNCore(nn.Module):
+    """First-party BatchNorm core with ghost (grouped) batch statistics.
+
+    ``group_size == 0`` → stats over the whole (global) batch: under jit
+    with the batch sharded on ``data`` this IS SyncBatchNorm (ref:
+    trainer.py:131). ``group_size == g`` → the batch is viewed as
+    ``(n//g, g, ...)`` and each g-sample group is normalized by its own
+    statistics — the reference's non-synced regime (``SYNCBN False``, BN
+    over one GPU's samples) reproduced exactly, device-count-independently.
+    When g divides the per-shard batch, the group dim lands on shard
+    boundaries and the grouped stats need no cross-device reduction at all.
+
+    torch-matching numerics (ref BN is torch nn.BatchNorm2d):
+    normalization uses biased variance; the running-variance update uses
+    the *unbiased* estimate (×count/(count-1)) — flax's nn.BatchNorm
+    deviates from torch on the latter, which is one reason this core is
+    first-party. Stats/params are fp32 regardless of compute dtype.
+    """
+
+    group_size: int = 0
+    momentum: float = 0.9
+    epsilon: float = 1e-5
+    dtype: Any = jnp.bfloat16
+    scale_init: Callable = nn.initializers.ones
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        feat = x.shape[-1]
+        scale = self.param("scale", self.scale_init, (feat,), jnp.float32)
+        bias = self.param("bias", nn.initializers.zeros, (feat,), jnp.float32)
+        ra_mean = self.variable(
+            "batch_stats", "mean", lambda: jnp.zeros((feat,), jnp.float32)
+        )
+        ra_var = self.variable(
+            "batch_stats", "var", lambda: jnp.ones((feat,), jnp.float32)
+        )
+        if not train:
+            inv = jax.lax.rsqrt(ra_var.value + self.epsilon) * scale
+            y = (x.astype(jnp.float32) - ra_mean.value) * inv + bias
+            return y.astype(self.dtype)
+
+        n = x.shape[0]
+        gs = self.group_size
+        spatial = 1
+        for d in x.shape[1:-1]:
+            spatial *= d
+        xf = x.astype(jnp.float32)
+        # n <= gs degenerates to one group = the whole batch (torch
+        # semantics: a device with fewer samples normalizes over what it
+        # has); only the indivisible case is an error.
+        if gs > 0 and n > gs:
+            if n % gs:
+                raise ValueError(
+                    f"ghost BN group_size={gs} does not divide batch {n}; "
+                    "set MODEL.BN_GROUP to a divisor of the (micro-)batch"
+                )
+            g = n // gs
+            xg = xf.reshape((g, gs) + x.shape[1:])
+            axes = tuple(range(1, xg.ndim - 1))
+            gmean = xg.mean(axes)  # (g, C)
+            gvar = jnp.square(xg).mean(axes) - jnp.square(gmean)  # biased
+            bshape = (g,) + (1,) * (xg.ndim - 2) + (feat,)
+            inv = jax.lax.rsqrt(gvar + self.epsilon).reshape(bshape) * scale
+            y = ((xg - gmean.reshape(bshape)) * inv + bias).reshape(x.shape)
+            count = gs * spatial
+            mean_upd = gmean.mean(0)
+            # running stats average the per-group (unbiased) estimates —
+            # strictly more informative than torch DDP's rank-0-only stats
+            var_upd = gvar.mean(0) * count / max(count - 1, 1)
+        else:
+            axes = tuple(range(x.ndim - 1))
+            mean = xf.mean(axes)
+            var = jnp.square(xf).mean(axes) - jnp.square(mean)
+            inv = jax.lax.rsqrt(var + self.epsilon) * scale
+            y = (xf - mean) * inv + bias
+            count = n * spatial
+            mean_upd, var_upd = mean, var * count / max(count - 1, 1)
+        if not self.is_initializing():
+            m = self.momentum
+            ra_mean.value = m * ra_mean.value + (1.0 - m) * mean_upd
+            ra_var.value = m * ra_var.value + (1.0 - m) * var_upd
+        return y.astype(self.dtype)
+
+
 class BatchNorm(nn.Module):
     """BatchNorm with torch-matching hyperparams (torch momentum 0.1 == flax
-    momentum 0.9, eps 1e-5 by default; EfficientNet overrides). Stats/params
-    are fp32 regardless of compute dtype; `train` selects batch stats vs
-    running averages."""
+    momentum 0.9, eps 1e-5 by default; EfficientNet overrides). ``train``
+    selects batch stats vs running averages; ``group_size`` selects ghost
+    (per-group) vs global batch statistics — see :class:`_BNCore`.
+
+    The core sits under the fixed child name ``BatchNorm_0`` so variable
+    paths (``.../BatchNorm_0/{scale,bias}`` + batch_stats ``{mean,var}``)
+    are stable across core implementations (checkpoints and torch
+    ingestion address them)."""
 
     dtype: Any = jnp.bfloat16
     scale_init: Callable = nn.initializers.ones
     momentum: float = 0.9
     epsilon: float = 1e-5
+    group_size: int = 0
 
     @nn.compact
     def __call__(self, x, train: bool = False):
-        return nn.BatchNorm(
-            use_running_average=not train,
+        return _BNCore(
+            group_size=self.group_size,
             momentum=self.momentum,
             epsilon=self.epsilon,
             dtype=self.dtype,
-            param_dtype=jnp.float32,
             scale_init=self.scale_init,
-        )(x)
+            name="BatchNorm_0",
+        )(x, train=train)
 
 
 class SqueezeExcite(nn.Module):

@@ -54,26 +54,30 @@ class RegNetBlock(nn.Module):
     se_width: int = 0  # 0 = X block (no SE)
     downsample: bool = False
     dtype: Any = jnp.bfloat16
+    bn_group: int = 0
 
     @nn.compact
     def __call__(self, x, train: bool = False):
         shortcut = x
         if self.downsample:
-            shortcut = ConvBN(self.width, (1, 1), self.strides, dtype=self.dtype)(
+            shortcut = ConvBN(self.width, (1, 1), self.strides, dtype=self.dtype,
+                               bn_group=self.bn_group)(
                 x, train=train
             )
-        out = ConvBN(self.width, (1, 1), 1, dtype=self.dtype, act=nn.relu)(
+        out = ConvBN(self.width, (1, 1), 1, dtype=self.dtype, act=nn.relu,
+                     bn_group=self.bn_group)(
             x, train=train
         )
         out = ConvBN(
             self.width, (3, 3), self.strides,
             groups=self.width // self.group_width, dtype=self.dtype, act=nn.relu,
+            bn_group=self.bn_group,
         )(out, train=train)
         if self.se_width > 0:
             out = SqueezeExcite(self.se_width, dtype=self.dtype)(out)
         out = ConvBN(
             self.width, (1, 1), 1, dtype=self.dtype,
-            bn_scale_init=nn.initializers.zeros,
+            bn_scale_init=nn.initializers.zeros, bn_group=self.bn_group,
         )(out, train=train)
         return nn.relu(out + shortcut)
 
@@ -88,11 +92,13 @@ class RegNet(nn.Module):
     num_classes: int = 1000
     stem_w: int = 32
     dtype: Any = jnp.bfloat16
+    bn_group: int = 0
 
     @nn.compact
     def __call__(self, x, train: bool = False):
         x = x.astype(self.dtype)
-        x = ConvBN(self.stem_w, (3, 3), 2, dtype=self.dtype, act=nn.relu)(
+        x = ConvBN(self.stem_w, (3, 3), 2, dtype=self.dtype, act=nn.relu,
+                   bn_group=self.bn_group)(
             x, train=train
         )
         widths, depths = generate_widths(self.w_a, self.w_0, self.w_m, self.depth)
@@ -108,6 +114,7 @@ class RegNet(nn.Module):
                     se_width=se_w,
                     downsample=(i == 0),
                     dtype=self.dtype,
+                    bn_group=self.bn_group,
                 )(x, train=train)
                 in_w = w
         x = global_avg_pool(x)

@@ -39,6 +39,7 @@ class BasicBlock(nn.Module):
     base_width: int = 64
     zero_init_residual: bool = False
     dtype: Any = jnp.bfloat16
+    bn_group: int = 0
 
     expansion: int = 1
 
@@ -46,17 +47,20 @@ class BasicBlock(nn.Module):
     def __call__(self, x, train: bool = False):
         identity = x
         out = ConvBN(
-            self.features, (3, 3), self.strides, dtype=self.dtype, act=nn.relu
+            self.features, (3, 3), self.strides, dtype=self.dtype, act=nn.relu,
+            bn_group=self.bn_group,
         )(x, train=train)
         bn2_init = (
             nn.initializers.zeros if self.zero_init_residual else nn.initializers.ones
         )
-        out = ConvBN(self.features, (3, 3), 1, dtype=self.dtype, bn_scale_init=bn2_init)(
-            out, train=train
-        )
+        out = ConvBN(
+            self.features, (3, 3), 1, dtype=self.dtype, bn_scale_init=bn2_init,
+            bn_group=self.bn_group,
+        )(out, train=train)
         if self.downsample:
             identity = ConvBN(
-                self.features * self.expansion, (1, 1), self.strides, dtype=self.dtype
+                self.features * self.expansion, (1, 1), self.strides,
+                dtype=self.dtype, bn_group=self.bn_group,
             )(x, train=train)
         return nn.relu(out + identity)
 
@@ -74,6 +78,7 @@ class Bottleneck(nn.Module):
     base_width: int = 64
     zero_init_residual: bool = False
     dtype: Any = jnp.bfloat16
+    bn_group: int = 0
 
     expansion: int = 4
 
@@ -81,21 +86,23 @@ class Bottleneck(nn.Module):
     def __call__(self, x, train: bool = False):
         width = int(self.features * (self.base_width / 64.0)) * self.groups
         identity = x
-        out = ConvBN(width, (1, 1), 1, dtype=self.dtype, act=nn.relu)(x, train=train)
+        out = ConvBN(width, (1, 1), 1, dtype=self.dtype, act=nn.relu,
+                     bn_group=self.bn_group)(x, train=train)
         out = ConvBN(
             width, (3, 3), self.strides, groups=self.groups, dtype=self.dtype,
-            act=nn.relu,
+            act=nn.relu, bn_group=self.bn_group,
         )(out, train=train)
         bn3_init = (
             nn.initializers.zeros if self.zero_init_residual else nn.initializers.ones
         )
         out = ConvBN(
             self.features * self.expansion, (1, 1), 1, dtype=self.dtype,
-            bn_scale_init=bn3_init,
+            bn_scale_init=bn3_init, bn_group=self.bn_group,
         )(out, train=train)
         if self.downsample:
             identity = ConvBN(
-                self.features * self.expansion, (1, 1), self.strides, dtype=self.dtype
+                self.features * self.expansion, (1, 1), self.strides,
+                dtype=self.dtype, bn_group=self.bn_group,
             )(x, train=train)
         return nn.relu(out + identity)
 
@@ -110,6 +117,7 @@ class ResNet(nn.Module):
     width_per_group: int = 64
     zero_init_residual: bool = False
     dtype: Any = jnp.bfloat16
+    bn_group: int = 0
     s2d_stem: bool = False
     stage_features = (64, 128, 256, 512)
 
@@ -120,7 +128,7 @@ class ResNet(nn.Module):
         # s2d_stem selects the space-to-depth compute path (layers.StemConv7x7)
         x = ConvBN(
             64, (7, 7), 2, padding=[(3, 3), (3, 3)], dtype=self.dtype,
-            act=nn.relu, s2d_stem=self.s2d_stem,
+            act=nn.relu, s2d_stem=self.s2d_stem, bn_group=self.bn_group,
         )(x, train=train)
         x = max_pool_3x3_s2(x)
         in_features = 64
@@ -139,6 +147,7 @@ class ResNet(nn.Module):
                     base_width=self.width_per_group,
                     zero_init_residual=self.zero_init_residual,
                     dtype=self.dtype,
+                    bn_group=self.bn_group,
                 )(x, train=train)
                 in_features = feats * self.block.expansion
         x = global_avg_pool(x)

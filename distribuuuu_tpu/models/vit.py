@@ -42,6 +42,92 @@ class Mlp(nn.Module):
         return nn.Dropout(self.dropout, deterministic=not train)(x)
 
 
+class MoeMlp(nn.Module):
+    """Mixture-of-experts FFN block (expert parallelism, ops/moe.py).
+
+    Expert tensors are sharded over the ``model`` mesh axis (dim 0), so EP
+    rides the same axis TP does — at ``MESH.MODEL=1`` everything is
+    replicated and the math is the dense reference formulation. With a mesh,
+    tokens stay on their data shard and each rank computes its local
+    experts' partials + one psum (``moe_ffn_partial_batched``) — exact
+    MoE, no token dropping.
+
+    The switch-transformer load-balancing aux (arXiv:2101.03961) is sown
+    into the ``intermediates`` collection under ``moe_aux``; the trainer
+    adds ``MODEL.MOE.AUX_WEIGHT ×`` its mean to the task loss.
+    """
+
+    dim: int
+    hidden: int
+    num_experts: int
+    top_k: int
+    dtype: Any
+    mesh: Any = None
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        from distribuuuu_tpu.ops import moe as moe_ops
+        from distribuuuu_tpu.parallel.tp import MODEL_AXIS
+
+        E = self.num_experts
+        d, f = self.dim, self.hidden
+        scale_in = 1.0 / np.sqrt(d)
+        scale_out = 1.0 / np.sqrt(f)
+
+        def normal(scale):
+            return nn.initializers.normal(stddev=scale)
+
+        params = {
+            "gate": self.param("gate", normal(scale_in), (d, E), jnp.float32),
+            "w_in": self.param(
+                "w_in",
+                nn.with_partitioning(normal(scale_in), (MODEL_AXIS, None, None)),
+                (E, d, f), jnp.float32,
+            ),
+            "b_in": self.param(
+                "b_in",
+                nn.with_partitioning(nn.initializers.zeros, (MODEL_AXIS, None)),
+                (E, f), jnp.float32,
+            ),
+            "w_out": self.param(
+                "w_out",
+                nn.with_partitioning(normal(scale_out), (MODEL_AXIS, None, None)),
+                (E, f, d), jnp.float32,
+            ),
+            "b_out": self.param(
+                "b_out",
+                nn.with_partitioning(nn.initializers.zeros, (MODEL_AXIS, None)),
+                (E, d), jnp.float32,
+            ),
+        }
+        B, S, _ = x.shape
+        x = x.astype(self.dtype)
+        data_size = (
+            self.mesh.shape.get("data", 1) if self.mesh is not None else 1
+        )
+        # the dense reference path also covers batches that cannot shard
+        # over data (the tiny init-time dummy) — identical math either way
+        if (
+            self.mesh is not None
+            and self.mesh.shape.get(MODEL_AXIS, 1) > 1
+            and B % data_size == 0
+        ):
+            out = moe_ops.moe_ffn_partial_batched(
+                params, x, mesh=self.mesh, axis=MODEL_AXIS, top_k=self.top_k
+            )
+        else:
+            out = moe_ops.moe_ffn_reference(
+                params, x.reshape(B * S, d), top_k=self.top_k
+            ).reshape(B, S, d)
+        if train:
+            # aux from the same router function on the same tokens/gate the
+            # expert paths used (identical values up to reduction order)
+            probs = moe_ops.gating_probs(x.reshape(B * S, d), params["gate"])
+            aux = moe_ops.load_balancing_loss_from_probs(probs, self.top_k)
+            self.sow("intermediates", "moe_aux", aux)
+        return out
+
+
 class Attention(nn.Module):
     dim: int
     num_heads: int
@@ -108,6 +194,8 @@ class Block(nn.Module):
     dtype: Any
     attn_impl: str
     mesh: Any
+    moe_experts: int = 0  # >0: MoE FFN instead of the dense Mlp
+    moe_top_k: int = 2
 
     @nn.compact
     def __call__(self, x, train: bool = False):
@@ -117,28 +205,30 @@ class Block(nn.Module):
             self.attn_impl, self.mesh,
         )(y, train=train)
         y = nn.LayerNorm(dtype=self.dtype, param_dtype=jnp.float32)(x)
-        x = x + Mlp(
-            int(self.dim * self.mlp_ratio), self.dim, self.dropout, self.dtype
-        )(y, train=train)
+        if self.moe_experts > 0:
+            ffn = MoeMlp(
+                self.dim, int(self.dim * self.mlp_ratio), self.moe_experts,
+                self.moe_top_k, self.dtype, self.mesh,
+            )
+        else:
+            ffn = Mlp(
+                int(self.dim * self.mlp_ratio), self.dim, self.dropout,
+                self.dtype,
+            )
+        x = x + ffn(y, train=train)
         return x
 
 
-class ViT(nn.Module):
-    """Patch embed → pre-norm transformer blocks → LN → GAP → head."""
+class _ViTCommon(nn.Module):
+    """Shared patch-embed/head helpers for the ViT variants.
 
-    num_classes: int = 1000
-    patch: int = 16
-    dim: int = 192
-    depth: int = 12
-    num_heads: int = 3
-    mlp_ratio: float = 4.0
-    dropout: float = 0.0
-    dtype: Any = jnp.bfloat16
-    attn_impl: str = "xla"
-    mesh: Any = None
+    Plain methods, NOT child modules: their params stay at the variant's
+    top level under the original auto-names (``Conv_0``, ``pos_embed``,
+    ``LayerNorm_0``, ``Dense_0``), so checkpoints keep their paths across
+    variants and releases (the same stability contract
+    models/layers.BatchNorm pins with its fixed child name)."""
 
-    @nn.compact
-    def __call__(self, x, train: bool = False):
+    def _embed(self, x, train: bool):
         B, H, W, _ = x.shape
         assert H % self.patch == 0 and W % self.patch == 0, (
             f"input {H}x{W} not divisible by patch {self.patch}"
@@ -156,12 +246,9 @@ class ViT(nn.Module):
             (1, S, self.dim), jnp.float32,
         )
         x = x + pos.astype(self.dtype)
-        x = nn.Dropout(self.dropout, deterministic=not train)(x)
-        for _ in range(self.depth):
-            x = Block(
-                self.dim, self.num_heads, self.mlp_ratio, self.dropout,
-                self.dtype, self.attn_impl, self.mesh,
-            )(x, train=train)
+        return nn.Dropout(self.dropout, deterministic=not train)(x)
+
+    def _head(self, x):
         x = nn.LayerNorm(dtype=self.dtype, param_dtype=jnp.float32)(x)
         x = x.mean(axis=1)  # GAP over tokens
         return Dense(self.num_classes, dtype=jnp.float32)(
@@ -169,17 +256,208 @@ class ViT(nn.Module):
         )
 
 
+class ViT(_ViTCommon):
+    """Patch embed → pre-norm transformer blocks → LN → GAP → head."""
+
+    num_classes: int = 1000
+    patch: int = 16
+    dim: int = 192
+    depth: int = 12
+    num_heads: int = 3
+    mlp_ratio: float = 4.0
+    dropout: float = 0.0
+    dtype: Any = jnp.bfloat16
+    attn_impl: str = "xla"
+    mesh: Any = None
+    moe_experts: int = 0  # >0: MoE FFN in every ``moe_every``-th block
+    moe_top_k: int = 2
+    moe_every: int = 2
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        x = self._embed(x, train)
+        for i in range(self.depth):
+            # MoE in every moe_every-th block (odd indices at the default 2 —
+            # the GShard/ViT-MoE placement); dense FFN elsewhere
+            moe = (
+                self.moe_experts
+                if self.moe_experts > 0 and i % self.moe_every == self.moe_every - 1
+                else 0
+            )
+            x = Block(
+                self.dim, self.num_heads, self.mlp_ratio, self.dropout,
+                self.dtype, self.attn_impl, self.mesh,
+                moe_experts=moe, moe_top_k=self.moe_top_k,
+            )(x, train=train)
+        return self._head(x)
+
+
+class ViTStage(nn.Module):
+    """``blocks_per_stage`` uniform transformer blocks — the pipeline-stage
+    unit for :class:`PipelinedViT` (satisfies parallel/pp.py's uniform
+    param-structure + activation-shape contract)."""
+
+    dim: int
+    num_heads: int
+    mlp_ratio: float
+    dropout: float
+    dtype: Any
+    blocks_per_stage: int
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        for _ in range(self.blocks_per_stage):
+            x = Block(
+                self.dim, self.num_heads, self.mlp_ratio, self.dropout,
+                self.dtype, "xla", None,
+            )(x, train=train)
+        return x
+
+
+class PipelinedViT(_ViTCommon):
+    """ViT with the block stack run as a GPipe pipeline over the ``pipe``
+    mesh axis (parallel/pp.py).
+
+    Params: patch embed / head are ordinary (replicated) children; the
+    ``depth`` blocks live in ONE ``stages`` param — a stacked pytree with
+    leading dim ``pipe_stages`` sharded over ``pipe`` (each device holds
+    only its stage's blocks). Embed/head compute is replicated across pipe
+    ranks (standard SPMD pipelining; it is tiny next to the blocks).
+
+    The same stacked params also run **sequentially** (stage s applied in
+    order) — used when the batch cannot be microbatched (e.g. ``init``) and
+    as the correctness oracle in tests: GPipe is math-preserving, so both
+    paths agree.
+    """
+
+    num_classes: int = 1000
+    patch: int = 16
+    dim: int = 192
+    depth: int = 12
+    num_heads: int = 3
+    mlp_ratio: float = 4.0
+    dropout: float = 0.0
+    dtype: Any = jnp.bfloat16
+    attn_impl: str = "xla"
+    mesh: Any = None
+    pipe_stages: int = 2
+    pipe_microbatches: int = 0  # 0 → 2 × pipe_stages
+
+    def _stage_module(self):
+        if self.depth % self.pipe_stages:
+            raise ValueError(
+                f"depth {self.depth} not divisible by pipe_stages "
+                f"{self.pipe_stages}"
+            )
+        if self.dropout > 0:
+            raise ValueError(
+                "dropout inside pipeline stages is not supported (stage "
+                "apply runs under shard_map without an rng); set dropout=0"
+            )
+        if self.attn_impl != "xla":
+            raise ValueError(
+                "PipelinedViT uses dense XLA attention inside stages; "
+                "sequence-sharded attention does not compose with the pipe "
+                f"axis (got attn_impl={self.attn_impl!r})"
+            )
+        return ViTStage(
+            self.dim, self.num_heads, self.mlp_ratio, 0.0, self.dtype,
+            self.depth // self.pipe_stages,
+        )
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        from distribuuuu_tpu.parallel import pp
+
+        stage_mod = self._stage_module()
+        S = self.pipe_stages
+        M = self.pipe_microbatches or 2 * S
+
+        def init_stages(key):
+            keys = jax.random.split(key, S)
+            dummy = jnp.zeros((1, 8, self.dim), jnp.float32)
+
+            def one(k):
+                return stage_mod.init(k, dummy, train=False)["params"]
+
+            template = jax.eval_shape(one, keys[0])  # boxed: TP names
+            stacked = jax.vmap(lambda k: nn.meta.unbox(one(k)))(keys)
+
+            def rebox(t, v):
+                # stage dim 0 → "pipe"; inner TP names preserved (PP × TP)
+                if isinstance(t, nn.Partitioned):
+                    return nn.Partitioned(v, names=("pipe",) + tuple(t.names))
+                return nn.Partitioned(
+                    v, names=("pipe",) + (None,) * (np.ndim(v) - 1)
+                )
+
+            return jax.tree.map(
+                rebox, template, stacked,
+                is_leaf=lambda n: isinstance(n, nn.Partitioned),
+            )
+
+        x = self._embed(x, train)
+        stages = self.param("stages", init_stages)
+        B = x.shape[0]
+
+        def stage_fn(p, a):
+            return stage_mod.apply({"params": p}, a, train=train)
+
+        mesh = self.mesh
+        pipe_on_mesh = mesh is not None and mesh.shape.get("pipe", 1) == S
+        # each data shard needs M whole microbatches
+        need = M * (mesh.shape.get("data", 1) if pipe_on_mesh else 1)
+        if pipe_on_mesh and S > 1 and B >= need:
+            if B % need:
+                raise ValueError(
+                    f"batch {B} does not split into {M} GPipe microbatches "
+                    f"per data shard (need a multiple of {need}; "
+                    "MESH.MICROBATCH × data axis)"
+                )
+            x = pp.pipelined(
+                stage_fn, mesh=mesh, num_microbatches=M
+            )(stages, x)
+        else:
+            # sequential fallback: same params, same math (used for the
+            # tiny init-time dummy batch and on meshes without a pipe axis)
+            for s in range(S):
+                x = stage_fn(jax.tree.map(lambda a: a[s], stages), x)
+        return self._head(x)
+
+
+def _vit(num_classes, kw, **defaults):
+    for k, v in defaults.items():
+        kw.setdefault(k, v)
+    pipe = kw.pop("pipe_stages", 0)
+    if pipe and pipe > 1:
+        kw.setdefault("pipe_microbatches", 0)
+        for unsupported in ("moe_experts",):
+            if kw.get(unsupported):
+                raise ValueError(
+                    "MoE FFN does not compose with the pipeline axis yet; "
+                    "use MESH.PIPE=1 for the *_moe archs"
+                )
+        kw.pop("moe_experts", None)
+        kw.pop("moe_top_k", None)
+        kw.pop("moe_every", None)
+        return PipelinedViT(num_classes=num_classes, pipe_stages=pipe, **kw)
+    kw.pop("pipe_microbatches", None)
+    return ViT(num_classes=num_classes, **kw)
+
+
 def vit_tiny(num_classes=1000, **kw):
     """ViT-Ti/16: 192 dim, 12 blocks, 3 heads (~5.5M params at 1000 cls)."""
-    kw.setdefault("dim", 192)
-    kw.setdefault("depth", 12)
-    kw.setdefault("num_heads", 3)
-    return ViT(num_classes=num_classes, **kw)
+    return _vit(num_classes, kw, dim=192, depth=12, num_heads=3)
 
 
 def vit_small(num_classes=1000, **kw):
     """ViT-S/16: 384 dim, 12 blocks, 6 heads (~21.7M params at 1000 cls)."""
-    kw.setdefault("dim", 384)
-    kw.setdefault("depth", 12)
-    kw.setdefault("num_heads", 6)
-    return ViT(num_classes=num_classes, **kw)
+    return _vit(num_classes, kw, dim=384, depth=12, num_heads=6)
+
+
+def vit_tiny_moe(num_classes=1000, **kw):
+    """ViT-Ti/16 with MoE FFN in every 2nd block (8 experts, top-2 by
+    default — override via MODEL.MOE.*). The trainer-reachable
+    expert-parallel arch: expert tensors shard over the ``model`` axis."""
+    kw.setdefault("moe_experts", 8)
+    return _vit(num_classes, kw, dim=192, depth=12, num_heads=3)

@@ -65,21 +65,32 @@ def moe_params_sharding(mesh, params, axis: str = "model"):
     return {k: spec(k, v) for k, v in params.items()}
 
 
-def top_k_gating(x, gate_w, top_k: int):
-    """Softmax-renormalized top-k gate.
+def gating_probs(x, gate_w):
+    """Router probabilities: softmax(x @ gate) in fp32, [T, E]. The single
+    source of routing — compute once, feed both the expert paths and the
+    load-balancing aux."""
+    logits = x.astype(jnp.float32) @ gate_w.astype(jnp.float32)
+    return jax.nn.softmax(logits, axis=-1)
+
+
+def top_k_from_probs(probs, top_k: int):
+    """Softmax-renormalized top-k gate from precomputed probabilities.
 
     Returns (weights [T, k] f32, indices [T, k] i32).
     """
-    logits = x.astype(jnp.float32) @ gate_w.astype(jnp.float32)  # [T, E]
-    gates = jax.nn.softmax(logits, axis=-1)
-    weights, indices = jax.lax.top_k(gates, top_k)
+    weights, indices = jax.lax.top_k(probs, top_k)
     weights = weights / jnp.maximum(
         weights.sum(axis=-1, keepdims=True), 1e-9
     )
     return weights, indices.astype(jnp.int32)
 
 
-def load_balancing_loss(x, gate_w, top_k: int):
+def top_k_gating(x, gate_w, top_k: int):
+    """Softmax-renormalized top-k gate (gating_probs ∘ top_k_from_probs)."""
+    return top_k_from_probs(gating_probs(x, gate_w), top_k)
+
+
+def load_balancing_loss_from_probs(probs, top_k: int):
     """Switch-transformer auxiliary loss (arXiv:2101.03961 eq. 4-6).
 
     ``E · Σ_e f_e · P_e`` where ``f_e`` is the fraction of tokens whose
@@ -88,14 +99,17 @@ def load_balancing_loss(x, gate_w, top_k: int):
     task loss to keep routed experts balanced — without it top-k routing
     collapses onto a few experts and the dispatch path drops tokens.
     """
-    E = gate_w.shape[-1]
-    logits = x.astype(jnp.float32) @ gate_w.astype(jnp.float32)
-    probs = jax.nn.softmax(logits, axis=-1)                    # [T, E]
+    E = probs.shape[-1]
     _, indices = jax.lax.top_k(probs, top_k)
     assigned = jax.nn.one_hot(indices, E).sum(axis=1)          # [T, E] 0/1
     f = assigned.mean(axis=0) / top_k                          # Σf = 1
     p = probs.mean(axis=0)
     return E * jnp.sum(f * p)
+
+
+def load_balancing_loss(x, gate_w, top_k: int):
+    """`load_balancing_loss_from_probs` with the router computed here."""
+    return load_balancing_loss_from_probs(gating_probs(x, gate_w), top_k)
 
 
 def _expert_ffn(w_in, b_in, w_out, b_out, x):
@@ -122,6 +136,37 @@ def moe_ffn_reference(params, x, top_k: int = 2):
     return out
 
 
+def _rank_partials(params, tokens, axis: str, top_k: int):
+    """The shared per-rank body of the partial strategy: route the [T, d]
+    tokens, run the LOCAL experts, psum the partials over ``axis``. Call
+    inside shard_map with ``axis`` bound."""
+    r = jax.lax.axis_index(axis)
+    local_E = params["w_in"].shape[0]  # E / n
+    weights, indices = top_k_from_probs(
+        gating_probs(tokens, params["gate"]), top_k
+    )
+    out = jnp.zeros_like(tokens)
+    for le in range(local_E):
+        ge = r * local_E + le  # global expert id
+        y = _expert_ffn(
+            params["w_in"][le], params["b_in"][le],
+            params["w_out"][le], params["b_out"][le], tokens,
+        )
+        w_e = (weights * (indices == ge)).sum(axis=-1)
+        out = out + y * w_e[:, None].astype(tokens.dtype)
+    return jax.lax.psum(out, axis)
+
+
+def _partial_param_specs(axis: str):
+    """shard_map specs for the partial strategy's params: expert tensors on
+    ``axis`` dim 0, gate replicated."""
+    return {
+        "gate": P(),
+        "w_in": P(axis), "b_in": P(axis),
+        "w_out": P(axis), "b_out": P(axis),
+    }
+
+
 def moe_ffn_partial(params, x, *, mesh, axis: str = "model", top_k: int = 2):
     """Exact expert-parallel MoE: local experts over all tokens + one psum.
 
@@ -133,32 +178,50 @@ def moe_ffn_partial(params, x, *, mesh, axis: str = "model", top_k: int = 2):
     assert E % n == 0, f"expert-axis size {n} must divide num_experts {E}"
 
     def per_rank(params, x):
-        r = jax.lax.axis_index(axis)
-        local_E = params["w_in"].shape[0]  # E / n
-        weights, indices = top_k_gating(x, params["gate"], top_k)
-        out = jnp.zeros_like(x)
-        for le in range(local_E):
-            ge = r * local_E + le  # global expert id
-            y = _expert_ffn(
-                params["w_in"][le], params["b_in"][le],
-                params["w_out"][le], params["b_out"][le], x,
-            )
-            w_e = (weights * (indices == ge)).sum(axis=-1)
-            out = out + y * w_e[:, None].astype(x.dtype)
-        return jax.lax.psum(out, axis)
+        return _rank_partials(params, x, axis, top_k)
 
     return shard_map(
         per_rank,
         mesh=mesh,
-        in_specs=(
-            {
-                "gate": P(),
-                "w_in": P(axis), "b_in": P(axis),
-                "w_out": P(axis), "b_out": P(axis),
-            },
-            P(),
-        ),
+        in_specs=(_partial_param_specs(axis), P()),
         out_specs=P(),
+    )(params, x)
+
+
+def moe_ffn_partial_batched(
+    params,
+    x,
+    *,
+    mesh,
+    axis: str = "model",
+    data_axis: str | None = "data",
+    top_k: int = 2,
+):
+    """`moe_ffn_partial` for batched activations inside a larger SPMD program.
+
+    ``x``: [B, S, d] with B sharded over ``data_axis`` (the trainer's layout).
+    Tokens stay on their data shard — each data rank routes and combines its
+    own B_local·S tokens; the only communication is the expert-partials psum
+    over ``axis``. This is the trainer-facing EP entry point (DP × EP
+    composition); ``moe_ffn_partial`` is the flat-token primitive.
+    """
+    n = mesh.shape[axis]
+    E = params["gate"].shape[-1]
+    if E % n:
+        raise ValueError(f"expert-axis size {n} must divide num_experts {E}")
+
+    def per_rank(params, x):
+        b, s, d = x.shape
+        out = _rank_partials(params, x.reshape(b * s, d), axis, top_k)
+        return out.reshape(b, s, d)
+
+    data_sharded = bool(data_axis) and mesh.shape.get(data_axis, 1) > 1
+    x_spec = P(data_axis) if data_sharded else P()
+    return shard_map(
+        per_rank,
+        mesh=mesh,
+        in_specs=(_partial_param_specs(axis), x_spec),
+        out_specs=x_spec,
     )(params, x)
 
 

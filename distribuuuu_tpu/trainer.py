@@ -62,17 +62,42 @@ def check_trainer_mesh():
     silently replicate the whole computation over an unused axis (N×
     redundant work) rather than erroring."""
     if cfg.MESH.PIPE not in (0, 1):
-        raise ValueError(
-            f"MESH.PIPE={cfg.MESH.PIPE}: the classification trainer does not "
-            "pipeline CNN stages; use MESH.DATA/MODEL/SEQ here, and "
-            "parallel.pp.pipelined for pipeline-parallel workloads"
-        )
+        if not cfg.MODEL.ARCH.startswith("vit"):
+            raise ValueError(
+                f"MESH.PIPE={cfg.MESH.PIPE}: only the ViT archs satisfy the "
+                "uniform-stage pipeline contract (parallel/pp.py); a CNN's "
+                "shrinking stage pyramid does not — use MESH.DATA/MODEL "
+                "for those archs"
+            )
+        if cfg.MODEL.ARCH.endswith("_moe"):
+            raise ValueError(
+                "MESH.PIPE>1 does not compose with the *_moe archs yet "
+                "(expert shard_map inside a pipeline stage); use "
+                "MESH.MODEL for expert parallelism"
+            )
+        if cfg.MESH.SEQ not in (0, 1, -1):
+            raise ValueError(
+                f"MESH.PIPE={cfg.MESH.PIPE} with MESH.SEQ={cfg.MESH.SEQ}: "
+                "pipeline stages run dense XLA attention; sequence-sharded "
+                "attention does not compose with the pipe axis"
+            )
     if cfg.MESH.SEQ not in (0, 1, -1) and not cfg.MODEL.ARCH.startswith("vit"):
         raise ValueError(
             f"MESH.SEQ={cfg.MESH.SEQ}: only the ViT archs route attention "
             "over the seq axis; CNN archs have no sequence dimension to "
             "shard (the axis would be silently replicated)"
         )
+
+
+def bn_group_from_cfg() -> int:
+    """BN statistic regime from the config (honors ``MODEL.SYNCBN``,
+    ref: trainer.py:131 + config.py:14). ``SYNCBN True`` ⇒ 0 = global-batch
+    stats (SyncBatchNorm). ``False`` (the reference default for every
+    published baseline) ⇒ ghost groups of ``MODEL.BN_GROUP`` samples,
+    defaulting to ``TRAIN.BATCH_SIZE`` — the reference's per-GPU BN batch."""
+    if cfg.MODEL.SYNCBN:
+        return 0
+    return cfg.MODEL.BN_GROUP or cfg.TRAIN.BATCH_SIZE
 
 
 def build_model_from_cfg():
@@ -82,6 +107,9 @@ def build_model_from_cfg():
         num_classes=cfg.MODEL.NUM_CLASSES,
         dtype=resolve_dtype(cfg.DEVICE.COMPUTE_DTYPE),
     )
+    if not cfg.MODEL.ARCH.startswith("vit"):
+        # every non-ViT arch in the zoo normalizes with BN
+        kwargs["bn_group"] = bn_group_from_cfg()
     if cfg.MODEL.ARCH.startswith(
         ("resnet", "resnext", "wide_resnet", "botnet", "densenet")
     ):
@@ -115,6 +143,20 @@ def build_model_from_cfg():
                 "accept 'auto'/'xla' (dense), 'blockwise', or MESH.SEQ>1 "
                 "for ring attention"
             )
+        if cfg.MESH.PIPE not in (0, 1):
+            # GPipe pipeline over the pipe axis (models/vit.PipelinedViT);
+            # the mesh resolves PIPE=-1 ("remaining devices") to a size
+            pipe_mesh = mesh_lib.mesh_from_cfg(cfg)
+            kwargs["pipe_stages"] = dict(pipe_mesh.shape)["pipe"]
+            kwargs["pipe_microbatches"] = cfg.MESH.MICROBATCH
+            kwargs["mesh"] = pipe_mesh
+        if cfg.MODEL.ARCH.endswith("_moe"):
+            # expert parallelism over the model axis (models/vit.MoeMlp)
+            kwargs["moe_experts"] = cfg.MODEL.MOE.NUM_EXPERTS
+            kwargs["moe_top_k"] = cfg.MODEL.MOE.TOP_K
+            kwargs["moe_every"] = cfg.MODEL.MOE.EVERY
+            if cfg.MESH.MODEL not in (0, 1):
+                kwargs["mesh"] = mesh_lib.mesh_from_cfg(cfg)
     return models.build_model(cfg.MODEL.ARCH, **kwargs)
 
 
@@ -191,15 +233,23 @@ def _train_step_body(model, optimizer, topk: int, accum_steps: int = 1):
             key=state.key,
         ), metrics
 
+    # λ for the MoE load-balancing aux (models/vit.MoeMlp sows per-block
+    # values into ``intermediates``); captured at step-build time. Zero
+    # overhead for dense archs: the collection stays empty.
+    moe_aux_weight = float(cfg.MODEL.MOE.AUX_WEIGHT)
+
     def loss_fn(params, stats, images, labels, key):
         logits, mutated = model.apply(
             {"params": params, "batch_stats": stats},
             images,
             train=True,
-            mutable=["batch_stats"],
+            mutable=["batch_stats", "intermediates"],
             rngs={"dropout": key},
         )
         loss = cross_entropy(logits, labels)
+        aux = jax.tree.leaves(mutated.get("intermediates", {}))
+        if aux and moe_aux_weight:
+            loss = loss + moe_aux_weight * sum(aux) / len(aux)
         return loss, (logits, mutated.get("batch_stats", {}))
 
     grad_fn = jax.value_and_grad(loss_fn, has_aux=True)
@@ -429,8 +479,13 @@ def train_epoch(loader, mesh, state, train_step, epoch: int, logger,
 
     # Two preallocated (fold, batch, ...) host buffers, ping-ponged per
     # dispatch: device_put may still be reading buffer A asynchronously
-    # while the next fold fills buffer B.
+    # while the next fold fills buffer B. Before REFILLING a buffer, fence
+    # on the device batch previously created from it — readiness implies the
+    # H2D transfer has consumed the host memory (near-zero cost in steady
+    # state; without it a deep dispatch backlog could overwrite a buffer a
+    # pending transfer is still reading, silently corrupting a batch).
     stack_bufs, buf_idx = None, 0
+    inflight = [None, None]  # device batch last created from each buffer
     end = time.perf_counter()
     win_start = end  # start of the current fold window (covers buffering too)
     for it, host_batch in enumerate(loader):
@@ -452,6 +507,9 @@ def train_epoch(loader, mesh, state, train_step, epoch: int, logger,
                     for _ in range(2)
                 ]
             stack_buf = stack_bufs[buf_idx]
+            if n_buffered == 0 and inflight[buf_idx] is not None:
+                jax.block_until_ready(inflight[buf_idx])
+                inflight[buf_idx] = None
             jax.tree.map(
                 lambda buf, x: buf.__setitem__(n_buffered, x),
                 stack_buf, host_batch,
@@ -463,6 +521,7 @@ def train_epoch(loader, mesh, state, train_step, epoch: int, logger,
             n = n_buffered
             if n == fold:
                 batch = put_stacked(stack_buf)
+                inflight[buf_idx] = batch
                 prof.begin(done)
                 state, metrics = scan_step(state, batch)
                 prof.end(done + fold - 1, state)
@@ -503,6 +562,7 @@ def validate(loader, mesh, state, eval_step, epoch: int, logger):
     (≙ ref validate's meter display, trainer.py:91-95) — totals stay on
     device between prints so batches dispatch asynchronously."""
     totals = None
+    pending_print = None  # previous window's (batch_idx, totals) — async copy
     num_batches = len(loader)
     end = time.perf_counter()
     for it, host_batch in enumerate(loader):
@@ -514,18 +574,27 @@ def validate(loader, mesh, state, eval_step, epoch: int, logger):
             else jax.tree.map(jnp.add, totals, m)
         )
         if (it + 1) % cfg.TEST.PRINT_FREQ == 0 and mesh_lib.is_primary():
-            # fetch first (blocks on all queued eval work), then time the
-            # window so device compute is attributed to it
-            acc1_so_far = (
-                float(totals["correct1"]) / max(float(totals["count"]), 1.0) * 100.0
-            )
-            window = time.perf_counter() - end
-            logger.info(
-                "Eval[%d][%d/%d]  Time %6.3f (%.3f/batch)  Acc@1 %.3f (so far)",
-                epoch + 1, it + 1, num_batches,
-                window, window / cfg.TEST.PRINT_FREQ, acc1_so_far,
-            )
+            # async metric fetch (same treatment the train loop gives its
+            # metrics): start the host copy of THIS window's totals and log
+            # the PREVIOUS window's — already landed, so reading it costs
+            # nothing and eval batches keep dispatching back-to-back
+            # (the blocking fetch here was the last per-N-batches host sync)
+            for leaf in jax.tree.leaves(totals):
+                leaf.copy_to_host_async()
+            if pending_print is not None:
+                pit, ptot = pending_print
+                acc1_so_far = (
+                    float(ptot["correct1"]) / max(float(ptot["count"]), 1.0) * 100.0
+                )
+                window = time.perf_counter() - end
+                logger.info(
+                    "Eval[%d][%d/%d]  Time %6.3f (%.3f/batch)  "
+                    "Acc@1 %.3f (through batch %d)",
+                    epoch + 1, it + 1, num_batches,
+                    window, window / cfg.TEST.PRINT_FREQ, acc1_so_far, pit,
+                )
             end = time.perf_counter()
+            pending_print = (it + 1, totals)
     totals = jax.tree.map(float, totals)
     n = max(totals["count"], 1.0)
     top1 = totals["correct1"] / n * 100.0
@@ -647,6 +716,25 @@ def train_model():
             f"TRAIN.GRAD_ACCUM_STEPS={accum}) does not shard over the "
             f"data axis of size {data_size}; raise TRAIN.BATCH_SIZE or "
             "lower GRAD_ACCUM_STEPS"
+        )
+    pipe_size = dict(mesh.shape).get("pipe", 1)
+    if pipe_size > 1:
+        pipe_mb = cfg.MESH.MICROBATCH or 2 * pipe_size
+        per_shard = global_micro // data_size
+        if per_shard % pipe_mb:
+            raise ValueError(
+                f"per-data-shard batch {per_shard} not divisible by the "
+                f"{pipe_mb} GPipe microbatches (MESH.MICROBATCH, 0 → "
+                "2×PIPE); adjust TRAIN.BATCH_SIZE or MESH.MICROBATCH"
+            )
+    bn_g = 0 if cfg.MODEL.ARCH.startswith("vit") else bn_group_from_cfg()
+    if bn_g > 0 and global_micro > bn_g and global_micro % bn_g:
+        # fail before the expensive init/compile — _BNCore would raise the
+        # same condition at first train-step trace
+        raise ValueError(
+            f"ghost BN group {bn_g} (MODEL.BN_GROUP, 0 → TRAIN.BATCH_SIZE) "
+            f"does not divide the per-step forward batch {global_micro}; "
+            "adjust MODEL.BN_GROUP / TRAIN.BATCH_SIZE / GRAD_ACCUM_STEPS"
         )
 
     model = build_model_from_cfg()

@@ -1,0 +1,165 @@
+"""Trainer-reachable pipeline parallelism (MESH.PIPE) and expert
+parallelism (vit_tiny_moe) — VERDICT r1 item 3.
+
+The r1 gap: parallel/pp.py and ops/moe.py were library-level only; the
+trainer refused MESH.PIPE>1 and no arch consumed MoE. Now
+``train_net.py --cfg config/vit_tiny.yaml MESH.PIPE 4`` trains (GPipe over
+the pipe axis, models/vit.PipelinedViT), and ``vit_tiny_moe`` trains
+through the normal step with expert tensors sharded over ``model`` and the
+switch load-balancing aux (MODEL.MOE.AUX_WEIGHT) added to the loss.
+"""
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+import pytest
+
+from distribuuuu_tpu import models, trainer
+from distribuuuu_tpu.config import cfg
+from distribuuuu_tpu.parallel import mesh as mesh_lib, sharding as sharding_lib
+from distribuuuu_tpu.utils.optim import construct_optimizer
+
+
+def _tiny_vit_cfg(pipe=1, model_axis=1, arch="vit_tiny"):
+    cfg.MODEL.ARCH = arch
+    cfg.MODEL.NUM_CLASSES = 10
+    cfg.TRAIN.IM_SIZE = 32
+    cfg.TRAIN.BATCH_SIZE = 2  # per chip
+    cfg.DEVICE.COMPUTE_DTYPE = "float32"
+    cfg.MESH.PIPE = pipe
+    cfg.MESH.MODEL = model_axis
+    cfg.MESH.DATA = -1
+
+
+def _one_step(im=32, seed=0):
+    mesh = mesh_lib.mesh_from_cfg(cfg)
+    model = trainer.build_model_from_cfg()
+    state = trainer.create_train_state(model, jax.random.key(seed), mesh, im)
+    optimizer = construct_optimizer()
+    step = trainer.make_train_step(model, optimizer, topk=5)
+    rng = np.random.default_rng(seed)
+    batch = {
+        "image": rng.standard_normal((16, im, im, 3)).astype(np.float32),
+        "label": rng.integers(0, 10, size=(16,)).astype(np.int32),
+        "mask": np.ones((16,), np.float32),
+    }
+    gbatch = sharding_lib.shard_batch(mesh, batch)
+    state, metrics = step(state, gbatch)
+    return state, jax.tree.map(float, metrics), model, mesh, gbatch
+
+
+def test_vit_tiny_trains_with_pipe4():
+    """MESH.PIPE=4 (×2 data) trains vit_tiny end-to-end via the trainer's
+    normal make_train_step — the r1 refusal is gone."""
+    _tiny_vit_cfg(pipe=4)
+    # small depth so the CPU-mesh compile stays fast; depth % pipe == 0
+    cfg.MESH.MICROBATCH = 4
+    trainer.check_trainer_mesh()
+    state, metrics, model, mesh, _ = _one_step()
+    assert type(model).__name__ == "PipelinedViT"
+    assert dict(mesh.shape)["pipe"] == 4
+    assert np.isfinite(metrics["loss"])
+    # stage params exist and are stacked with leading dim = pipe
+    stages = state.params["stages"]
+    assert all(leaf.shape[0] == 4 for leaf in jax.tree.leaves(stages))
+
+
+def test_pipe_matches_dataparallel_forward():
+    """The pipelined model's logits equal a plain ViT's when the stacked
+    stage params are scattered back into Block_i params (GPipe is
+    math-preserving end to end, trainer path included)."""
+    _tiny_vit_cfg(pipe=2)
+    cfg.MESH.MICROBATCH = 2
+    mesh = mesh_lib.mesh_from_cfg(cfg)
+    pmodel = trainer.build_model_from_cfg()
+    pstate = trainer.create_train_state(pmodel, jax.random.key(0), mesh, 32)
+
+    rng = np.random.default_rng(1)
+    x = jnp.asarray(rng.standard_normal((8, 32, 32, 3)), jnp.float32)
+    plogits = jax.jit(
+        lambda p, a: pmodel.apply({"params": p}, a, train=False)
+    )(pstate.params, x)
+
+    # rebuild as a plain (non-pipe) ViT with the SAME weights: stage s,
+    # local block j  →  Block_{s*k+j}
+    dense = models.build_model(
+        "vit_tiny", num_classes=10, dtype=jnp.float32
+    )
+    k = dense.depth // 2
+    params = {}
+    for name, sub in pstate.params.items():
+        if name == "stages":
+            for s in range(2):
+                for j in range(k):
+                    params[f"Block_{s * k + j}"] = jax.tree.map(
+                        lambda a: a[s], sub[f"Block_{j}"]
+                    )
+        else:
+            params[name] = sub
+    dlogits = jax.jit(
+        lambda p, a: dense.apply({"params": p}, a, train=False)
+    )(params, x)
+    np.testing.assert_allclose(
+        np.asarray(plogits), np.asarray(dlogits), atol=2e-5
+    )
+
+
+def test_vit_tiny_moe_trains_with_expert_parallelism():
+    """vit_tiny_moe trains through the normal step on a data×model mesh;
+    the loss includes the load-balancing aux (λ > 0 changes the loss)."""
+    _tiny_vit_cfg(model_axis=2, arch="vit_tiny_moe")
+    trainer.check_trainer_mesh()
+    state, metrics, model, mesh, gbatch = _one_step()
+    assert model.moe_experts == 8
+    assert np.isfinite(metrics["loss"])
+    # expert tensors are sharded over the model axis (dim 0)
+    w_in = None
+    for path, leaf in jax.tree_util.tree_flatten_with_path(state.params)[0]:
+        if any(getattr(p, "key", None) == "w_in" for p in path):
+            w_in = leaf
+    assert w_in is not None
+    spec = w_in.sharding.spec
+    assert spec[0] == "model", f"expert dim not sharded over model: {spec}"
+
+
+def test_moe_aux_weight_reaches_the_loss():
+    _tiny_vit_cfg(arch="vit_tiny_moe")
+    losses = {}
+    for w in (0.0, 10.0):
+        cfg.MODEL.MOE.AUX_WEIGHT = w
+        _, metrics, *_ = _one_step(seed=0)
+        losses[w] = metrics["loss"]
+    assert losses[10.0] > losses[0.0]  # aux ≥ 1 by construction
+
+
+def test_moe_parallel_matches_dense_reference():
+    """EP (model axis 2) and the dense single-axis path produce the same
+    logits for the same params — moe_ffn_partial_batched is exact."""
+    rng = np.random.default_rng(2)
+    x = jnp.asarray(rng.standard_normal((4, 32, 32, 3)), jnp.float32)
+
+    _tiny_vit_cfg(model_axis=2, arch="vit_tiny_moe")
+    mesh = mesh_lib.mesh_from_cfg(cfg)
+    pmodel = trainer.build_model_from_cfg()
+    pstate = trainer.create_train_state(pmodel, jax.random.key(0), mesh, 32)
+    plogits = jax.jit(
+        lambda p, a: pmodel.apply({"params": p}, a, train=False)
+    )(pstate.params, x)
+
+    dmodel = models.build_model(
+        "vit_tiny_moe", num_classes=10, dtype=jnp.float32
+    )
+    params_host = jax.tree.map(np.asarray, pstate.params)
+    dlogits = dmodel.apply({"params": params_host}, x, train=False)
+    np.testing.assert_allclose(
+        np.asarray(plogits), np.asarray(dlogits), atol=2e-4
+    )
+
+
+def test_pipe_refused_for_cnn_and_moe():
+    _tiny_vit_cfg(pipe=4, arch="resnet18")
+    with pytest.raises(ValueError, match="uniform-stage"):
+        trainer.check_trainer_mesh()
+    _tiny_vit_cfg(pipe=4, arch="vit_tiny_moe")
+    with pytest.raises(ValueError, match="compose"):
+        trainer.check_trainer_mesh()
