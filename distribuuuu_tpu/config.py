@@ -287,9 +287,13 @@ _C.DEVICE.COMPUTE_DTYPE = "bfloat16"
 _C.DEVICE.DETERMINISTIC = False
 # Attention implementation for attention archs. BoTNet: "auto" | "xla" |
 # "pallas" ("auto" resolves per measurement, ops/pallas_attention.use_pallas).
-# ViT additionally accepts "blockwise": exact attention in O(L·chunk) memory
-# (ops/ring_attention.blockwise_attention) for high-resolution inputs on a
-# single chip; MESH.SEQ>1 overrides with ring attention over the mesh.
+# ViT: "auto" picks the Pallas flash kernel (ops/flash_attention.py) for
+# sequences ≥1024 tokens WHEN dropout is 0 (the kernel has no
+# probability-dropout; with dropout>0 auto stays on dense XLA — at long
+# sequences that materializes O(L²) logits, so prefer dropout 0 there),
+# and dense XLA below; "flash" forces the kernel (blockwise-scan fallback
+# off-TPU); "blockwise" is the lax.scan O(L·chunk) exact path; MESH.SEQ>1
+# overrides with ring attention.
 _C.DEVICE.ATTN_IMPL = "auto"
 # Space-to-depth stem for the 7x7/s2-stem archs (resnet/resnext/wide_resnet/
 # botnet): compute the stem as a 4x4/s1 conv over 2x2-block-folded input

@@ -133,40 +133,68 @@ class Attention(nn.Module):
     num_heads: int
     dropout: float
     dtype: Any
-    attn_impl: str = "xla"  # "xla" | "blockwise" | "ring" | "ulysses"
+    # "auto" | "xla" | "flash" | "blockwise" | "ring" | "ulysses".
+    # "auto" resolves per shape at trace time: the Pallas flash kernel
+    # (ops/flash_attention.py) for long sequences on TPU, dense XLA
+    # otherwise. "flash" forces the kernel (falls back to the lax.scan
+    # blockwise path off-TPU — same exact math).
+    attn_impl: str = "xla"
     mesh: Any = None        # required for ring/ulysses
+
+    # sequence length at/above which "auto" picks the flash kernel (the
+    # kernel wins from ~1-2k tokens on a v5e; dense XLA wins below)
+    FLASH_MIN_SEQ = 1024
+
+    @staticmethod
+    def resolve_impl(attn_impl: str, seq_len: int, dropout: float) -> str:
+        """'auto' → 'flash' at ≥FLASH_MIN_SEQ tokens with dropout 0 (the
+        flash kernel has no probability-dropout support), dense 'xla'
+        otherwise. Exposed so the threshold branch is directly testable."""
+        if attn_impl != "auto":
+            return attn_impl
+        if seq_len >= Attention.FLASH_MIN_SEQ and dropout == 0:
+            return "flash"
+        return "xla"
 
     @nn.compact
     def __call__(self, x, train: bool = False):
-        if self.attn_impl not in ("xla", "blockwise", "ring", "ulysses"):
+        if self.attn_impl not in (
+            "auto", "xla", "flash", "blockwise", "ring", "ulysses"
+        ):
             raise ValueError(
-                f"vit attn_impl must be 'xla', 'blockwise', 'ring', or "
-                f"'ulysses'; got {self.attn_impl!r}"
+                f"vit attn_impl must be 'auto', 'xla', 'flash', 'blockwise', "
+                f"'ring', or 'ulysses'; got {self.attn_impl!r}"
             )
-        if self.attn_impl != "xla" and self.dropout > 0:
+        if self.attn_impl not in ("xla", "auto") and self.dropout > 0:
             raise ValueError(
                 "attention-probability dropout is not supported under "
-                "blockwise/sequence-sharded attention; set dropout=0 or "
-                "use attn_impl='xla'"
+                "flash/blockwise/sequence-sharded attention; set dropout=0 "
+                "or use attn_impl='xla'"
             )
         B, S, _ = x.shape
+        impl = self.resolve_impl(self.attn_impl, S, self.dropout)
         H = self.num_heads
         D = self.dim // H
         qkv = Dense(3 * self.dim, dtype=self.dtype)(x)
         qkv = qkv.reshape(B, S, 3, H, D).transpose(2, 0, 3, 1, 4)  # [3,B,H,S,D]
         q, k, v = qkv[0], qkv[1], qkv[2]
 
-        if self.attn_impl in ("ring", "ulysses"):
+        if impl in ("ring", "ulysses"):
             from distribuuuu_tpu.ops import ring_attention as ra
 
             assert self.mesh is not None, "seq-parallel attention needs a mesh"
             fn = (
                 ra.ring_attention
-                if self.attn_impl == "ring"
+                if impl == "ring"
                 else ra.ulysses_attention
             )
             out = fn(q, k, v, self.mesh, causal=False)
-        elif self.attn_impl == "blockwise":
+        elif impl == "flash":
+            from distribuuuu_tpu.ops import flash_attention as fa
+
+            # Pallas flash kernel on TPU; blockwise scan fallback elsewhere
+            out = fa.flash_attention(q, k, v)
+        elif impl == "blockwise":
             from distribuuuu_tpu.ops import ring_attention as ra
 
             # O(L·chunk) memory — high-resolution single-chip training

@@ -130,18 +130,22 @@ def build_model_from_cfg():
         if cfg.MESH.SEQ not in (0, 1, -1):
             kwargs["attn_impl"] = "ring"
             kwargs["mesh"] = mesh_lib.mesh_from_cfg(cfg)
-        elif cfg.DEVICE.ATTN_IMPL == "blockwise":
-            kwargs["attn_impl"] = "blockwise"
+        elif cfg.DEVICE.ATTN_IMPL in ("blockwise", "flash"):
+            kwargs["attn_impl"] = cfg.DEVICE.ATTN_IMPL
+        elif cfg.DEVICE.ATTN_IMPL == "auto":
+            # per-shape resolution at trace time (models/vit.Attention):
+            # Pallas flash kernel for long sequences on TPU, dense XLA below
+            kwargs["attn_impl"] = "auto"
         elif cfg.DEVICE.ATTN_IMPL in ("ring", "ulysses"):
             raise ValueError(
                 f"DEVICE.ATTN_IMPL={cfg.DEVICE.ATTN_IMPL!r} needs a "
                 "sequence-sharded mesh: set MESH.SEQ > 1"
             )
-        elif cfg.DEVICE.ATTN_IMPL not in ("auto", "xla"):
+        elif cfg.DEVICE.ATTN_IMPL != "xla":
             raise ValueError(
                 f"DEVICE.ATTN_IMPL={cfg.DEVICE.ATTN_IMPL!r}: ViT archs "
-                "accept 'auto'/'xla' (dense), 'blockwise', or MESH.SEQ>1 "
-                "for ring attention"
+                "accept 'auto', 'xla' (dense), 'flash' (Pallas kernel), "
+                "'blockwise', or MESH.SEQ>1 for ring attention"
             )
         if cfg.MESH.PIPE not in (0, 1):
             # GPipe pipeline over the pipe axis (models/vit.PipelinedViT);

@@ -1,0 +1,132 @@
+"""Pallas flash attention (ops/flash_attention.py) — VERDICT r1 item 4.
+
+Correctness on the CPU mesh runs the kernels through the Pallas interpreter
+(``interpret=True``) against the dense reference — forward AND both backward
+kernels (dq, dk/dv), including the padded (L not a block multiple) case
+whose masked rows/keys are the easy thing to get wrong.
+
+The performance claim (≥1.2× over the lax.scan blockwise path at
+[4, 3, 4096, 64] on a v5e — measured 1.5× fwd / 1.3× fwd+bwd, PERF.md) is
+hardware-gated and not asserted here.
+"""
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+import pytest
+
+from distribuuuu_tpu.ops import flash_attention as fa
+from distribuuuu_tpu.ops.ring_attention import reference_attention
+
+BLK = dict(blk_q=256, blk_k=256)
+
+
+@pytest.mark.parametrize(
+    "B,H,L,D",
+    [
+        (2, 3, 512, 64),   # block multiple
+        (1, 2, 300, 64),   # padded L (masked keys + padded q rows)
+        (2, 2, 640, 32),   # L > blk, not a multiple; small head dim
+    ],
+)
+def test_forward_matches_reference(B, H, L, D):
+    rng = np.random.default_rng(0)
+    q, k, v = (
+        jnp.asarray(rng.standard_normal((B, H, L, D)), jnp.float32)
+        for _ in range(3)
+    )
+    out = fa.flash_attention(q, k, v, interpret=True, **BLK)
+    ref = reference_attention(q, k, v)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=2e-5)
+
+
+@pytest.mark.parametrize("L", [512, 300])
+def test_gradients_match_reference(L):
+    rng = np.random.default_rng(1)
+    q, k, v = (
+        jnp.asarray(rng.standard_normal((1, 2, L, 64)), jnp.float32)
+        for _ in range(3)
+    )
+    w = jnp.asarray(rng.standard_normal((64,)), jnp.float32)
+
+    def loss(fn):
+        return lambda q, k, v: jnp.sum(fn(q, k, v) * w)
+
+    gf = jax.grad(
+        loss(lambda q, k, v: fa.flash_attention(q, k, v, interpret=True, **BLK)),
+        argnums=(0, 1, 2),
+    )(q, k, v)
+    gr = jax.grad(loss(reference_attention), argnums=(0, 1, 2))(q, k, v)
+    for a, b, name in zip(gf, gr, ("dq", "dk", "dv")):
+        np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), atol=5e-5, err_msg=name
+        )
+
+
+def test_cpu_fallback_is_blockwise():
+    """Off-TPU the public entry point must run (and agree) without Pallas."""
+    rng = np.random.default_rng(2)
+    q, k, v = (
+        jnp.asarray(rng.standard_normal((1, 2, 256, 32)), jnp.float32)
+        for _ in range(3)
+    )
+    out = fa.flash_attention(q, k, v)  # backend is cpu in tests → fallback
+    ref = reference_attention(q, k, v)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=2e-5)
+
+
+def test_causal_refused():
+    q = jnp.zeros((1, 1, 128, 32), jnp.float32)
+    with pytest.raises(NotImplementedError):
+        fa.flash_attention(q, q, q, causal=True)
+
+
+def test_auto_resolution_threshold():
+    """The 'auto' branch itself: flash at ≥1024 tokens with dropout 0,
+    dense below / with dropout; explicit impls pass through."""
+    from distribuuuu_tpu.models.vit import Attention
+
+    assert Attention.resolve_impl("auto", 1024, 0.0) == "flash"
+    assert Attention.resolve_impl("auto", 4096, 0.0) == "flash"
+    assert Attention.resolve_impl("auto", 1023, 0.0) == "xla"
+    assert Attention.resolve_impl("auto", 4096, 0.1) == "xla"  # no p-dropout
+    assert Attention.resolve_impl("xla", 4096, 0.0) == "xla"
+    assert Attention.resolve_impl("blockwise", 64, 0.0) == "blockwise"
+
+
+def test_vit_auto_resolves_by_length():
+    """Through the real model: a ≥1024-token input drives the auto→flash
+    branch (CPU fallback executes the blockwise math), a 64-token input
+    the auto→xla branch; both produce finite logits."""
+    from distribuuuu_tpu import models
+
+    rng = np.random.default_rng(3)
+    cases = [
+        (128, 16, "auto"),   # 64 tokens  → xla
+        (256, 8, "auto"),    # 1024 tokens → flash (threshold branch)
+        (128, 16, "flash"),  # forced flash, short seq
+    ]
+    for size, patch, impl in cases:
+        m = models.build_model(
+            "vit_tiny", num_classes=10, dtype=jnp.float32, patch=patch,
+            depth=1, dim=32, num_heads=2, attn_impl=impl,
+        )
+        x = jnp.asarray(
+            rng.standard_normal((1, size, size, 3)), jnp.float32
+        )
+        vs = m.init(jax.random.key(0), x, train=False)
+        logits = m.apply(vs, x, train=False)
+        assert np.isfinite(np.asarray(logits)).all(), (size, patch, impl)
+
+
+def test_trainer_accepts_flash_impl():
+    from distribuuuu_tpu import trainer
+    from distribuuuu_tpu.config import cfg
+
+    cfg.MODEL.ARCH = "vit_tiny"
+    cfg.DEVICE.ATTN_IMPL = "flash"
+    model = trainer.build_model_from_cfg()
+    assert model.attn_impl == "flash"
+    cfg.DEVICE.ATTN_IMPL = "auto"
+    model = trainer.build_model_from_cfg()
+    assert model.attn_impl == "auto"
