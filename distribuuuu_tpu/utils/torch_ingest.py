@@ -20,6 +20,9 @@ layout transposition, so any misalignment fails loudly:
   - linear weight [O, I]           →  kernel [I, O]
   - bn {weight, bias, running_mean, running_var}
         → params {scale, bias} + batch_stats {mean, var}
+  - embed (everything else: learned position/relative embeddings — botnet's
+    rel_height/rel_width, ViT's pos_embed) → copied 1:1 by order, exact
+    shape match required (embeddings share layout across frameworks)
 
 Torch is only needed when reading ``.pth`` pickles; a pre-extracted numpy
 ``state_dict``-style mapping works without torch installed.
@@ -87,7 +90,9 @@ def load_torch_state_dict(path: str) -> dict[str, np.ndarray]:
 
 
 def _torch_slots(state_dict: Mapping[str, np.ndarray]):
-    """Yield ('conv'|'linear'|'bn', dict) per module, in definition order."""
+    """Yield ('conv'|'linear'|'bn'|'embed', dict) per module, in definition
+    order. 'embed' entries are per-LEAF (one tensor each) because the flax
+    walk sees loose embedding params individually."""
     groups: dict[str, dict[str, np.ndarray]] = {}
     order: list[str] = []
     for key, val in state_dict.items():
@@ -110,8 +115,13 @@ def _torch_slots(state_dict: Mapping[str, np.ndarray]):
             # 1D weight without running stats: an affine norm layer saved
             # without stats — treat as bn with zero/one stats
             yield "bn", prefix, g
-        # anything else (buffers, pos embeddings) has no generic torch
-        # counterpart here and is left to arch-specific handling
+        else:
+            # loose learned tensors (position / relative embeddings):
+            # one slot per leaf, in insertion order
+            for leaf, val in g.items():
+                yield "embed", f"{prefix}.{leaf}" if prefix else leaf, {
+                    leaf: val
+                }
 
 
 # ---------------------------------------------------------------------------
@@ -159,14 +169,16 @@ def _flax_slots(params: Mapping, batch_stats: Mapping):
                 kind = "conv" if np.ndim(node["kernel"]) == 4 else "linear"
                 yield kind, path, dict(node)
                 return
-            # e.g. learned position embeddings — arch-specific, skipped here
-            yield "other", path, dict(node)
+            # learned embeddings saved as a leaf dict: one slot per leaf
+            for key, v in node.items():
+                yield "embed", path + (key,), {key: v}
             return
         for key, child in node.items():
             if isinstance(child, Mapping):
                 yield from walk(child, path + (key,))
             else:
-                yield "other", path + (key,), {key: _unwrap(child)}
+                # loose param directly on a module (pos_embed, rel_height…)
+                yield "embed", path + (key,), {key: _unwrap(child)}
 
     yield from walk(params, ())
 
@@ -194,7 +206,7 @@ def convert_state_dict(
     params = variables["params"]
     batch_stats = variables.get("batch_stats", {})
 
-    queues: dict[str, list] = {"conv": [], "linear": [], "bn": []}
+    queues: dict[str, list] = {"conv": [], "linear": [], "bn": [], "embed": []}
     for kind, prefix, group in _torch_slots(state_dict):
         queues[kind].append((prefix, group))
 
@@ -203,11 +215,6 @@ def convert_state_dict(
     new_stats: dict = {}
 
     for kind, path, leaves in _flax_slots(params, batch_stats):
-        if kind == "other":
-            raise ValueError(
-                f"flax module at {'/'.join(path)} has no torch equivalent "
-                f"(leaves: {list(leaves)}); arch not ingestible generically"
-            )
         if counts[kind] >= len(queues[kind]):
             raise ValueError(
                 f"torch checkpoint ran out of {kind} modules at flax path "
@@ -224,7 +231,24 @@ def convert_state_dict(
                     f"{tuple(want_shape)} — architecture/order mismatch"
                 )
 
-        if kind == "conv":
+        if kind == "embed":
+            # path ends with the leaf name; embeddings copy 1:1 (no layout
+            # transpose — both frameworks store them identically). The
+            # trailing names must MATCH: same-shape embeddings (botnet's
+            # rel_height/rel_width on a square grid) would otherwise swap
+            # silently, and this module's contract is to fail loudly.
+            (leaf_name, want) = next(iter(leaves.items()))
+            (t_leaf, got) = next(iter(group.items()))
+            if t_leaf != leaf_name:
+                raise ValueError(
+                    f"embedding name mismatch at flax {'/'.join(path)} ↔ "
+                    f"torch '{prefix}': '{leaf_name}' vs '{t_leaf}' — if the "
+                    "source checkpoint uses different names, rename its "
+                    "keys to match before ingesting"
+                )
+            check(t_leaf, got, np.shape(want))
+            _set_in(new_params, path[:-1], path[-1], np.asarray(got))
+        elif kind == "conv":
             w = np.transpose(group["weight"], (2, 3, 1, 0))  # OIHW → HWIO
             check("weight", w, np.shape(leaves["kernel"]))
             _set_in(new_params, path, "kernel", np.ascontiguousarray(w))

@@ -58,6 +58,7 @@ def _train_params_sum(seed):
     return [np.asarray(x) for x in jax.tree.leaves(state.params)]
 
 
+@pytest.mark.slow  # dominates the fast tier; full tier covers it
 def test_fixed_seed_is_bit_reproducible():
     a = _train_params_sum(7)
     b = _train_params_sum(7)

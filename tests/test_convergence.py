@@ -17,6 +17,10 @@ from distribuuuu_tpu import trainer
 from distribuuuu_tpu.parallel import mesh as mesh_lib, sharding as sharding_lib
 from distribuuuu_tpu.utils.optim import construct_optimizer
 
+import pytest
+
+pytestmark = pytest.mark.slow  # multi-minute on the 1-core CPU mesh
+
 
 def synthetic_batch(rng, n):
     images = rng.standard_normal((n, 32, 32, 3)).astype(np.float32)

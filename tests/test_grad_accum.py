@@ -11,6 +11,8 @@ import pytest
 import distribuuuu_tpu.config as config
 from distribuuuu_tpu.config import cfg
 
+pytestmark = pytest.mark.slow  # multi-minute on the 1-core CPU mesh
+
 
 class _TinyMLP(nn.Module):
     """BN-free, dropout-free model with the zoo's apply signature — isolates

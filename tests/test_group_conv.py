@@ -8,6 +8,7 @@ import numpy as np
 from jax import lax
 
 from distribuuuu_tpu.models.layers import ConvBN
+import pytest
 
 
 def _conv_bn(groups, features=256):
@@ -83,6 +84,7 @@ def test_group_conv_checkpoint_compatible_across_widths():
     )
 
 
+@pytest.mark.slow  # dominates the fast tier; full tier covers it
 def test_unrolled_group_conv_composes_with_tensor_parallel():
     """The unrolled path slices the kernel's OUT dim, which TP shards over
     `model` — GSPMD must resolve slice-across-shard without error."""

@@ -11,6 +11,8 @@ import pytest
 
 from distribuuuu_tpu.models import available_models, build_model
 
+pytestmark = pytest.mark.slow  # multi-minute on the 1-core CPU mesh
+
 # arch -> M params (torch/torchvision + reference README published values;
 # the timm-sourced archs use the reference baseline table README.md:206-217)
 PARAM_ORACLE = {

@@ -54,6 +54,7 @@ def test_fused_gradients_match():
         )
 
 
+@pytest.mark.slow  # dominates the fast tier; full tier covers it
 def test_botnet_forward_with_pallas_impl():
     from distribuuuu_tpu import models
 

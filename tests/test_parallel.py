@@ -18,6 +18,8 @@ from distribuuuu_tpu.parallel import (
     shard_batch,
 )
 
+pytestmark = pytest.mark.slow  # multi-minute on the 1-core CPU mesh
+
 
 def test_virtual_mesh_has_8_devices():
     assert len(jax.devices()) == 8

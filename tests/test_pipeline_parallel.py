@@ -11,6 +11,8 @@ import pytest
 
 from distribuuuu_tpu.parallel import mesh as mesh_lib, pp
 
+pytestmark = pytest.mark.slow  # multi-minute on the 1-core CPU mesh
+
 FEAT = 16
 
 

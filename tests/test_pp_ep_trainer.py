@@ -19,6 +19,8 @@ from distribuuuu_tpu.config import cfg
 from distribuuuu_tpu.parallel import mesh as mesh_lib, sharding as sharding_lib
 from distribuuuu_tpu.utils.optim import construct_optimizer
 
+pytestmark = pytest.mark.slow  # multi-minute on the 1-core CPU mesh
+
 
 def _tiny_vit_cfg(pipe=1, model_axis=1, arch="vit_tiny"):
     cfg.MODEL.ARCH = arch

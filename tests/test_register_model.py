@@ -1,0 +1,96 @@
+"""`models.register_model` — the documented answer to the reference's timm
+fallback (ref: /root/reference/distribuuuu/trainer.py:123-128 falls back to
+`timm.create_model` for unknown archs; this zoo is closed + explicitly
+extensible instead — VERDICT r1 item 9).
+
+A custom arch registered through the public decorator must work everywhere
+an arch name does: the registry, `build_model_from_cfg`, and a real jitted
+train step via the YAML-configured trainer path.
+"""
+
+from typing import Any
+
+import numpy as np
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+import pytest
+
+from distribuuuu_tpu import models, trainer
+from distribuuuu_tpu.config import cfg
+from distribuuuu_tpu.parallel import mesh as mesh_lib, sharding as sharding_lib
+from distribuuuu_tpu.utils.optim import construct_optimizer
+
+
+class TinyNet(nn.Module):
+    """Minimal custom arch: conv → GAP → head. Accepts the trainer's
+    standard kwargs (dtype, bn_group) like any zoo arch."""
+
+    num_classes: int = 10
+    dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        x = x.astype(self.dtype)
+        x = nn.Conv(8, (3, 3), dtype=self.dtype, param_dtype=jnp.float32)(x)
+        x = nn.relu(x)
+        x = x.mean(axis=(1, 2))
+        return nn.Dense(self.num_classes, dtype=jnp.float32)(
+            x.astype(jnp.float32)
+        )
+
+
+@pytest.fixture()
+def registered(monkeypatch):
+    """Register a custom arch through the REAL public decorator (so a
+    regression in register_model itself fails these tests), cleaning the
+    registry up afterwards."""
+    # ensure cleanup even though registration goes through the decorator
+    monkeypatch.delitem(models._REGISTRY, "tiny_custom", raising=False)
+
+    @models.register_model
+    def tiny_custom(num_classes=1000, dtype=jnp.float32, bn_group=0, **kw):
+        return TinyNet(num_classes=num_classes, dtype=dtype)
+
+    assert models._REGISTRY["tiny_custom"] is tiny_custom  # decorator works
+    yield tiny_custom
+    models._REGISTRY.pop("tiny_custom", None)
+
+
+def test_registry_rejects_unknown_arch():
+    with pytest.raises(KeyError, match="Unknown arch"):
+        models.build_model("definitely_not_registered")
+
+
+def test_registered_arch_builds(registered):
+    m = models.build_model("tiny_custom", num_classes=7, dtype=jnp.float32)
+    assert isinstance(m, TinyNet) and m.num_classes == 7
+
+
+def test_registered_arch_trains_via_cfg(registered):
+    """The YAML-visible path: MODEL.ARCH names the custom arch and the
+    normal trainer machinery runs a step on it."""
+    cfg.MODEL.ARCH = "tiny_custom"
+    cfg.MODEL.NUM_CLASSES = 10
+    cfg.DEVICE.COMPUTE_DTYPE = "float32"
+    trainer.check_trainer_mesh()
+    mesh = mesh_lib.build_mesh()
+    model = trainer.build_model_from_cfg()
+    state = trainer.create_train_state(model, jax.random.key(0), mesh, 16)
+    step = trainer.make_train_step(model, construct_optimizer(), topk=5)
+    rng = np.random.default_rng(0)
+    batch = sharding_lib.shard_batch(
+        mesh,
+        {
+            "image": rng.standard_normal((16, 16, 16, 3)).astype(np.float32),
+            "label": rng.integers(0, 10, size=(16,)).astype(np.int32),
+            "mask": np.ones((16,), np.float32),
+        },
+    )
+    state, metrics = step(state, batch)
+    assert np.isfinite(float(metrics["loss"]))
+
+
+def test_register_model_decorator_is_public():
+    assert callable(models.register_model)
+    assert "tiny_custom" not in models.available_models()  # fixtures clean up

@@ -48,6 +48,7 @@ def test_ulysses_matches_reference(mesh, causal):
                                rtol=1e-5, atol=1e-5)
 
 
+@pytest.mark.slow  # dominates the fast tier; full tier covers it
 def test_ring_gradients_match(mesh):
     q, k, v = _qkv(s=16, seed=2)
 

@@ -8,6 +8,7 @@ import numpy as np
 
 import distribuuuu_tpu.config as config
 from distribuuuu_tpu.config import cfg
+import pytest
 
 
 def _stem_pair():
@@ -88,6 +89,7 @@ def test_resnet_checkpoint_compatible_across_modes():
     np.testing.assert_allclose(np.asarray(a), np.asarray(b), rtol=5e-4, atol=5e-4)
 
 
+@pytest.mark.slow  # dominates the fast tier; full tier covers it
 def test_densenet_checkpoint_compatible_across_modes():
     from distribuuuu_tpu import models
 

@@ -7,6 +7,10 @@ import numpy as np
 import distribuuuu_tpu.config as config
 from distribuuuu_tpu.config import cfg
 
+import pytest
+
+pytestmark = pytest.mark.slow  # multi-minute on the 1-core CPU mesh
+
 
 def _setup(arch="resnet18"):
     import jax

@@ -18,6 +18,10 @@ from distribuuuu_tpu.config import cfg
 from distribuuuu_tpu.parallel import mesh as mesh_lib, sharding as sharding_lib, tp
 from distribuuuu_tpu.utils.optim import construct_optimizer
 
+import pytest
+
+pytestmark = pytest.mark.slow  # multi-minute on the 1-core CPU mesh
+
 
 def _make_batch(n, im=32, classes=10, seed=0):
     rng = np.random.default_rng(seed)

@@ -3,6 +3,7 @@
 import os
 import subprocess
 import sys
+import pytest
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
@@ -19,6 +20,7 @@ def _run(args, timeout=400):
     )
 
 
+@pytest.mark.slow  # dominates the fast tier; full tier covers it
 def test_zoo_check_single_arch():
     out = _run(
         ["tools/zoo_check.py", "--arch", "resnet18", "--batch", "2",

@@ -12,6 +12,8 @@ import pytest
 import distribuuuu_tpu.config as config
 from distribuuuu_tpu.config import cfg
 
+pytestmark = pytest.mark.slow  # multi-minute on the 1-core CPU mesh
+
 
 def _tiny_cfg(tmp_path, arch="resnet18", max_epoch=1):
     config.reset_cfg()

@@ -53,6 +53,7 @@ def test_seq_parallel_attention_matches_dense(impl):
     np.testing.assert_allclose(np.asarray(got), np.asarray(want), atol=2e-4)
 
 
+@pytest.mark.slow  # dominates the fast tier; full tier covers it
 def test_config_driven_seq_parallel_vit():
     """MESH.SEQ>1 + vit arch wires ring attention through the trainer path;
     MESH.SEQ>1 + CNN arch is refused."""
