@@ -382,11 +382,14 @@ class PipelinedViT(_ViTCommon):
                 "dropout inside pipeline stages is not supported (stage "
                 "apply runs under shard_map without an rng); set dropout=0"
             )
-        if self.attn_impl != "xla":
+        if self.attn_impl not in ("auto", "xla"):
+            # ("auto" is accepted and resolves to dense XLA here: stage
+            # apply runs under shard_map, where neither the flash kernel
+            # nor sequence-sharded attention composes with the pipe axis)
             raise ValueError(
                 "PipelinedViT uses dense XLA attention inside stages; "
-                "sequence-sharded attention does not compose with the pipe "
-                f"axis (got attn_impl={self.attn_impl!r})"
+                "flash/sequence-sharded attention does not compose with "
+                f"the pipe axis (got attn_impl={self.attn_impl!r})"
             )
         return ViTStage(
             self.dim, self.num_heads, self.mlp_ratio, 0.0, self.dtype,

@@ -46,8 +46,10 @@ def _resolve_blocks(L: int, blk_q: int, blk_k: int):
     length. Both invariants the kernels rely on hold by construction
     (lp % blk == 0 for q AND k — a floor-divided remainder would silently
     drop keys / leave output rows unwritten), and the padding overhead is
-    ≤127 rows. This matters for ViT's grid²+1 sequences: L=4097 pads to
-    4224 with blk 768 (+3% work) rather than to an lcm multiple (+25%)."""
+    ≤127 rows for ANY length — e.g. a cls-token sequence L=4097 resolves
+    to lp=4224 with blk 384 (+3% work) where lcm-based padding would have
+    cost a whole extra block (+25%). Power-of-two lengths keep the full
+    requested blocks (L=4096 → blk 1024, the tuned default)."""
     lp = _round_up(L, 128)
 
     def pick(req):

@@ -12,9 +12,12 @@ through silently; against the checked-in goldens any numerics drift fails.
 Source images are regenerated from seeds as raw arrays (no codec in the
 loop — PIL↔native codec agreement is tests/test_native_decode.py's job).
 
-PIL path must match byte-tight (identical code path, deterministic
-fixed-point resampling); the native C++ path must match within its
-documented resampler quantization bound (native/decode.cc).
+The repo-owned streams (RRC boxes, flips, geoms) must match EXACTLY —
+they are pure Python/numpy. The resampled pixel outputs go through
+Pillow's C bilinear resampler, so they get a ±2-count tolerance (a
+Pillow upgrade may legally shift rounding by one uint8 count); the
+native C++ path matches within its documented quantization bound
+(native/decode.cc).
 """
 
 import os
@@ -38,12 +41,17 @@ def _cases(golden):
         yield idx, golden[f"src_{idx}"]
 
 
+# ±2 uint8 counts in normalized space: 2/255 / min(std) ≈ 0.035
+RESAMPLE_ATOL = 0.035
+
+
 def test_val_pipeline_matches_golden(golden):
     for idx, src in _cases(golden):
         img = Image.fromarray(src)
         got = T.val_transform(img, 48, 32)
-        np.testing.assert_array_equal(
-            got, golden[f"val_{idx}"], err_msg=f"val case {idx}"
+        np.testing.assert_allclose(
+            got, golden[f"val_{idx}"], atol=RESAMPLE_ATOL,
+            err_msg=f"val case {idx}",
         )
 
 
@@ -52,8 +60,9 @@ def test_train_pipeline_matches_golden(golden):
         img = Image.fromarray(src)
         rng = np.random.default_rng(1000 + idx)
         got = T.train_transform(img, 32, rng)
-        np.testing.assert_array_equal(
-            got, golden[f"train_{idx}"], err_msg=f"train case {idx}"
+        np.testing.assert_allclose(
+            got, golden[f"train_{idx}"], atol=RESAMPLE_ATOL,
+            err_msg=f"train case {idx}",
         )
 
 

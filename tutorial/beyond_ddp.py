@@ -10,6 +10,10 @@ axes of scale as first-class, and they all hang off the same
   3. PP — a GPipe pipeline over ``pipe`` with gradients through the schedule.
   4. EP — a routed mixture-of-experts layer over ``model``.
 
+These are the primitives; the trainer reaches PP and EP straight from
+YAML too — ``train_net.py --cfg config/vit_tiny.yaml MESH.PIPE 4`` and
+``--cfg config/vit_tiny_moe.yaml MESH.MODEL 2`` (see README "Mesh axes").
+
 Run:
 
     JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8 \
