@@ -456,6 +456,88 @@ class PipelinedViT(_ViTCommon):
         return self._head(x)
 
 
+def _is_boxed(x):
+    return isinstance(x, nn.Partitioned)
+
+
+def pipe_to_flat_params(params):
+    """PipelinedViT params → plain ViT params (same weights).
+
+    The stacked ``stages`` tree (leading dim S, blocks ``Block_j`` within a
+    stage) scatters to top-level ``Block_{s·k+j}``; embed/head params keep
+    their shared top-level names (``_ViTCommon``), so the result loads
+    straight into the non-pipelined :class:`ViT` — train pipelined,
+    evaluate (or resume) anywhere.
+
+    Partitioning metadata is handled: slicing drops the leading ``pipe``
+    axis name along with the stage dim, and leaves whose remaining names
+    are all ``None`` unbox back to plain arrays — the exact inverse of
+    ``init_stages``' rebox, so boxed ``model.init`` output converts to the
+    layout a plain ViT's init produces.
+    """
+    stages = params["stages"]
+    block_names = sorted(stages, key=lambda n: int(n.split("_")[-1]))
+    k = len(block_names)
+    S = jax.tree.leaves(stages)[0].shape[0]
+
+    def slice_leaf(a, s):
+        if _is_boxed(a):
+            names = tuple(a.names)[1:]  # drop the 'pipe' axis name
+            if any(n is not None for n in names):
+                return nn.Partitioned(a.value[s], names=names)
+            return a.value[s]
+        return a[s]
+
+    out = {}
+    for name, sub in params.items():
+        if name != "stages":
+            out[name] = sub
+    for s in range(S):
+        for j, bname in enumerate(block_names):
+            out[f"Block_{s * k + j}"] = jax.tree.map(
+                lambda a: slice_leaf(a, s), stages[bname], is_leaf=_is_boxed
+            )
+    return out
+
+
+def flat_to_pipe_params(params, pipe_stages: int):
+    """Plain ViT params → PipelinedViT params (inverse of
+    :func:`pipe_to_flat_params`): ``Block_{s·k+j}`` stacks into
+    ``stages/Block_j`` with leading dim ``pipe_stages``, every stacked
+    leaf boxed with a leading ``pipe`` axis name (inner TP names
+    preserved) — the same metadata ``PipelinedViT``'s ``init_stages``
+    establishes, so sharding derivation places the result correctly."""
+    blocks = {
+        int(n.split("_")[-1]): sub
+        for n, sub in params.items()
+        if n.startswith("Block_")
+    }
+    depth = len(blocks)
+    if depth % pipe_stages:
+        raise ValueError(
+            f"{depth} blocks do not split into {pipe_stages} stages"
+        )
+    k = depth // pipe_stages
+
+    def stack_leaves(*xs):
+        if _is_boxed(xs[0]):
+            vals = jnp.stack([x.value for x in xs])
+            return nn.Partitioned(vals, names=("pipe",) + tuple(xs[0].names))
+        vals = jnp.stack(xs)
+        return nn.Partitioned(vals, names=("pipe",) + (None,) * xs[0].ndim)
+
+    out = {n: sub for n, sub in params.items() if not n.startswith("Block_")}
+    stages = {}
+    for j in range(k):
+        stages[f"Block_{j}"] = jax.tree.map(
+            stack_leaves,
+            *[blocks[s * k + j] for s in range(pipe_stages)],
+            is_leaf=_is_boxed,
+        )
+    out["stages"] = stages
+    return out
+
+
 def _vit(num_classes, kw, **defaults):
     for k, v in defaults.items():
         kw.setdefault(k, v)
