@@ -223,6 +223,11 @@ _C.TRAIN.IM_SIZE = 224
 _C.TRAIN.BATCH_SIZE = 32
 _C.TRAIN.AUTO_RESUME = True
 _C.TRAIN.LOAD_OPT = True
+# Preemption-safe training (utils/preempt.py): on SIGTERM the epoch loop
+# stops at the next dispatch boundary and writes a mid-epoch checkpoint
+# that AUTO_RESUME prefers — the interrupted epoch re-runs from the
+# preserved params/optimizer state instead of the last epoch boundary.
+_C.TRAIN.PREEMPT_SAVE = True
 _C.TRAIN.WORKERS = 4
 _C.TRAIN.PIN_MEMORY = True
 _C.TRAIN.PRINT_FREQ = 30

@@ -40,6 +40,7 @@ from distribuuuu_tpu.parallel import (
     sharding as sharding_lib,
 )
 from distribuuuu_tpu.utils import checkpoint as ckpt
+from distribuuuu_tpu.utils import preempt
 from distribuuuu_tpu.utils.logger import get_logger, setup_logger
 from distribuuuu_tpu.utils.meters import construct_meters
 from distribuuuu_tpu.utils.metrics import accuracy, count_parameters, cross_entropy
@@ -429,11 +430,24 @@ def train_epoch(loader, mesh, state, train_step, epoch: int, logger,
     back to ``train_step``. Metric fetch still happens at PRINT_FREQ batch
     granularity (rounded up to the fold size); the profiler window rounds to
     call boundaries.
+
+    Returns ``(state, interrupted)``: with ``TRAIN.PREEMPT_SAVE`` on, a
+    SIGTERM (utils/preempt.py) ends the epoch at the next dispatch
+    boundary with ``interrupted=True`` so the caller can write the
+    mid-epoch checkpoint.
     """
     lr = get_epoch_lr(epoch)
     set_lr(state.opt_state, lr)  # epoch-granular LR (ref: trainer.py:25-26)
     loader.set_epoch(epoch)  # reshuffle shards (ref: trainer.py:33)
     num_batches = len(loader)
+    watch_preemption = cfg.TRAIN.PREEMPT_SAVE
+    interrupted = False
+    # multi-host: the cross-host flag agreement is a blocking collective,
+    # so run it only every Nth window (deterministic sites — every process
+    # reaches the same ones, exit stays agreed). Single-process reads the
+    # local bool — free, so check every window.
+    preempt_check_every = 1 if jax.process_count() == 1 else 8
+    windows_seen = 0
     fold = max(1, cfg.TRAIN.STEPS_PER_CALL) if scan_step is not None else 1
     accum = max(1, cfg.TRAIN.GRAD_ACCUM_STEPS)
 
@@ -556,8 +570,29 @@ def train_epoch(loader, mesh, state, train_step, epoch: int, logger,
             batch_time.update(time.perf_counter() - end)
         end = time.perf_counter()
         maybe_print()
+        # preemption check at window granularity: requested_global() makes
+        # every process agree on the exit boundary (the save is collective).
+        # A COMPLETED epoch never reports interrupted — it falls through to
+        # the normal validate/save path (re-running a fully-trained epoch
+        # from its own end state would double-train it).
+        batches_done = done if fold > 1 else it + 1
+        windows_seen += 1
+        if (
+            watch_preemption
+            and batches_done < num_batches
+            and windows_seen % preempt_check_every == 0
+            and preempt.requested_global()
+        ):
+            flush_pending()
+            if mesh_lib.is_primary():
+                logger.warning(
+                    "preemption signaled — leaving epoch %d at batch %d/%d",
+                    epoch + 1, batches_done, num_batches,
+                )
+            interrupted = True
+            break
     prof.finish(state)
-    return state
+    return state, interrupted
 
 
 def validate(loader, mesh, state, eval_step, epoch: int, logger):
@@ -786,10 +821,40 @@ def train_model():
             "MODEL.PRETRAINED True (evaluation uses test_net.py)"
         )
 
+    if cfg.TRAIN.PREEMPT_SAVE:
+        preempt.install()
+
+    def _preempt_exit(path, resume_epoch):
+        if mesh_lib.is_primary():
+            logger.warning(
+                "preempted: state saved to %s; rerun to resume at epoch %d",
+                path, resume_epoch + 1,
+            )
+        return best_acc1
+
     for epoch in range(start_epoch, cfg.OPTIM.MAX_EPOCH):
-        state = train_epoch(loader=train_loader, mesh=mesh, state=state,
-                            train_step=train_step, epoch=epoch, logger=logger,
-                            first_epoch=start_epoch, scan_step=scan_step)
+        state, interrupted = train_epoch(
+            loader=train_loader, mesh=mesh, state=state,
+            train_step=train_step, epoch=epoch, logger=logger,
+            first_epoch=start_epoch, scan_step=scan_step)
+        watching = cfg.TRAIN.PREEMPT_SAVE
+        if interrupted:
+            # mid-epoch preemption: persist now; the next run's AUTO_RESUME
+            # prefers this checkpoint and re-runs this epoch from it
+            # (utils/preempt.py has the full story)
+            path = ckpt.save_preempt_checkpoint(
+                _state_tree(state), epoch, best_acc1
+            )
+            return _preempt_exit(path, epoch)
+        if watching and preempt.requested_global():
+            # signaled between the last batch and validate: the epoch is
+            # COMPLETE — skip the (possibly long) validation, save the
+            # finished state with cursor `epoch`, exit inside the grace
+            # window; resume continues at epoch+1
+            path = ckpt.save_preempt_checkpoint(
+                _state_tree(state), epoch + 1, best_acc1
+            )
+            return _preempt_exit(path, epoch + 1)
         acc1, _ = validate(val_loader, mesh, state, eval_step, epoch, logger)
         is_best = acc1 > best_acc1
         best_acc1 = max(acc1, best_acc1)
@@ -798,6 +863,10 @@ def train_model():
             logger.info(
                 "epoch %d done: Acc@1 %.3f (best %.3f)", epoch + 1, acc1, best_acc1
             )
+        if watching and preempt.requested_global():
+            # signaled during validate/save: ckpt_ep_{epoch} is already on
+            # disk — nothing more to persist, just exit promptly
+            return _preempt_exit(ckpt.get_checkpoint(epoch), epoch + 1)
     return best_acc1
 
 

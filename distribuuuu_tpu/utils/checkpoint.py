@@ -26,6 +26,10 @@ from distribuuuu_tpu.config import cfg
 
 _NAME_PREFIX = "ckpt_ep_"
 _BEST_NAME = "best"
+# mid-epoch checkpoint written on preemption (utils/preempt.py); the number
+# is the INTERRUPTED epoch, so preempt_ep_e outranks ckpt_ep_{e-1} (it holds
+# strictly newer optimizer progress) and is superseded by ckpt_ep_e.
+_PREEMPT_PREFIX = "preempt_ep_"
 
 
 def get_checkpoint_dir() -> str:
@@ -42,46 +46,73 @@ def get_best_checkpoint() -> str:
     return os.path.join(get_checkpoint_dir(), _BEST_NAME)
 
 
-def get_last_checkpoint() -> str:
-    """Latest epoch checkpoint by numeric order (ref: utils.py:337-342)."""
+def _scan(prefix: str) -> dict[int, str]:
     d = get_checkpoint_dir()
-    names = [
-        f
-        for f in os.listdir(d)
-        if re.fullmatch(_NAME_PREFIX + r"\d+", f)
-        and os.path.isdir(os.path.join(d, f))
-    ]
-    if not names:
-        raise FileNotFoundError(f"No checkpoints in {d}")
-    return os.path.join(d, sorted(names)[-1])
+    if not os.path.isdir(d):
+        return {}
+    out = {}
+    for f in os.listdir(d):
+        if re.fullmatch(prefix + r"\d+", f) and os.path.isdir(
+            os.path.join(d, f)
+        ):
+            out[int(f[len(prefix):])] = os.path.join(d, f)
+    return out
+
+
+def get_last_checkpoint() -> str:
+    """Latest resumable checkpoint (ref numeric-order pick: utils.py:337-342),
+    extended for preemption: ``preempt_ep_e`` (mid-epoch state of an
+    interrupted epoch e) is preferred over ``ckpt_ep_{e-1}`` and ignored as
+    stale once ``ckpt_ep_e`` exists."""
+    epochs = _scan(_NAME_PREFIX)
+    preempts = _scan(_PREEMPT_PREFIX)
+    last_epoch = max(epochs) if epochs else -1
+    live_preempts = {e: p for e, p in preempts.items() if e > last_epoch}
+    if live_preempts:
+        return live_preempts[max(live_preempts)]
+    if epochs:
+        return epochs[last_epoch]
+    raise FileNotFoundError(f"No checkpoints in {get_checkpoint_dir()}")
 
 
 def has_checkpoint() -> bool:
     """Any checkpoint to resume from? (ref: utils.py:345-350)"""
-    d = get_checkpoint_dir()
-    if not os.path.isdir(d):
-        return False
-    return any(re.fullmatch(_NAME_PREFIX + r"\d+", f) for f in os.listdir(d))
+    return bool(_scan(_NAME_PREFIX) or _scan(_PREEMPT_PREFIX))
+
+
+def _save_full(path: str, state_tree: dict, epoch_cursor: int, best_acc1: float):
+    """The one save protocol: reference-shaped payload {epoch, state,
+    best_acc1} (ref: utils.py:375-380), collective orbax write (every
+    process participates; array shards written by their owners)."""
+    os.makedirs(get_checkpoint_dir(), exist_ok=True)
+    payload = dict(state_tree)
+    payload["epoch"] = np.int32(epoch_cursor)
+    payload["best_acc1"] = np.float32(best_acc1)
+    ocp.PyTreeCheckpointer().save(path, payload, force=True)
+    return path
 
 
 def save_checkpoint(state_tree: dict, epoch: int, best_acc1: float, is_best: bool):
-    """Save a full training checkpoint; side-write weights-only ``best``.
-
-    The payload mirrors the reference dict {epoch, state_dict, optimizer,
-    best_acc1} (ref: utils.py:375-380). All processes must call this
-    (collective); orbax writes each array shard from its owning host.
-    """
-    os.makedirs(get_checkpoint_dir(), exist_ok=True)
-    payload = dict(state_tree)
-    payload["epoch"] = np.int32(epoch)
-    payload["best_acc1"] = np.float32(best_acc1)
-    ckptr = ocp.PyTreeCheckpointer()
-    path = get_checkpoint(epoch)
-    ckptr.save(path, payload, force=True)
+    """Save a full training checkpoint; side-write weights-only ``best``."""
+    path = _save_full(get_checkpoint(epoch), state_tree, epoch, best_acc1)
     if is_best:
         best = {"params": state_tree["params"], "batch_stats": state_tree["batch_stats"]}
-        ckptr.save(get_best_checkpoint(), best, force=True)
+        ocp.PyTreeCheckpointer().save(get_best_checkpoint(), best, force=True)
     return path
+
+
+def save_preempt_checkpoint(state_tree: dict, epoch: int, best_acc1: float):
+    """Mid-epoch checkpoint on preemption (utils/preempt.py).
+
+    ``epoch`` is the epoch being interrupted; the stored cursor is
+    ``epoch - 1`` so the normal resume path re-runs the interrupted epoch
+    from this (strictly newer) params/optimizer state. Same collective
+    save protocol as ``save_checkpoint`` (``_save_full``).
+    """
+    return _save_full(
+        os.path.join(get_checkpoint_dir(), f"{_PREEMPT_PREFIX}{epoch:03d}"),
+        state_tree, epoch - 1, best_acc1,
+    )
 
 
 def load_checkpoint(path: str):

@@ -1,0 +1,62 @@
+"""Preemption-safe training (TPU-native extension; no reference analogue).
+
+TPU slices are routinely preempted — the scheduler delivers SIGTERM with a
+grace window. The reference's whole recovery story is restart + epoch
+auto-resume (ref: /root/reference/distribuuuu/trainer.py:143-149), which
+loses every step of the interrupted epoch. Here the trainer installs a
+signal handler; when preemption is signaled, the epoch loop stops at the
+next dispatch boundary and writes a mid-epoch checkpoint
+(``utils/checkpoint.py::save_preempt_checkpoint``) that auto-resume
+prefers — the interrupted epoch is re-run, but from the preserved
+params/optimizer state rather than the last epoch boundary.
+
+Multi-host: each host may receive the signal at a different moment, and
+the checkpoint save is a collective — so the loop consults
+``requested_global()``, an OR of the per-host flags via
+``process_allgather``, guaranteeing every process leaves the epoch at the
+same boundary. At world size 1 this is a local bool check (free).
+"""
+
+from __future__ import annotations
+
+import signal
+
+import jax
+
+_state = {"requested": False, "installed": False}
+
+
+def install(signals=(signal.SIGTERM,)) -> None:
+    """Install the preemption handler (idempotent). Call from the main
+    thread before the epoch loop (the trainer does this when
+    ``TRAIN.PREEMPT_SAVE`` is on)."""
+
+    def handler(signum, frame):
+        _state["requested"] = True
+
+    for s in signals:
+        signal.signal(s, handler)
+    _state["installed"] = True
+
+
+def requested_local() -> bool:
+    return _state["requested"]
+
+
+def requested_global() -> bool:
+    """True iff ANY process has seen the signal — all processes agree on
+    the answer, so the collective checkpoint save lines up."""
+    if jax.process_count() == 1:
+        return _state["requested"]
+    import numpy as np
+    from jax.experimental import multihost_utils
+
+    flags = multihost_utils.process_allgather(
+        np.int32(1 if _state["requested"] else 0)
+    )
+    return bool(np.asarray(flags).sum() > 0)
+
+
+def reset() -> None:
+    """Clear the flag (tests; also after a handled preemption save)."""
+    _state["requested"] = False

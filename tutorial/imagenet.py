@@ -117,9 +117,23 @@ def main():
     train_step = trainer.make_train_step(model, optimizer, topk)
     eval_step = trainer.make_eval_step(model, topk)
 
+    from distribuuuu_tpu.utils import preempt
+
+    preempt.install()  # SIGTERM → clean mid-epoch exit (utils/preempt.py)
+
     best = 0.0
     for epoch in range(cfg.OPTIM.MAX_EPOCH):
-        state = trainer.train_epoch(train_loader, mesh, state, train_step, epoch, logger)
+        state, interrupted = trainer.train_epoch(
+            train_loader, mesh, state, train_step, epoch, logger
+        )
+        if interrupted:
+            # preemption: persist progress the way the full trainer does
+            # (trainer.train_model) so a rerun resumes this epoch
+            path = ckpt.save_preempt_checkpoint(
+                trainer._state_tree(state), epoch, best
+            )
+            print(f"preempted — state saved to {path}")
+            break
         acc1, _ = trainer.validate(val_loader, mesh, state, eval_step, epoch, logger)
         best = max(best, acc1)
         ckpt.save_checkpoint(trainer._state_tree(state), epoch, best, acc1 >= best)
