@@ -597,9 +597,13 @@ def train_epoch(loader, mesh, state, train_step, epoch: int, logger,
 
 def validate(loader, mesh, state, eval_step, epoch: int, logger):
     """Full evaluation pass; returns (top1, topk) percentages
-    (ref: trainer.py:67-103). Per-batch progress at TEST.PRINT_FREQ
-    (≙ ref validate's meter display, trainer.py:91-95) — totals stay on
-    device between prints so batches dispatch asynchronously."""
+    (ref: trainer.py:67-103), or ``None`` if preemption was signaled
+    mid-eval (``TRAIN.PREEMPT_SAVE`` — the caller persists state and
+    exits inside the grace window rather than finishing a long eval).
+    Per-batch progress at TEST.PRINT_FREQ (≙ ref validate's meter display,
+    trainer.py:91-95) — totals stay on device between prints so batches
+    dispatch asynchronously."""
+    watch_preemption = cfg.TRAIN.PREEMPT_SAVE
     totals = None
     pending_print = None  # previous window's (batch_idx, totals) — async copy
     num_batches = len(loader)
@@ -612,6 +616,20 @@ def validate(loader, mesh, state, eval_step, epoch: int, logger):
             if totals is None
             else jax.tree.map(jnp.add, totals, m)
         )
+        if (
+            watch_preemption
+            and (it + 1) % cfg.TEST.PRINT_FREQ == 0
+            and it + 1 < num_batches
+            and preempt.requested_global()
+        ):
+            # deterministic check sites (same batch indices on every
+            # process) — abandon the eval; the caller saves and exits
+            if mesh_lib.is_primary():
+                logger.warning(
+                    "preemption signaled — abandoning eval at batch %d/%d",
+                    it + 1, num_batches,
+                )
+            return None
         if (it + 1) % cfg.TEST.PRINT_FREQ == 0 and mesh_lib.is_primary():
             # async metric fetch (same treatment the train loop gives its
             # metrics): start the host copy of THIS window's totals and log
@@ -712,6 +730,8 @@ def _resume(state: TrainState, mesh) -> tuple[TrainState, int, float]:
             logger.warning("optimizer state not restored (%s); fresh optimizer", e)
     start_epoch = int(restored.get("epoch", -1)) + 1
     best_acc1 = float(restored.get("best_acc1", 0.0))
+    pending = restored.get("pending_eval")
+    pending_eval = None if pending is None else int(pending)
     logger.info("resumed from %s (epoch %d)", path, start_epoch)
     return (
         TrainState(
@@ -723,6 +743,7 @@ def _resume(state: TrainState, mesh) -> tuple[TrainState, int, float]:
         ),
         start_epoch,
         best_acc1,
+        pending_eval,
     )
 
 
@@ -798,9 +819,9 @@ def train_model():
         )
     eval_step = make_eval_step(model, effective_topk())
 
-    start_epoch, best_acc1 = 0, 0.0
+    start_epoch, best_acc1, pending_eval = 0, 0.0, None
     if cfg.TRAIN.AUTO_RESUME and ckpt.has_checkpoint():
-        state, start_epoch, best_acc1 = _resume(state, mesh)
+        state, start_epoch, best_acc1, pending_eval = _resume(state, mesh)
     elif cfg.MODEL.PRETRAINED and cfg.MODEL.WEIGHTS:
         # warm start from pretrained weights (≙ the reference's URL-zoo
         # `pretrained=True` path, ref: resnet.py:309-311 — here the file may
@@ -832,6 +853,41 @@ def train_model():
             )
         return best_acc1
 
+    def _finish_epoch(epoch):
+        """Validate + best-track + save for a completed epoch. Returns the
+        preempt-checkpoint path if the eval itself was preempted, else
+        None."""
+        nonlocal best_acc1
+        result = validate(val_loader, mesh, state, eval_step, epoch, logger)
+        if result is None:  # preempted mid-eval; epoch's training is done
+            return ckpt.save_preempt_checkpoint(
+                _state_tree(state), epoch + 1, best_acc1, pending_eval=epoch
+            )
+        acc1, _ = result
+        is_best = acc1 > best_acc1
+        best_acc1 = max(acc1, best_acc1)
+        ckpt.save_checkpoint(_state_tree(state), epoch, best_acc1, is_best)
+        if mesh_lib.is_primary():
+            logger.info(
+                "epoch %d done: Acc@1 %.3f (best %.3f)",
+                epoch + 1, acc1, best_acc1,
+            )
+        return None
+
+    if pending_eval is not None:
+        # the interrupted run finished training epoch `pending_eval` but
+        # was preempted before/during its eval: validate it NOW so it gets
+        # best-tracking and a real epoch checkpoint (which also supersedes
+        # the preempt checkpoint we just resumed from)
+        if mesh_lib.is_primary():
+            logger.info(
+                "running epoch %d's validation (skipped by the preemption)",
+                pending_eval + 1,
+            )
+        path = _finish_epoch(pending_eval)
+        if path is not None:  # preempted again
+            return _preempt_exit(path, pending_eval + 1)
+
     for epoch in range(start_epoch, cfg.OPTIM.MAX_EPOCH):
         state, interrupted = train_epoch(
             loader=train_loader, mesh=mesh, state=state,
@@ -849,22 +905,17 @@ def train_model():
         if watching and preempt.requested_global():
             # signaled between the last batch and validate: the epoch is
             # COMPLETE — skip the (possibly long) validation, save the
-            # finished state with cursor `epoch`, exit inside the grace
-            # window; resume continues at epoch+1
+            # finished state marked eval-pending, exit inside the grace
+            # window; the resume validates it before continuing
             path = ckpt.save_preempt_checkpoint(
-                _state_tree(state), epoch + 1, best_acc1
+                _state_tree(state), epoch + 1, best_acc1, pending_eval=epoch
             )
             return _preempt_exit(path, epoch + 1)
-        acc1, _ = validate(val_loader, mesh, state, eval_step, epoch, logger)
-        is_best = acc1 > best_acc1
-        best_acc1 = max(acc1, best_acc1)
-        ckpt.save_checkpoint(_state_tree(state), epoch, best_acc1, is_best)
-        if mesh_lib.is_primary():
-            logger.info(
-                "epoch %d done: Acc@1 %.3f (best %.3f)", epoch + 1, acc1, best_acc1
-            )
+        path = _finish_epoch(epoch)
+        if path is not None:  # eval itself was preempted (validate → None)
+            return _preempt_exit(path, epoch + 1)
         if watching and preempt.requested_global():
-            # signaled during validate/save: ckpt_ep_{epoch} is already on
+            # signaled during the save: ckpt_ep_{epoch} is already on
             # disk — nothing more to persist, just exit promptly
             return _preempt_exit(ckpt.get_checkpoint(epoch), epoch + 1)
     return best_acc1
@@ -886,7 +937,12 @@ def test_model():
         logger.info("loaded weights from %s", cfg.MODEL.WEIGHTS)
     val_loader = construct_val_loader()
     eval_step = make_eval_step(model, effective_topk())
-    top1, topk = validate(val_loader, mesh, state, eval_step, 0, logger)
+    result = validate(val_loader, mesh, state, eval_step, 0, logger)
+    if result is None:  # preempted mid-eval (TRAIN.PREEMPT_SAVE)
+        if mesh_lib.is_primary():
+            logger.warning("evaluation preempted before completion")
+        return None
+    top1, topk = result
     if mesh_lib.is_primary():
         logger.info("TEST  Acc@1 %.3f  Acc@%d %.3f", top1, effective_topk(), topk)
     return top1, topk

@@ -80,7 +80,10 @@ def has_checkpoint() -> bool:
     return bool(_scan(_NAME_PREFIX) or _scan(_PREEMPT_PREFIX))
 
 
-def _save_full(path: str, state_tree: dict, epoch_cursor: int, best_acc1: float):
+def _save_full(
+    path: str, state_tree: dict, epoch_cursor: int, best_acc1: float,
+    extra: dict | None = None,
+):
     """The one save protocol: reference-shaped payload {epoch, state,
     best_acc1} (ref: utils.py:375-380), collective orbax write (every
     process participates; array shards written by their owners)."""
@@ -88,8 +91,23 @@ def _save_full(path: str, state_tree: dict, epoch_cursor: int, best_acc1: float)
     payload = dict(state_tree)
     payload["epoch"] = np.int32(epoch_cursor)
     payload["best_acc1"] = np.float32(best_acc1)
+    if extra:
+        payload.update(extra)
     ocp.PyTreeCheckpointer().save(path, payload, force=True)
     return path
+
+
+def _prune_stale_preempts(epoch: int):
+    """Delete preempt checkpoints superseded by ``ckpt_ep_{epoch}`` —
+    full params+optimizer snapshots would otherwise accumulate across
+    preemptions. Primary process only (plain filesystem op)."""
+    if jax.process_index() != 0:
+        return
+    import shutil
+
+    for e, p in _scan(_PREEMPT_PREFIX).items():
+        if e <= epoch:
+            shutil.rmtree(p, ignore_errors=True)
 
 
 def save_checkpoint(state_tree: dict, epoch: int, best_acc1: float, is_best: bool):
@@ -98,20 +116,31 @@ def save_checkpoint(state_tree: dict, epoch: int, best_acc1: float, is_best: boo
     if is_best:
         best = {"params": state_tree["params"], "batch_stats": state_tree["batch_stats"]}
         ocp.PyTreeCheckpointer().save(get_best_checkpoint(), best, force=True)
+    _prune_stale_preempts(epoch)
     return path
 
 
-def save_preempt_checkpoint(state_tree: dict, epoch: int, best_acc1: float):
+def save_preempt_checkpoint(
+    state_tree: dict, epoch: int, best_acc1: float,
+    pending_eval: int | None = None,
+):
     """Mid-epoch checkpoint on preemption (utils/preempt.py).
 
     ``epoch`` is the epoch being interrupted; the stored cursor is
     ``epoch - 1`` so the normal resume path re-runs the interrupted epoch
-    from this (strictly newer) params/optimizer state. Same collective
-    save protocol as ``save_checkpoint`` (``_save_full``).
+    from this (strictly newer) params/optimizer state. ``pending_eval``
+    marks a COMPLETED epoch whose validation was preempted — the resume
+    path validates it and writes its real epoch checkpoint before
+    continuing. Same collective save protocol as ``save_checkpoint``.
     """
+    extra = (
+        {"pending_eval": np.int32(pending_eval)}
+        if pending_eval is not None
+        else None
+    )
     return _save_full(
         os.path.join(get_checkpoint_dir(), f"{_PREEMPT_PREFIX}{epoch:03d}"),
-        state_tree, epoch - 1, best_acc1,
+        state_tree, epoch - 1, best_acc1, extra,
     )
 
 

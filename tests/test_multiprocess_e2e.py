@@ -32,8 +32,11 @@ from distribuuuu_tpu.config import cfg
 from distribuuuu_tpu import trainer
 
 out_dir = sys.argv[1]
+arch = sys.argv[2] if len(sys.argv) > 2 else "resnet18"
+model_axis = int(sys.argv[3]) if len(sys.argv) > 3 else 1
 config.reset_cfg()
-cfg.MODEL.ARCH = "resnet18"
+cfg.MODEL.ARCH = arch
+cfg.MESH.MODEL = model_axis
 cfg.MODEL.NUM_CLASSES = 10
 cfg.MODEL.DUMMY_INPUT = True
 cfg.OPTIM.MAX_EPOCH = 1
@@ -59,8 +62,7 @@ def _free_port() -> int:
         return s.getsockname()[1]
 
 
-@pytest.mark.slow
-def test_two_process_training(tmp_path):
+def _run_two_process(tmp_path, extra_args=()):
     out_dir = str(tmp_path / "run")
     script = tmp_path / "worker.py"
     script.write_text(WORKER)
@@ -85,7 +87,7 @@ def test_two_process_training(tmp_path):
         logs.append(log)
         procs.append(
             subprocess.Popen(
-                [sys.executable, str(script), out_dir],
+                [sys.executable, str(script), out_dir, *extra_args],
                 env=env, stdout=log, stderr=subprocess.STDOUT,
                 text=True, cwd=REPO,
             )
@@ -118,3 +120,25 @@ def test_two_process_training(tmp_path):
     # one collective checkpoint, written once
     ckpt_dir = os.path.join(out_dir, "checkpoints")
     assert sorted(os.listdir(ckpt_dir)) == ["best", "ckpt_ep_000"]
+
+
+@pytest.mark.slow
+def test_two_process_training(tmp_path):
+    """DP across the process boundary (the reference's DDP topology)."""
+    _run_two_process(tmp_path)
+
+
+@pytest.mark.slow
+def test_two_process_tensor_parallel(tmp_path):
+    """DP×TP with the model axis alive across 2 processes (data=4 ×
+    model=2 over 8 global devices): TP's GSPMD collectives ride the
+    distributed backend, not just local devices."""
+    _run_two_process(tmp_path, ("resnet18", "2"))
+
+
+@pytest.mark.slow
+def test_two_process_expert_parallel(tmp_path):
+    """DP×EP: vit_tiny_moe with expert tensors sharded over a model axis
+    that spans the process boundary — the expert-partials psum is a real
+    cross-process collective."""
+    _run_two_process(tmp_path, ("vit_tiny_moe", "2"))

@@ -78,6 +78,46 @@ def _dummy_cfg(tmp_path):
 
 
 @pytest.mark.slow
+def test_eval_preemption_defers_validation_to_resume(tmp_path, monkeypatch):
+    """Preemption DURING validate: the completed epoch's trained state is
+    saved with an eval-pending marker; the resumed run validates it first
+    (so it gets best-tracking and its real checkpoint), then continues.
+    The superseded preempt checkpoint is pruned."""
+    from distribuuuu_tpu import trainer
+
+    _dummy_cfg(tmp_path)
+    cfg.OPTIM.MAX_EPOCH = 2
+
+    real_validate = trainer.validate
+    calls = {"n": 0}
+
+    def fake_validate(*a, **k):
+        calls["n"] += 1
+        if calls["n"] == 1:
+            return None  # what validate() returns when preempted mid-eval
+        return real_validate(*a, **k)
+
+    monkeypatch.setattr(trainer, "validate", fake_validate)
+    trainer.train_model()
+    d = ckpt.get_checkpoint_dir()
+    names = set(os.listdir(d))
+    assert "preempt_ep_001" in names and "ckpt_ep_000" not in names, names
+    restored = ckpt.load_checkpoint(ckpt.get_last_checkpoint())
+    assert int(restored["epoch"]) == 0  # epoch 0 training IS complete
+    assert int(restored["pending_eval"]) == 0
+
+    # rerun: the pending eval runs first, epoch 0 gets its real checkpoint
+    # + best tracking, training continues through epoch 1, and the stale
+    # preempt checkpoint is pruned
+    monkeypatch.setattr(trainer, "validate", real_validate)
+    best = trainer.train_model()
+    names = set(os.listdir(d))
+    assert {"ckpt_ep_000", "ckpt_ep_001"} <= names, names
+    assert "preempt_ep_001" not in names, names
+    assert np.isfinite(best) and best > 50.0
+
+
+@pytest.mark.slow
 def test_preemption_saves_and_resume_continues(tmp_path, monkeypatch):
     """End-to-end through train_model: epoch 0 completes, the flag fires
     during epoch 1 → mid-epoch save + early return; the rerun resumes

@@ -122,7 +122,12 @@ def main():
     preempt.install()  # SIGTERM → clean mid-epoch exit (utils/preempt.py)
 
     best = 0.0
-    for epoch in range(cfg.OPTIM.MAX_EPOCH):
+    start_epoch = 0
+    if ckpt.has_checkpoint():
+        # a previous (possibly preempted) run left state — pick it up, the
+        # same auto-resume the full trainer does
+        state, start_epoch, best, _ = trainer._resume(state, mesh)
+    for epoch in range(start_epoch, cfg.OPTIM.MAX_EPOCH):
         state, interrupted = trainer.train_epoch(
             train_loader, mesh, state, train_step, epoch, logger
         )
@@ -134,7 +139,14 @@ def main():
             )
             print(f"preempted — state saved to {path}")
             break
-        acc1, _ = trainer.validate(val_loader, mesh, state, eval_step, epoch, logger)
+        result = trainer.validate(val_loader, mesh, state, eval_step, epoch, logger)
+        if result is None:  # eval preempted: save the trained state, stop
+            path = ckpt.save_preempt_checkpoint(
+                trainer._state_tree(state), epoch + 1, best, pending_eval=epoch
+            )
+            print(f"preempted during eval — state saved to {path}")
+            break
+        acc1, _ = result
         best = max(best, acc1)
         ckpt.save_checkpoint(trainer._state_tree(state), epoch, best, acc1 >= best)
         if epoch == 0:
