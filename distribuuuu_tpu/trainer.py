@@ -41,6 +41,7 @@ from distribuuuu_tpu.parallel import (
 )
 from distribuuuu_tpu.utils import checkpoint as ckpt
 from distribuuuu_tpu.utils import preempt
+from distribuuuu_tpu.utils.jsonlog import metrics_log, setup_metrics_log
 from distribuuuu_tpu.utils.logger import get_logger, setup_logger
 from distribuuuu_tpu.utils.meters import construct_meters
 from distribuuuu_tpu.utils.metrics import accuracy, count_parameters, cross_entropy
@@ -494,6 +495,11 @@ def train_epoch(loader, mesh, state, train_step, epoch: int, logger,
                     + (cfg.OPTIM.MAX_EPOCH - epoch - 1) * num_batches,
                 )
                 logger.info("%s  LR %.5f  ETA %s", progress.display(done), lr, eta)
+                metrics_log(
+                    "train", epoch=epoch + 1, batch=done, loss=losses.avg,
+                    top1=top1.avg, topk=topk_m.avg, lr=lr,
+                    batch_time=batch_time.avg, data_time=data_time.avg,
+                )
 
     # Two preallocated (fold, batch, ...) host buffers, ping-ponged per
     # dispatch: device_put may still be reading buffer A asynchronously
@@ -604,6 +610,10 @@ def validate(loader, mesh, state, eval_step, epoch: int, logger):
     trainer.py:91-95) — totals stay on device between prints so batches
     dispatch asynchronously."""
     watch_preemption = cfg.TRAIN.PREEMPT_SAVE
+    # same collective-throttle as train_epoch: cross-host agreement only at
+    # every Nth deterministic site; free local check at world size 1
+    preempt_check_every = 1 if jax.process_count() == 1 else 8
+    checks_seen = 0
     totals = None
     pending_print = None  # previous window's (batch_idx, totals) — async copy
     num_batches = len(loader)
@@ -616,10 +626,16 @@ def validate(loader, mesh, state, eval_step, epoch: int, logger):
             if totals is None
             else jax.tree.map(jnp.add, totals, m)
         )
-        if (
+        at_check_site = (
             watch_preemption
             and (it + 1) % cfg.TEST.PRINT_FREQ == 0
             and it + 1 < num_batches
+        )
+        if at_check_site:
+            checks_seen += 1
+        if (
+            at_check_site
+            and checks_seen % preempt_check_every == 0
             and preempt.requested_global()
         ):
             # deterministic check sites (same batch indices on every
@@ -661,6 +677,10 @@ def validate(loader, mesh, state, eval_step, epoch: int, logger):
         logger.info(
             "Eval[%d]  Loss %.4f  Acc@1 %.3f  Acc@%d %.3f  (%d samples)",
             epoch + 1, loss, top1, effective_topk(), topk, int(n),
+        )
+        metrics_log(
+            "eval", epoch=epoch + 1, loss=loss, top1=top1, topk=topk,
+            samples=int(n),
         )
     return top1, topk
 
@@ -714,7 +734,9 @@ def _with_restored_weights(state: TrainState, path: str, model) -> TrainState:
     )
 
 
-def _resume(state: TrainState, mesh) -> tuple[TrainState, int, float]:
+def _resume(
+    state: TrainState, mesh
+) -> tuple[TrainState, int, float, int | None]:
     """Auto-resume from the last epoch checkpoint (ref: trainer.py:143-149)."""
     logger = get_logger()
     path = ckpt.get_last_checkpoint()
@@ -755,6 +777,7 @@ def train_model():
     check_trainer_mesh()
     setup_env()
     logger = setup_logger()
+    setup_metrics_log(cfg.OUT_DIR, primary=mesh_lib.is_primary())
     mesh = mesh_lib.mesh_from_cfg(cfg)
     key = setup_seed()
 
@@ -872,6 +895,9 @@ def train_model():
                 "epoch %d done: Acc@1 %.3f (best %.3f)",
                 epoch + 1, acc1, best_acc1,
             )
+            metrics_log(
+                "epoch", epoch=epoch + 1, acc1=acc1, best_acc1=best_acc1
+            )
         return None
 
     if pending_eval is not None:
@@ -887,6 +913,11 @@ def train_model():
         path = _finish_epoch(pending_eval)
         if path is not None:  # preempted again
             return _preempt_exit(path, pending_eval + 1)
+        # the eval-preempt checkpoint (named pending_eval+1, holding this
+        # epoch's end state) is now fully superseded by ckpt_ep_{pending};
+        # without this prune it would outrank the real checkpoints on
+        # every restart and the run could never cleanly terminate
+        ckpt.prune_preempts(pending_eval + 1)
 
     for epoch in range(start_epoch, cfg.OPTIM.MAX_EPOCH):
         state, interrupted = train_epoch(

@@ -97,16 +97,17 @@ def _save_full(
     return path
 
 
-def _prune_stale_preempts(epoch: int):
-    """Delete preempt checkpoints superseded by ``ckpt_ep_{epoch}`` —
-    full params+optimizer snapshots would otherwise accumulate across
-    preemptions. Primary process only (plain filesystem op)."""
+def prune_preempts(upto: int):
+    """Delete preempt checkpoints with number ≤ ``upto`` — full
+    params+optimizer snapshots would otherwise accumulate across
+    preemptions (and a stale one would outrank the real checkpoints on
+    every restart). Primary process only (plain filesystem op)."""
     if jax.process_index() != 0:
         return
     import shutil
 
     for e, p in _scan(_PREEMPT_PREFIX).items():
-        if e <= epoch:
+        if e <= upto:
             shutil.rmtree(p, ignore_errors=True)
 
 
@@ -116,7 +117,7 @@ def save_checkpoint(state_tree: dict, epoch: int, best_acc1: float, is_best: boo
     if is_best:
         best = {"params": state_tree["params"], "batch_stats": state_tree["batch_stats"]}
         ocp.PyTreeCheckpointer().save(get_best_checkpoint(), best, force=True)
-    _prune_stale_preempts(epoch)
+    prune_preempts(epoch)
     return path
 
 

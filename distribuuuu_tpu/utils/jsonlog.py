@@ -1,0 +1,49 @@
+"""Structured metrics sink: one JSON object per line in
+``{OUT_DIR}/metrics.jsonl``.
+
+The reference's observability is text logs only (loguru file + stderr,
+ref: /root/reference/distribuuuu/utils.py:71-82; SURVEY.md §5.5). This adds
+the machine-readable channel: every train print-window, eval summary, and
+epoch boundary lands as a JSON record — plot, diff, or regression-track a
+run with ``jq``/pandas, no tensorboard dependency.
+
+Module-level singleton like ``utils/logger.py`` (``setup`` in
+``train_model``, then ``log()`` from anywhere; a no-op until set up and on
+non-primary processes), so call sites need no signature changes.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import time
+
+_sink = {"f": None}
+
+
+def setup_metrics_log(out_dir: str, primary: bool = True) -> None:
+    """Open (append) the sink on the primary process; close any previous."""
+    close_metrics_log()
+    if not primary:
+        return
+    os.makedirs(out_dir, exist_ok=True)
+    _sink["f"] = open(
+        os.path.join(out_dir, "metrics.jsonl"), "a", buffering=1
+    )
+
+
+def metrics_log(kind: str, **fields) -> None:
+    """Append one record: {"t": unix_time, "kind": kind, **fields}.
+    No-op when the sink is not set up (non-primary, tests, library use)."""
+    f = _sink["f"]
+    if f is None:
+        return
+    rec = {"t": round(time.time(), 3), "kind": kind}
+    rec.update(fields)
+    f.write(json.dumps(rec) + "\n")
+
+
+def close_metrics_log() -> None:
+    if _sink["f"] is not None:
+        _sink["f"].close()
+        _sink["f"] = None

@@ -118,6 +118,40 @@ def test_eval_preemption_defers_validation_to_resume(tmp_path, monkeypatch):
 
 
 @pytest.mark.slow
+def test_final_epoch_eval_preempt_terminates_cleanly(tmp_path, monkeypatch):
+    """Eval-preempt on the LAST epoch: the resume validates it, writes its
+    real checkpoint, and prunes the preempt checkpoint — a further restart
+    must terminate immediately instead of re-validating forever."""
+    from distribuuuu_tpu import trainer
+
+    _dummy_cfg(tmp_path)
+    cfg.OPTIM.MAX_EPOCH = 1  # epoch 0 is the final epoch
+
+    real_validate = trainer.validate
+    monkeypatch.setattr(trainer, "validate", lambda *a, **k: None)
+    trainer.train_model()  # eval of epoch 0 "preempted"
+    d = ckpt.get_checkpoint_dir()
+    assert "preempt_ep_001" in os.listdir(d)
+
+    monkeypatch.setattr(trainer, "validate", real_validate)
+    trainer.train_model()  # resume: pending eval runs, real ckpt written
+    names = set(os.listdir(d))
+    assert "ckpt_ep_000" in names, names
+    assert "preempt_ep_001" not in names, names  # pruned — nothing stale
+
+    # third run: resumes from ckpt_ep_000, loop range empty, returns fast
+    calls = {"n": 0}
+
+    def counting_validate(*a, **k):
+        calls["n"] += 1
+        return real_validate(*a, **k)
+
+    monkeypatch.setattr(trainer, "validate", counting_validate)
+    trainer.train_model()
+    assert calls["n"] == 0  # no re-validation churn on restart
+
+
+@pytest.mark.slow
 def test_preemption_saves_and_resume_continues(tmp_path, monkeypatch):
     """End-to-end through train_model: epoch 0 completes, the flag fires
     during epoch 1 → mid-epoch save + early return; the rerun resumes

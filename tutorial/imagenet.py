@@ -126,7 +126,21 @@ def main():
     if ckpt.has_checkpoint():
         # a previous (possibly preempted) run left state — pick it up, the
         # same auto-resume the full trainer does
-        state, start_epoch, best, _ = trainer._resume(state, mesh)
+        state, start_epoch, best, pending = trainer._resume(state, mesh)
+        if pending is not None:
+            # that run finished training epoch `pending` but its eval was
+            # preempted: validate it now so it gets best-tracking and its
+            # real checkpoint (which supersedes the preempt checkpoint)
+            result = trainer.validate(
+                val_loader, mesh, state, eval_step, pending, logger
+            )
+            if result is not None:
+                acc1, _ = result
+                best = max(best, acc1)
+                ckpt.save_checkpoint(
+                    trainer._state_tree(state), pending, best, acc1 >= best
+                )
+                ckpt.prune_preempts(pending + 1)
     for epoch in range(start_epoch, cfg.OPTIM.MAX_EPOCH):
         state, interrupted = trainer.train_epoch(
             train_loader, mesh, state, train_step, epoch, logger
