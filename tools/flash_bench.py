@@ -1,0 +1,141 @@
+"""Reproduce the flash-attention performance claims (PERF.md r2 section).
+
+Benchmarks the three long-sequence attention paths at a chosen shape —
+the hand-tiled Pallas flash kernel (ops/flash_attention.py), the lax.scan
+blockwise path (ops/ring_attention.blockwise_attention), and dense XLA —
+forward and forward+backward, with the dispatch-amortized methodology this
+environment requires (N applications folded inside ONE jit via lax.scan
+with output feedback; per-call timing on a tunneled transport measures the
+~5-10 ms dispatch floor, not the kernel).
+
+Usage (defaults are the canonical ViT-Ti/1024px shape [4, 3, 4096, 64]):
+
+    python tools/flash_bench.py [--batch 4] [--heads 3] [--seq 4096]
+        [--dim 64] [--iters 10] [--skip-dense]
+
+Reference numbers (v5e, bf16, 2026-07, this script): fwd flash 6.96 ms /
+scan 7.99 / dense 8.11; fwd+bwd flash 7.89 / scan 9.67 / dense 14.69 —
+flash 1.15× scan fwd, **1.23× fwd+bwd**, 1.9× dense fwd+bwd. NOTES:
+(1) absolute ms on the tunneled transport vary with load by up to ~2×
+between sessions, and the fwd ratio varies with it (1.15-1.54× observed);
+the fwd+bwd ratio is the steadier claim. (2) the fwd+bwd feedback MUST
+depend on all three grads — feeding back only dq lets XLA dead-code-
+eliminate the dK/dV backward (a separable pallas_call on the flash path)
+and inflates the flash ratio. (3) --iters ≥ 20: shorter windows
+under-amortize the dispatch floor.
+"""
+
+from __future__ import annotations
+
+import argparse
+import time
+
+import _path  # noqa: F401  (repo root onto sys.path)
+import numpy as np
+
+
+def bench_folded(fn, q, k, v, iters: int) -> float:
+    """Best-of-3 windows of ``iters`` applications inside one jit."""
+    import jax
+    import jax.numpy as jnp
+
+    @jax.jit
+    def run(q, k, v):
+        def body(c, _):
+            o = fn(c, k, v)
+            return o.astype(c.dtype), ()  # feedback defeats DCE
+
+        out, _ = jax.lax.scan(body, q, None, length=iters)
+        return out
+
+    o = run(q, k, v)
+    float(jnp.sum(o.astype(jnp.float32)))  # tunnel-safe fence
+    best = float("inf")
+    for _ in range(3):
+        t0 = time.perf_counter()
+        o = run(q, k, v)
+        float(jnp.sum(o.astype(jnp.float32)))
+        best = min(best, (time.perf_counter() - t0) / iters)
+    return best
+
+
+def bench_grad_folded(fn, q, k, v, iters: int) -> float:
+    import jax
+    import jax.numpy as jnp
+
+    grad = jax.grad(
+        lambda q, k, v: jnp.sum(fn(q, k, v).astype(jnp.float32)),
+        argnums=(0, 1, 2),
+    )
+
+    @jax.jit
+    def run(q, k, v):
+        def body(c, _):
+            dq, dk, dv = grad(c, k, v)
+            # feedback must depend on ALL grads or XLA dead-code-eliminates
+            # the dK/dV backward (a separable pallas_call on the flash path)
+            return (dq + dk + dv).astype(c.dtype), ()
+
+        out, _ = jax.lax.scan(body, q, None, length=iters)
+        return out
+
+    o = run(q, k, v)
+    float(jnp.sum(o.astype(jnp.float32)))
+    best = float("inf")
+    for _ in range(3):
+        t0 = time.perf_counter()
+        o = run(q, k, v)
+        float(jnp.sum(o.astype(jnp.float32)))
+        best = min(best, (time.perf_counter() - t0) / iters)
+    return best
+
+
+def main():
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--batch", type=int, default=4)
+    ap.add_argument("--heads", type=int, default=3)
+    ap.add_argument("--seq", type=int, default=4096)
+    ap.add_argument("--dim", type=int, default=64)
+    ap.add_argument("--iters", type=int, default=20)
+    ap.add_argument("--skip-dense", action="store_true",
+                    help="skip the O(L²)-memory dense baseline")
+    args = ap.parse_args()
+
+    import jax
+    import jax.numpy as jnp
+
+    from distribuuuu_tpu.ops import flash_attention as fa
+    from distribuuuu_tpu.ops import ring_attention as ra
+
+    B, H, L, D = args.batch, args.heads, args.seq, args.dim
+    print(f"backend={jax.default_backend()} "
+          f"device={jax.devices()[0].device_kind} shape=[{B},{H},{L},{D}]")
+    rng = np.random.default_rng(0)
+    q, k, v = (
+        jnp.asarray(rng.standard_normal((B, H, L, D)), jnp.bfloat16)
+        for _ in range(3)
+    )
+    flops = 2 * 2 * B * H * L * L * D
+
+    paths = {
+        "flash": lambda q, k, v: fa.flash_attention(q, k, v),
+        "scan": lambda q, k, v: ra.blockwise_attention(q, k, v),
+    }
+    if not args.skip_dense:
+        paths["dense"] = lambda q, k, v: ra.reference_attention(q, k, v)
+
+    fwd, bwd = {}, {}
+    for name, fn in paths.items():
+        fwd[name] = bench_folded(fn, q, k, v, args.iters)
+        print(f"fwd     {name:5s}: {fwd[name] * 1e3:7.3f} ms "
+              f"({flops / fwd[name] / 1e12:5.1f} TFLOP/s)")
+    for name, fn in paths.items():
+        bwd[name] = bench_grad_folded(fn, q, k, v, args.iters)
+        print(f"fwd+bwd {name:5s}: {bwd[name] * 1e3:7.3f} ms")
+    if "flash" in fwd and "scan" in fwd:
+        print(f"flash vs scan: fwd {fwd['scan'] / fwd['flash']:.2f}x, "
+              f"fwd+bwd {bwd['scan'] / bwd['flash']:.2f}x")
+
+
+if __name__ == "__main__":
+    main()
