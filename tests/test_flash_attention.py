@@ -6,8 +6,9 @@ kernels (dq, dk/dv), including the padded (L not a block multiple) case
 whose masked rows/keys are the easy thing to get wrong.
 
 The performance claim (≥1.2× over the lax.scan blockwise path at
-[4, 3, 4096, 64] on a v5e — measured 1.5× fwd / 1.3× fwd+bwd, PERF.md) is
-hardware-gated and not asserted here.
+[4, 3, 4096, 64] on a v5e — measured 1.23× fwd+bwd with the DCE-safe
+harness, tools/flash_bench.py / PERF.md) is hardware-gated and not
+asserted here.
 """
 
 import numpy as np
