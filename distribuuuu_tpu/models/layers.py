@@ -270,8 +270,10 @@ class _BNCore(nn.Module):
             xg = xf.reshape((g, gs) + x.shape[1:])
             axes = tuple(range(1, xg.ndim - 1))
             gmean = xg.mean(axes)  # (g, C)
-            gvar = jnp.square(xg).mean(axes) - jnp.square(gmean)  # biased
             bshape = (g,) + (1,) * (xg.ndim - 2) + (feat,)
+            # centered (two-pass) variance, matching torch: E[x²]−E[x]²
+            # cancels catastrophically when |mean| ≫ spread
+            gvar = jnp.square(xg - gmean.reshape(bshape)).mean(axes)  # biased
             inv = jax.lax.rsqrt(gvar + self.epsilon).reshape(bshape) * scale
             y = ((xg - gmean.reshape(bshape)) * inv + bias).reshape(x.shape)
             count = gs * spatial
@@ -282,7 +284,7 @@ class _BNCore(nn.Module):
         else:
             axes = tuple(range(x.ndim - 1))
             mean = xf.mean(axes)
-            var = jnp.square(xf).mean(axes) - jnp.square(mean)
+            var = jnp.square(xf - mean).mean(axes)
             inv = jax.lax.rsqrt(var + self.epsilon) * scale
             y = (xf - mean) * inv + bias
             count = n * spatial

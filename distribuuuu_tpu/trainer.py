@@ -769,22 +769,16 @@ def _resume(
     )
 
 
-def train_model():
-    """End-to-end training (ref: trainer.py:106-173)."""
-    mesh_lib.apply_backend_flags(cfg.DEVICE.DETERMINISTIC or cfg.CUDNN.DETERMINISTIC)
-    mesh_lib.apply_platform(cfg.DEVICE.PLATFORM)
-    mesh_lib.setup_distributed()
-    check_trainer_mesh()
-    setup_env()
-    logger = setup_logger()
-    setup_metrics_log(cfg.OUT_DIR, primary=mesh_lib.is_primary())
-    mesh = mesh_lib.mesh_from_cfg(cfg)
-    key = setup_seed()
-
+def check_batch_geometry(mesh):
+    """Validate every batch-divisibility constraint before the expensive
+    state init/compile, in the user's config units: grad-accum split, data
+    axis sharding, GPipe microbatching (TRAIN **and** the padded eval
+    batch — the val loader pads each batch to the full TEST.BATCH_SIZE, so
+    an indivisible eval batch would otherwise train a whole epoch and then
+    crash inside validate(), ADVICE r2), and ghost BN grouping."""
     accum = max(1, cfg.TRAIN.GRAD_ACCUM_STEPS)
     per_host_batch = cfg.TRAIN.BATCH_SIZE * jax.local_device_count()
     if per_host_batch % accum:
-        # fail before the expensive state init/compile, in the user's units
         raise ValueError(
             f"TRAIN.BATCH_SIZE={cfg.TRAIN.BATCH_SIZE} × "
             f"{jax.local_device_count()} local chips = {per_host_batch} "
@@ -810,15 +804,45 @@ def train_model():
                 f"{pipe_mb} GPipe microbatches (MESH.MICROBATCH, 0 → "
                 "2×PIPE); adjust TRAIN.BATCH_SIZE or MESH.MICROBATCH"
             )
+        eval_global = (
+            cfg.TEST.BATCH_SIZE * jax.local_device_count()
+            * jax.process_count()
+        )
+        eval_per_shard = eval_global // data_size
+        # mirrors PipelinedViT's guard: below pipe_mb it falls back to the
+        # math-identical sequential stage path, no error
+        if eval_per_shard >= pipe_mb and eval_per_shard % pipe_mb:
+            raise ValueError(
+                f"per-data-shard eval batch {eval_per_shard} "
+                f"(TEST.BATCH_SIZE={cfg.TEST.BATCH_SIZE}) not divisible by "
+                f"the {pipe_mb} GPipe microbatches; adjust TEST.BATCH_SIZE "
+                "or MESH.MICROBATCH"
+            )
     bn_g = 0 if cfg.MODEL.ARCH.startswith("vit") else bn_group_from_cfg()
     if bn_g > 0 and global_micro > bn_g and global_micro % bn_g:
-        # fail before the expensive init/compile — _BNCore would raise the
-        # same condition at first train-step trace
+        # _BNCore would raise the same condition at first train-step trace
         raise ValueError(
             f"ghost BN group {bn_g} (MODEL.BN_GROUP, 0 → TRAIN.BATCH_SIZE) "
             f"does not divide the per-step forward batch {global_micro}; "
             "adjust MODEL.BN_GROUP / TRAIN.BATCH_SIZE / GRAD_ACCUM_STEPS"
         )
+    return global_micro
+
+
+def train_model():
+    """End-to-end training (ref: trainer.py:106-173)."""
+    mesh_lib.apply_backend_flags(cfg.DEVICE.DETERMINISTIC or cfg.CUDNN.DETERMINISTIC)
+    mesh_lib.apply_platform(cfg.DEVICE.PLATFORM)
+    mesh_lib.setup_distributed()
+    check_trainer_mesh()
+    setup_env()
+    logger = setup_logger()
+    setup_metrics_log(cfg.OUT_DIR, primary=mesh_lib.is_primary())
+    mesh = mesh_lib.mesh_from_cfg(cfg)
+    key = setup_seed()
+
+    accum = max(1, cfg.TRAIN.GRAD_ACCUM_STEPS)
+    check_batch_geometry(mesh)
 
     model = build_model_from_cfg()
     state = create_train_state(model, key, mesh, cfg.TRAIN.IM_SIZE)
@@ -960,6 +984,7 @@ def test_model():
     check_trainer_mesh()
     logger = setup_logger()
     mesh = mesh_lib.mesh_from_cfg(cfg)
+    check_batch_geometry(mesh)  # eval GPipe divisibility, before the compile
     model = build_model_from_cfg()
     key = jax.random.key(cfg.RNG_SEED or 0)
     state = create_train_state(model, key, mesh, cfg.TRAIN.IM_SIZE)

@@ -1,0 +1,72 @@
+"""Startup batch-geometry validation (trainer.check_batch_geometry).
+
+These constraints must fail BEFORE the expensive state init/compile — and
+before any training happens. The eval-batch GPipe check exists because the
+val loader pads every batch to the full TEST.BATCH_SIZE: an indivisible
+eval batch used to train a whole epoch and then crash inside validate()
+before that epoch's checkpoint was written (ADVICE r2, trainer.py).
+No compiles happen here, so the file is fast-tier.
+"""
+
+import pytest
+
+from distribuuuu_tpu import trainer
+from distribuuuu_tpu.config import cfg
+from distribuuuu_tpu.parallel import mesh as mesh_lib
+
+
+def _vit_pipe_cfg(train_bs=8, test_bs=8, microbatch=4):
+    cfg.MODEL.ARCH = "vit_tiny"
+    cfg.TRAIN.BATCH_SIZE = train_bs  # per chip; ×8 local devices
+    cfg.TEST.BATCH_SIZE = test_bs
+    cfg.MESH.PIPE = 4
+    cfg.MESH.DATA = -1  # → 2 on the 8-device mesh
+    cfg.MESH.MICROBATCH = microbatch
+    return mesh_lib.mesh_from_cfg(cfg)
+
+
+def test_valid_pipe_geometry_passes():
+    mesh = _vit_pipe_cfg()
+    # per-shard train batch = 8*8/2 = 32, divisible by 4 microbatches
+    assert trainer.check_batch_geometry(mesh) == 64
+
+
+def test_train_batch_indivisible_by_microbatches_raises():
+    mesh = _vit_pipe_cfg(train_bs=3, microbatch=8)  # per shard 12 % 8
+    with pytest.raises(ValueError, match="GPipe microbatches"):
+        trainer.check_batch_geometry(mesh)
+
+
+def test_eval_batch_indivisible_by_microbatches_raises():
+    # train side fine (32 % 4 == 0); eval per shard = 25*8/2 = 100 % 8 != 0
+    mesh = _vit_pipe_cfg(train_bs=8, test_bs=25, microbatch=4)
+    cfg.MESH.MICROBATCH = 8
+    with pytest.raises(ValueError, match="eval batch"):
+        trainer.check_batch_geometry(mesh)
+
+
+def test_small_eval_batch_falls_back_no_error():
+    """Below one microbatch-set per shard PipelinedViT runs its sequential
+    fallback — startup must not reject it."""
+    mesh = _vit_pipe_cfg(train_bs=8, test_bs=1, microbatch=4)
+    cfg.MESH.MICROBATCH = 16  # eval per shard 4 < 16 → fallback, OK
+    cfg.TRAIN.BATCH_SIZE = 16  # per shard 64 % 16 == 0
+    trainer.check_batch_geometry(mesh)
+
+
+def test_grad_accum_indivisible_raises():
+    cfg.MODEL.ARCH = "resnet18"
+    cfg.TRAIN.BATCH_SIZE = 3  # 24 per host
+    cfg.TRAIN.GRAD_ACCUM_STEPS = 5
+    mesh = mesh_lib.mesh_from_cfg(cfg)
+    with pytest.raises(ValueError, match="GRAD_ACCUM_STEPS"):
+        trainer.check_batch_geometry(mesh)
+
+
+def test_ghost_bn_indivisible_raises():
+    cfg.MODEL.ARCH = "resnet18"
+    cfg.TRAIN.BATCH_SIZE = 8  # global 64
+    cfg.MODEL.BN_GROUP = 48  # 64 > 48, 64 % 48 != 0
+    mesh = mesh_lib.mesh_from_cfg(cfg)
+    with pytest.raises(ValueError, match="ghost BN group"):
+        trainer.check_batch_geometry(mesh)

@@ -80,6 +80,37 @@ def test_global_bn_matches_torch_full_batch():
     np.testing.assert_allclose(leaves[1], tb.running_var.numpy(), rtol=1e-5)
 
 
+def test_bn_large_mean_numerics_match_torch():
+    """Large mean relative to spread: the E[x²]−E[x]² formulation cancels
+    catastrophically in fp32 (var ~1e-4 under mean ~1e3 drowns in the
+    ~0.1 absolute rounding of the 1e6-scale squares); the centered
+    two-pass variance matches torch's centered computation (ADVICE r2)."""
+    torch = pytest.importorskip("torch")
+    rng = np.random.default_rng(7)
+    x = (1e3 + 1e-2 * rng.standard_normal((32, 2, 2, 4))).astype(np.float32)
+    tb = torch.nn.BatchNorm2d(4, eps=1e-5, momentum=0.1)
+    tb.train()
+    with torch.no_grad():
+        yt = tb(torch.from_numpy(x.transpose(0, 3, 1, 2))).numpy()
+    # global (SyncBN) path: the running-var estimate is the direct probe
+    # of the variance formulation (cancellation gives ≤0 or garbage); the
+    # normalized output tolerates fp32 mean-accumulation rounding, which
+    # differs between jnp and torch at this scale
+    y, stats = _bn_apply(0, jnp.asarray(x))
+    np.testing.assert_allclose(
+        y, yt.transpose(0, 2, 3, 1), atol=0.1
+    )
+    np.testing.assert_allclose(
+        jax.tree.leaves(stats)[1], tb.running_var.numpy(), rtol=0.02
+    )
+    # ghost path: each group must still normalize to ~N(0,1) — the
+    # cancelling formulation gives a negative variance here (⇒ NaN)
+    yg, _ = _bn_apply(16, jnp.asarray(x))
+    assert np.isfinite(yg).all()
+    assert abs(float(yg.mean())) < 1e-2
+    assert abs(float(yg.std()) - 1.0) < 0.1
+
+
 def test_group_stats_differ_from_global_on_sharded_batch():
     """On a batch whose shards have different distributions, ghost and
     global BN produce measurably different outputs — the regime matters."""
