@@ -213,6 +213,15 @@ _C.MODEL.MOE.EVERY = 2
 # λ for the switch-transformer load-balancing aux loss added to the task
 # loss (0 disables; without it top-k routing collapses onto few experts).
 _C.MODEL.MOE.AUX_WEIGHT = 0.01
+# Execution strategy: "partial" = local experts on all tokens + one psum
+# (exact, O(E/n) compute/token — right for small E); "dispatch" =
+# switch-style all_to_all routing at fixed capacity (O(top_k)
+# compute/token — the scalable path for large E; over-capacity
+# assignments drop, logged as the ``moe_dropped`` train metric).
+_C.MODEL.MOE.IMPL = "partial"
+# Dispatch capacity: each expert takes ceil(T_shard·top_k/E × this) slots
+# per source rank. Raise toward E/top_k for exactness, lower for speed.
+_C.MODEL.MOE.CAPACITY_FACTOR = 2.0
 
 # ------------------------------- training ----------------------------------
 _C.TRAIN = CfgNode()

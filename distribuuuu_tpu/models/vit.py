@@ -55,6 +55,15 @@ class MoeMlp(nn.Module):
     The switch-transformer load-balancing aux (arXiv:2101.03961) is sown
     into the ``intermediates`` collection under ``moe_aux``; the trainer
     adds ``MODEL.MOE.AUX_WEIGHT ×`` its mean to the task loss.
+
+    ``impl`` selects the execution strategy (config ``MODEL.MOE.IMPL``):
+    ``"partial"`` — every rank runs its local experts on all tokens, one
+    psum; exact, O(E/n) compute per token — right for small E.
+    ``"dispatch"`` — switch-style all_to_all routing at a fixed capacity
+    (``MODEL.MOE.CAPACITY_FACTOR``); compute O(top_k) per token — the
+    scalable-EP path for large E. Its dropped-assignment fraction is sown
+    into the ``moe_stats`` collection (surfaced as the trainer's
+    ``moe_dropped`` metric).
     """
 
     dim: int
@@ -63,6 +72,8 @@ class MoeMlp(nn.Module):
     top_k: int
     dtype: Any
     mesh: Any = None
+    impl: str = "partial"
+    capacity_factor: float = 2.0
 
     @nn.compact
     def __call__(self, x, train: bool = False):
@@ -107,14 +118,31 @@ class MoeMlp(nn.Module):
         )
         # the dense reference path also covers batches that cannot shard
         # over data (the tiny init-time dummy) — identical math either way
+        if self.impl not in ("partial", "dispatch"):
+            raise ValueError(
+                f"MODEL.MOE.IMPL must be 'partial' or 'dispatch', "
+                f"got {self.impl!r}"
+            )
         if (
             self.mesh is not None
             and self.mesh.shape.get(MODEL_AXIS, 1) > 1
             and B % data_size == 0
         ):
-            out = moe_ops.moe_ffn_partial_batched(
-                params, x, mesh=self.mesh, axis=MODEL_AXIS, top_k=self.top_k
-            )
+            if self.impl == "dispatch":
+                out, dropped = moe_ops.moe_ffn_dispatch_batched(
+                    params, x, mesh=self.mesh, axis=MODEL_AXIS,
+                    top_k=self.top_k,
+                    capacity_factor=self.capacity_factor,
+                )
+                self.sow(
+                    "moe_stats", "dropped", dropped,
+                    reduce_fn=lambda a, b: a + b, init_fn=lambda: 0.0,
+                )
+            else:
+                out = moe_ops.moe_ffn_partial_batched(
+                    params, x, mesh=self.mesh, axis=MODEL_AXIS,
+                    top_k=self.top_k,
+                )
         else:
             out = moe_ops.moe_ffn_reference(
                 params, x.reshape(B * S, d), top_k=self.top_k
@@ -224,6 +252,8 @@ class Block(nn.Module):
     mesh: Any
     moe_experts: int = 0  # >0: MoE FFN instead of the dense Mlp
     moe_top_k: int = 2
+    moe_impl: str = "partial"
+    moe_capacity_factor: float = 2.0
 
     @nn.compact
     def __call__(self, x, train: bool = False):
@@ -237,6 +267,8 @@ class Block(nn.Module):
             ffn = MoeMlp(
                 self.dim, int(self.dim * self.mlp_ratio), self.moe_experts,
                 self.moe_top_k, self.dtype, self.mesh,
+                impl=self.moe_impl,
+                capacity_factor=self.moe_capacity_factor,
             )
         else:
             ffn = Mlp(
@@ -300,6 +332,8 @@ class ViT(_ViTCommon):
     moe_experts: int = 0  # >0: MoE FFN in every ``moe_every``-th block
     moe_top_k: int = 2
     moe_every: int = 2
+    moe_impl: str = "partial"
+    moe_capacity_factor: float = 2.0
 
     @nn.compact
     def __call__(self, x, train: bool = False):
@@ -316,6 +350,8 @@ class ViT(_ViTCommon):
                 self.dim, self.num_heads, self.mlp_ratio, self.dropout,
                 self.dtype, self.attn_impl, self.mesh,
                 moe_experts=moe, moe_top_k=self.moe_top_k,
+                moe_impl=self.moe_impl,
+                moe_capacity_factor=self.moe_capacity_factor,
             )(x, train=train)
         return self._head(x)
 
@@ -331,13 +367,14 @@ class ViTStage(nn.Module):
     dropout: float
     dtype: Any
     blocks_per_stage: int
+    attn_impl: str = "xla"
 
     @nn.compact
     def __call__(self, x, train: bool = False):
         for _ in range(self.blocks_per_stage):
             x = Block(
                 self.dim, self.num_heads, self.mlp_ratio, self.dropout,
-                self.dtype, "xla", None,
+                self.dtype, self.attn_impl, None,
             )(x, train=train)
         return x
 
@@ -382,18 +419,24 @@ class PipelinedViT(_ViTCommon):
                 "dropout inside pipeline stages is not supported (stage "
                 "apply runs under shard_map without an rng); set dropout=0"
             )
-        if self.attn_impl not in ("auto", "xla"):
-            # ("auto" is accepted and resolves to dense XLA here: stage
-            # apply runs under shard_map, where neither the flash kernel
-            # nor sequence-sharded attention composes with the pipe axis)
+        if self.attn_impl in ("ring", "ulysses"):
+            # sequence-SHARDED attention is genuinely incompatible: its
+            # collectives run over the ``seq`` axis, which a pipe mesh
+            # does not populate (PP shards depth, SP shards tokens — pick
+            # one per dimension). Per-device kernels compose fine: flash
+            # is an opaque pallas_call / blockwise a lax.scan, both legal
+            # inside the pipeline's shard_map (VERDICT r2 #7 probe —
+            # tests/test_pp_ep_trainer.py::test_pipe_with_flash_attention).
             raise ValueError(
-                "PipelinedViT uses dense XLA attention inside stages; "
-                "flash/sequence-sharded attention does not compose with "
-                f"the pipe axis (got attn_impl={self.attn_impl!r})"
+                "sequence-sharded attention (ring/ulysses) does not "
+                "compose with the pipe axis; use MESH.SEQ without PIPE, "
+                f"or attn_impl in ('xla', 'flash', 'blockwise') "
+                f"(got {self.attn_impl!r})"
             )
         return ViTStage(
             self.dim, self.num_heads, self.mlp_ratio, 0.0, self.dtype,
             self.depth // self.pipe_stages,
+            attn_impl=self.attn_impl,
         )
 
     @nn.compact
@@ -553,6 +596,8 @@ def _vit(num_classes, kw, **defaults):
         kw.pop("moe_experts", None)
         kw.pop("moe_top_k", None)
         kw.pop("moe_every", None)
+        kw.pop("moe_impl", None)
+        kw.pop("moe_capacity_factor", None)
         return PipelinedViT(num_classes=num_classes, pipe_stages=pipe, **kw)
     kw.pop("pipe_microbatches", None)
     return ViT(num_classes=num_classes, **kw)

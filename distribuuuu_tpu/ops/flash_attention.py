@@ -50,6 +50,14 @@ def _round_up(x: int, m: int) -> int:
     return (x + m - 1) // m * m
 
 
+def _k_loop(n: int, body, carry):
+    # NOTE (r3): statically unrolling this loop (Python for over range(n))
+    # was tried and REVERTED — Mosaic keeps every unrolled iteration's
+    # [blk_q, blk_k] fp32 logits tile live simultaneously, blowing the
+    # 16 MiB VMEM stack at the tuned 1024² blocks (measured: 16.14M).
+    return jax.lax.fori_loop(0, n, body, carry)
+
+
 def _resolve_blocks(L: int, blk_q: int, blk_k: int):
     """Pad the sequence to the 128-lane boundary and snap each requested
     block size down to the largest 128-multiple divisor of the padded
@@ -111,7 +119,7 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, scale, length, blk_k):
     m0 = jnp.full((blk_q, 1), _NEG_BIG, jnp.float32)
     l0 = jnp.zeros((blk_q, 1), jnp.float32)
     a0 = jnp.zeros((blk_q, d), jnp.float32)
-    m, l, acc = jax.lax.fori_loop(0, nk, body, (m0, l0, a0))
+    m, l, acc = _k_loop(nk, body, (m0, l0, a0))
     l_safe = jnp.maximum(l, 1e-30)
     o_ref[0] = (acc / l_safe).astype(o_ref.dtype)
     lse_ref[0] = m + jnp.log(l_safe)  # [blk_q, 1]
@@ -157,7 +165,7 @@ def _dq_kernel(
             ds.astype(kb.dtype), kb, preferred_element_type=jnp.float32
         )
 
-    dq = jax.lax.fori_loop(0, nk, body, jnp.zeros((blk_q, d), jnp.float32))
+    dq = _k_loop(nk, body, jnp.zeros((blk_q, d), jnp.float32))
     dq_ref[0] = dq.astype(dq_ref.dtype)
 
 
@@ -208,7 +216,7 @@ def _dkdv_kernel(
         return dk, dv
 
     z = jnp.zeros((blk_k, d), jnp.float32)
-    dk, dv = jax.lax.fori_loop(0, nq, body, (z, z))
+    dk, dv = _k_loop(nq, body, (z, z))
     dk_ref[0] = dk.astype(dk_ref.dtype)
     dv_ref[0] = dv.astype(dv_ref.dtype)
 

@@ -157,9 +157,9 @@ def _rank_partials(params, tokens, axis: str, top_k: int):
     return jax.lax.psum(out, axis)
 
 
-def _partial_param_specs(axis: str):
-    """shard_map specs for the partial strategy's params: expert tensors on
-    ``axis`` dim 0, gate replicated."""
+def _moe_param_specs(axis: str):
+    """shard_map specs shared by ALL strategies: expert tensors on ``axis``
+    dim 0, gate replicated."""
     return {
         "gate": P(),
         "w_in": P(axis), "b_in": P(axis),
@@ -183,7 +183,7 @@ def moe_ffn_partial(params, x, *, mesh, axis: str = "model", top_k: int = 2):
     return shard_map(
         per_rank,
         mesh=mesh,
-        in_specs=(_partial_param_specs(axis), P()),
+        in_specs=(_moe_param_specs(axis), P()),
         out_specs=P(),
     )(params, x)
 
@@ -220,9 +220,82 @@ def moe_ffn_partial_batched(
     return shard_map(
         per_rank,
         mesh=mesh,
-        in_specs=(_partial_param_specs(axis), x_spec),
+        in_specs=(_moe_param_specs(axis), x_spec),
         out_specs=x_spec,
     )(params, x)
+
+
+def _rank_dispatch(params, x, *, axis: str, top_k: int, C: int, valid=None):
+    """The per-rank switch-dispatch body (call inside shard_map, ``axis``
+    bound; tokens sharded over ``axis``). ``x``: [T_local, d] — this rank's
+    token shard; ``valid``: optional [T_local] bool marking real (non-pad)
+    tokens. Returns ``(out [T_local, d], kept, total)`` where kept/total
+    count this rank's surviving vs valid (token, k) assignments — psum and
+    divide for the global dropped fraction.
+    """
+    E = params["gate"].shape[-1]
+    n = jax.lax.psum(1, axis)
+    local_E = E // n
+    T_local, d = x.shape
+    weights, indices = top_k_gating(x, params["gate"], top_k)  # [Tl,k]
+    flat_e = indices.reshape(-1)          # [Tl*k] global expert ids
+    flat_w = weights.reshape(-1)          # [Tl*k]
+    flat_tok = jnp.repeat(jnp.arange(T_local), top_k)
+    if valid is None:
+        flat_valid = jnp.ones((T_local * top_k,), bool)
+    else:
+        flat_valid = jnp.repeat(valid, top_k)
+
+    # slot of each assignment within its expert's per-source capacity
+    # (pad tokens take no slot: their one_hot row is zeroed)
+    one_hot = jax.nn.one_hot(flat_e, E, dtype=jnp.int32)      # [Tl*k, E]
+    one_hot = one_hot * flat_valid[:, None].astype(jnp.int32)
+    pos_in_e = jnp.cumsum(one_hot, axis=0) * one_hot - 1      # [Tl*k, E]
+    pos = pos_in_e.max(axis=-1)                               # [Tl*k]
+    keep = (pos >= 0) & (pos < C)
+
+    # dispatch buffer [E, C, d]: my tokens, slotted per target expert
+    disp = jnp.zeros((E, C, d), x.dtype)
+    disp = disp.at[
+        jnp.where(keep, flat_e, 0),
+        jnp.where(keep, pos, 0),
+    ].add(jnp.where(keep[:, None], x[flat_tok], 0), mode="drop")
+
+    # all_to_all #1: chunk p (= experts owned by rank p) goes to rank p;
+    # I receive, from every source rank s, the slots for MY experts.
+    disp = disp.reshape(n, local_E, C, d)
+    recv = jax.lax.all_to_all(disp, axis, split_axis=0, concat_axis=0)
+    # recv: [n, local_E, C, d], recv[s, le] = rank s's tokens for my
+    # local expert le → flatten source into the slot dim per expert
+    recv = jnp.moveaxis(recv, 0, 1).reshape(local_E, n * C, d)
+
+    # local expert compute
+    y = jnp.stack(
+        [
+            _expert_ffn(
+                params["w_in"][le], params["b_in"][le],
+                params["w_out"][le], params["b_out"][le], recv[le],
+            )
+            for le in range(local_E)
+        ]
+    )  # [local_E, n*C, d]
+
+    # all_to_all #2 (return trip): chunk s goes back to source rank s
+    y = jnp.moveaxis(y.reshape(local_E, n, C, d), 1, 0)  # [n, local_E, C, d]
+    back = jax.lax.all_to_all(y, axis, split_axis=0, concat_axis=0)
+    # back: [n, local_E, C, d], back[p, le] = output of global expert
+    # (p*local_E + le) for MY tokens' slots → [E, C, d]
+    back = back.reshape(E, C, d)
+
+    # combine: weighted gather of each kept assignment's output
+    gathered = back[
+        jnp.where(keep, flat_e, 0), jnp.where(keep, pos, 0)
+    ]  # [Tl*k, d]
+    contrib = gathered * jnp.where(keep, flat_w, 0.0)[:, None].astype(x.dtype)
+    out = jnp.zeros_like(x).at[flat_tok].add(contrib)
+    kept = keep.sum().astype(jnp.float32)
+    total = flat_valid.sum().astype(jnp.float32)
+    return out, kept, total
 
 
 def moe_ffn_dispatch(
@@ -247,75 +320,85 @@ def moe_ffn_dispatch(
     n = mesh.shape[axis]
     E = params["gate"].shape[-1]
     assert E % n == 0, f"expert-axis size {n} must divide num_experts {E}"
-    local_E = E // n
     T = x.shape[0]
     assert T % n == 0, f"expert-axis size {n} must divide token count {T}"
     C = max(1, int(np.ceil(T // n * top_k / E * capacity_factor)))
 
     def per_rank(params, x):
-        # x: [T_local, d] — this rank's token shard
-        T_local, d = x.shape
-        weights, indices = top_k_gating(x, params["gate"], top_k)  # [Tl,k]
-        flat_e = indices.reshape(-1)          # [Tl*k] global expert ids
-        flat_w = weights.reshape(-1)          # [Tl*k]
-        flat_tok = jnp.repeat(jnp.arange(T_local), top_k)
-
-        # slot of each assignment within its expert's per-source capacity
-        one_hot = jax.nn.one_hot(flat_e, E, dtype=jnp.int32)      # [Tl*k, E]
-        pos_in_e = jnp.cumsum(one_hot, axis=0) * one_hot - 1      # [Tl*k, E]
-        pos = pos_in_e.max(axis=-1)                               # [Tl*k]
-        keep = pos < C
-
-        # dispatch buffer [E, C, d]: my tokens, slotted per target expert
-        disp = jnp.zeros((E, C, d), x.dtype)
-        disp = disp.at[
-            jnp.where(keep, flat_e, 0),
-            jnp.where(keep, pos, 0),
-        ].add(jnp.where(keep[:, None], x[flat_tok], 0), mode="drop")
-
-        # all_to_all #1: chunk p (= experts owned by rank p) goes to rank p;
-        # I receive, from every source rank s, the slots for MY experts.
-        disp = disp.reshape(n, local_E, C, d)
-        recv = jax.lax.all_to_all(disp, axis, split_axis=0, concat_axis=0)
-        # recv: [n, local_E, C, d], recv[s, le] = rank s's tokens for my
-        # local expert le → flatten source into the slot dim per expert
-        recv = jnp.moveaxis(recv, 0, 1).reshape(local_E, n * C, d)
-
-        # local expert compute
-        y = jnp.stack(
-            [
-                _expert_ffn(
-                    params["w_in"][le], params["b_in"][le],
-                    params["w_out"][le], params["b_out"][le], recv[le],
-                )
-                for le in range(local_E)
-            ]
-        )  # [local_E, n*C, d]
-
-        # all_to_all #2 (return trip): chunk s goes back to source rank s
-        y = jnp.moveaxis(y.reshape(local_E, n, C, d), 1, 0)  # [n, local_E, C, d]
-        back = jax.lax.all_to_all(y, axis, split_axis=0, concat_axis=0)
-        # back: [n, local_E, C, d], back[p, le] = output of global expert
-        # (p*local_E + le) for MY tokens' slots → [E, C, d]
-        back = back.reshape(E, C, d)
-
-        # combine: weighted gather of each kept assignment's output
-        gathered = back[
-            jnp.where(keep, flat_e, 0), jnp.where(keep, pos, 0)
-        ]  # [Tl*k, d]
-        contrib = gathered * jnp.where(keep, flat_w, 0.0)[:, None].astype(x.dtype)
-        return jnp.zeros_like(x).at[flat_tok].add(contrib)
+        out, _, _ = _rank_dispatch(
+            params, x, axis=axis, top_k=top_k, C=C
+        )
+        return out
 
     return shard_map(
         per_rank,
         mesh=mesh,
-        in_specs=(
-            {
-                "gate": P(),
-                "w_in": P(axis), "b_in": P(axis),
-                "w_out": P(axis), "b_out": P(axis),
-            },
-            P(axis),
-        ),
+        in_specs=(_moe_param_specs(axis), P(axis)),
         out_specs=P(axis),
+    )(params, x)
+
+
+def moe_ffn_dispatch_batched(
+    params,
+    x,
+    *,
+    mesh,
+    axis: str = "model",
+    data_axis: str | None = "data",
+    top_k: int = 2,
+    capacity_factor: float = 2.0,
+):
+    """`moe_ffn_dispatch` for batched activations inside a larger SPMD
+    program — the trainer-facing scalable-EP entry point (DP × EP).
+
+    ``x``: [B, S, d] with B sharded over ``data_axis`` and the activations
+    replicated over ``axis`` (the trainer's layout between blocks). Each
+    data shard's B_local·S tokens are split across the ``axis`` ranks
+    (padded up to a multiple — pad tokens take no capacity slots), routed
+    through the two all_to_alls, then all_gathered back to the replicated
+    layout. Returns ``(out [B, S, d], dropped)`` where ``dropped`` is the
+    global fraction of (token, k) assignments lost to the capacity bound —
+    0.0 when capacity is ample, at which point the result matches
+    ``moe_ffn_partial_batched`` exactly.
+    """
+    n = mesh.shape[axis]
+    E = params["gate"].shape[-1]
+    if E % n:
+        raise ValueError(f"expert-axis size {n} must divide num_experts {E}")
+    B, S, d = x.shape
+    data_sharded = bool(data_axis) and mesh.shape.get(data_axis, 1) > 1
+    data_size = mesh.shape.get(data_axis, 1) if data_sharded else 1
+    if B % data_size:
+        raise ValueError(
+            f"batch {B} does not shard over data axis of size {data_size}"
+        )
+    T = (B // data_size) * S
+    ss = -(-T // n)  # per-axis-rank token shard (ceil)
+    Tp = ss * n
+    C = max(1, int(np.ceil(ss * top_k / E * capacity_factor)))
+    reduce_axes = (axis, data_axis) if data_sharded else (axis,)
+
+    def per_rank(params, xl):
+        # xl: [B_local, S, d], replicated over ``axis``
+        flat = xl.reshape(T, d)
+        r = jax.lax.axis_index(axis)
+        flatp = jnp.pad(flat, ((0, Tp - T), (0, 0)))
+        mine = jax.lax.dynamic_slice_in_dim(flatp, r * ss, ss, 0)
+        valid = (r * ss + jnp.arange(ss)) < T
+        out_l, kept, total = _rank_dispatch(
+            params, mine, axis=axis, top_k=top_k, C=C, valid=valid
+        )
+        outp = jax.lax.all_gather(out_l, axis).reshape(Tp, d)
+        out = outp[:T].reshape(xl.shape)
+        kept = jax.lax.psum(kept, reduce_axes)
+        total = jax.lax.psum(total, reduce_axes)
+        dropped = 1.0 - kept / jnp.maximum(total, 1.0)
+        return out, dropped
+
+    x_spec = P(data_axis) if data_sharded else P()
+    return shard_map(
+        per_rank,
+        mesh=mesh,
+        in_specs=(_moe_param_specs(axis), x_spec),
+        out_specs=(x_spec, P()),
     )(params, x)

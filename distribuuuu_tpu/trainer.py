@@ -43,7 +43,7 @@ from distribuuuu_tpu.utils import checkpoint as ckpt
 from distribuuuu_tpu.utils import preempt
 from distribuuuu_tpu.utils.jsonlog import metrics_log, setup_metrics_log
 from distribuuuu_tpu.utils.logger import get_logger, setup_logger
-from distribuuuu_tpu.utils.meters import construct_meters
+from distribuuuu_tpu.utils.meters import AverageMeter, construct_meters
 from distribuuuu_tpu.utils.metrics import accuracy, count_parameters, cross_entropy
 from distribuuuu_tpu.utils.optim import construct_optimizer, set_lr
 from distribuuuu_tpu.utils.schedules import get_epoch_lr
@@ -80,8 +80,10 @@ def check_trainer_mesh():
         if cfg.MESH.SEQ not in (0, 1, -1):
             raise ValueError(
                 f"MESH.PIPE={cfg.MESH.PIPE} with MESH.SEQ={cfg.MESH.SEQ}: "
-                "pipeline stages run dense XLA attention; sequence-sharded "
-                "attention does not compose with the pipe axis"
+                "sequence-SHARDED (ring/ulysses) attention does not compose "
+                "with the pipe axis — PP shards depth, SP shards tokens; "
+                "per-device flash/blockwise attention inside stages is "
+                "supported instead (DEVICE.ATTN_IMPL flash)"
             )
     if cfg.MESH.SEQ not in (0, 1, -1) and not cfg.MODEL.ARCH.startswith("vit"):
         raise ValueError(
@@ -161,6 +163,8 @@ def build_model_from_cfg():
             kwargs["moe_experts"] = cfg.MODEL.MOE.NUM_EXPERTS
             kwargs["moe_top_k"] = cfg.MODEL.MOE.TOP_K
             kwargs["moe_every"] = cfg.MODEL.MOE.EVERY
+            kwargs["moe_impl"] = cfg.MODEL.MOE.IMPL
+            kwargs["moe_capacity_factor"] = cfg.MODEL.MOE.CAPACITY_FACTOR
             if cfg.MESH.MODEL not in (0, 1):
                 kwargs["mesh"] = mesh_lib.mesh_from_cfg(cfg)
     return models.build_model(cfg.MODEL.ARCH, **kwargs)
@@ -249,26 +253,38 @@ def _train_step_body(model, optimizer, topk: int, accum_steps: int = 1):
             {"params": params, "batch_stats": stats},
             images,
             train=True,
-            mutable=["batch_stats", "intermediates"],
+            mutable=["batch_stats", "intermediates", "moe_stats"],
             rngs={"dropout": key},
         )
         loss = cross_entropy(logits, labels)
         aux = jax.tree.leaves(mutated.get("intermediates", {}))
         if aux and moe_aux_weight:
             loss = loss + moe_aux_weight * sum(aux) / len(aux)
-        return loss, (logits, mutated.get("batch_stats", {}))
+        # dispatch-MoE observability: per-block dropped-assignment
+        # fractions (models/vit.MoeMlp sows the sum; empty for dense and
+        # partial-MoE models — zero overhead there)
+        dstats = jax.tree.leaves(mutated.get("moe_stats", {}))
+        dropped = sum(dstats) / len(dstats) if dstats else None
+        return loss, (logits, mutated.get("batch_stats", {}), dropped)
 
     grad_fn = jax.value_and_grad(loss_fn, has_aux=True)
 
+    def step_metrics(loss, logits, labels, dropped):
+        acc1, acck = accuracy(logits, labels, topk=(1, topk))
+        metrics = {"loss": loss, "top1": acc1, "topk": acck}
+        if dropped is not None:
+            metrics["moe_dropped"] = dropped
+        return metrics
+
     def train_step(state: TrainState, batch):
         step_key = jax.random.fold_in(state.key, state.step)
-        (loss, (logits, new_stats)), grads = grad_fn(
+        (loss, (logits, new_stats, dropped)), grads = grad_fn(
             state.params, state.batch_stats, batch["image"], batch["label"],
             step_key,
         )
-        acc1, acck = accuracy(logits, batch["label"], topk=(1, topk))
         return apply_grads(
-            state, grads, new_stats, {"loss": loss, "top1": acc1, "topk": acck}
+            state, grads, new_stats,
+            step_metrics(loss, logits, batch["label"], dropped),
         )
 
     def accum_train_step(state: TrainState, micro):
@@ -283,14 +299,13 @@ def _train_step_body(model, optimizer, topk: int, accum_steps: int = 1):
         def body(carry, mb):
             stats, gsum, i = carry
             mkey = jax.random.fold_in(step_key, i)
-            (loss, (logits, new_stats)), grads = grad_fn(
+            (loss, (logits, new_stats, dropped)), grads = grad_fn(
                 state.params, stats, mb["image"], mb["label"], mkey
             )
-            acc1, acck = accuracy(logits, mb["label"], topk=(1, topk))
             gsum = jax.tree.map(jnp.add, gsum, grads)
-            return (new_stats, gsum, i + 1), {
-                "loss": loss, "top1": acc1, "topk": acck,
-            }
+            return (new_stats, gsum, i + 1), step_metrics(
+                loss, logits, mb["label"], dropped
+            )
 
         zeros = jax.tree.map(jnp.zeros_like, state.params)
         (new_stats, gsum, _), micro_metrics = jax.lax.scan(
@@ -469,12 +484,17 @@ def train_epoch(loader, mesh, state, train_step, epoch: int, logger,
     n_buffered = 0  # fold slots filled since the last dispatch
     done = 0  # batches whose step has been dispatched
 
+    # dispatch-MoE only: fraction of routed assignments lost to capacity
+    moe_dropped = AverageMeter("MoEDrop", ":.4f")
+
     def flush_pending():
         for n, m in pending:
             if n == 1:
                 losses.update(float(m["loss"]))
                 top1.update(float(m["top1"]))
                 topk_m.update(float(m["topk"]))
+                if "moe_dropped" in m:
+                    moe_dropped.update(float(m["moe_dropped"]))
             else:  # stacked (fold,) metrics from a scan call
                 for ls, t1, tk in zip(
                     np.asarray(m["loss"]), np.asarray(m["top1"]),
@@ -483,6 +503,9 @@ def train_epoch(loader, mesh, state, train_step, epoch: int, logger,
                     losses.update(float(ls))
                     top1.update(float(t1))
                     topk_m.update(float(tk))
+                if "moe_dropped" in m:
+                    for dv in np.asarray(m["moe_dropped"]).reshape(-1):
+                        moe_dropped.update(float(dv))
         pending.clear()
 
     def maybe_print():
@@ -495,10 +518,14 @@ def train_epoch(loader, mesh, state, train_step, epoch: int, logger,
                     + (cfg.OPTIM.MAX_EPOCH - epoch - 1) * num_batches,
                 )
                 logger.info("%s  LR %.5f  ETA %s", progress.display(done), lr, eta)
+                extra = (
+                    {"moe_dropped": moe_dropped.avg} if moe_dropped.count else {}
+                )
                 metrics_log(
                     "train", epoch=epoch + 1, batch=done, loss=losses.avg,
                     top1=top1.avg, topk=topk_m.avg, lr=lr,
                     batch_time=batch_time.avg, data_time=data_time.avg,
+                    **extra,
                 )
 
     # Two preallocated (fold, batch, ...) host buffers, ping-ponged per

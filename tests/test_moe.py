@@ -101,6 +101,57 @@ def test_dispatch_tight_capacity_matches_masked_reference(setup):
     assert not keep.all()
 
 
+@pytest.fixture(scope="module")
+def batched_setup():
+    """data×model mesh + a [B, S, d] activation whose per-data-shard token
+    count (2·9=18) does NOT divide the model axis (4) — exercises the
+    pad-token path of the batched dispatch."""
+    params = moe.init_moe_params(jax.random.key(2), D, F, E)
+    x = jnp.asarray(
+        np.random.default_rng(5).standard_normal((4, 9, D)), jnp.float32
+    )
+    mesh = mesh_lib.build_mesh(data=2, model=4, seq=1, pipe=1)
+    return params, x, mesh
+
+
+def test_dispatch_batched_matches_partial_at_ample_capacity(batched_setup):
+    params, x, mesh = batched_setup
+    want = moe.moe_ffn_partial_batched(params, x, mesh=mesh, top_k=2)
+    out, dropped = jax.jit(
+        lambda p, x: moe.moe_ffn_dispatch_batched(
+            p, x, mesh=mesh, top_k=2, capacity_factor=float(E)
+        )
+    )(params, x)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(want), atol=1e-5)
+    assert float(dropped) == 0.0
+
+
+def test_dispatch_batched_tight_capacity_drops(batched_setup):
+    params, x, mesh = batched_setup
+    out, dropped = jax.jit(
+        lambda p, x: moe.moe_ffn_dispatch_batched(
+            p, x, mesh=mesh, top_k=2, capacity_factor=0.25
+        )
+    )(params, x)
+    assert np.isfinite(np.asarray(out)).all()
+    assert 0.0 < float(dropped) < 1.0, float(dropped)
+
+
+def test_dispatch_batched_differentiable(batched_setup):
+    params, x, mesh = batched_setup
+
+    def loss(p):
+        out, _ = moe.moe_ffn_dispatch_batched(
+            p, x, mesh=mesh, top_k=2, capacity_factor=2.0
+        )
+        return jnp.mean(out**2)
+
+    grads = jax.jit(jax.grad(loss))(params)
+    norms = {k: float(jnp.linalg.norm(v)) for k, v in grads.items()}
+    for k in ("w_in", "w_out", "gate"):
+        assert norms[k] > 0, f"zero grad for {k}: {norms}"
+
+
 def test_partial_path_is_differentiable(setup):
     params, x, mesh = setup
 

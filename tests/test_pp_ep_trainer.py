@@ -158,6 +158,84 @@ def test_moe_parallel_matches_dense_reference():
     )
 
 
+def test_pipe_with_flash_attention():
+    """PP × long-sequence attention (VERDICT r2 #7): the flash entry point
+    is legal inside pipeline stages (an opaque pallas_call on TPU; the
+    blockwise-scan fallback here on the CPU mesh — same exact-softmax
+    math), and the pipelined logits match the dense-XLA pipelined model."""
+    _tiny_vit_cfg(pipe=2)
+    cfg.MESH.MICROBATCH = 2
+    cfg.DEVICE.ATTN_IMPL = "flash"
+    trainer.check_trainer_mesh()
+    state, metrics, model, mesh, _ = _one_step()
+    assert type(model).__name__ == "PipelinedViT"
+    assert model.attn_impl == "flash"
+    assert np.isfinite(metrics["loss"])
+
+    # same stacked params through the xla-attention pipelined model
+    rng = np.random.default_rng(7)
+    x = jnp.asarray(rng.standard_normal((8, 32, 32, 3)), jnp.float32)
+    flogits = jax.jit(
+        lambda p, a: model.apply({"params": p}, a, train=False)
+    )(state.params, x)
+    cfg.DEVICE.ATTN_IMPL = "xla"
+    xmodel = trainer.build_model_from_cfg()
+    xlogits = jax.jit(
+        lambda p, a: xmodel.apply({"params": p}, a, train=False)
+    )(state.params, x)
+    np.testing.assert_allclose(
+        np.asarray(flogits), np.asarray(xlogits), atol=2e-4
+    )
+
+
+def test_vit_tiny_moe_trains_with_dispatch():
+    """MODEL.MOE.IMPL=dispatch routes MoeMlp through the all_to_all switch
+    path in the real trainer step; the dropped-assignment fraction surfaces
+    as the ``moe_dropped`` metric (0 at ample capacity)."""
+    _tiny_vit_cfg(model_axis=2, arch="vit_tiny_moe")
+    cfg.MODEL.MOE.IMPL = "dispatch"
+    cfg.MODEL.MOE.CAPACITY_FACTOR = float(cfg.MODEL.MOE.NUM_EXPERTS)
+    trainer.check_trainer_mesh()
+    state, metrics, model, mesh, _ = _one_step()
+    assert model.moe_impl == "dispatch"
+    assert np.isfinite(metrics["loss"])
+    assert metrics["moe_dropped"] == 0.0
+
+
+def test_dispatch_trainer_drops_under_tight_capacity():
+    _tiny_vit_cfg(model_axis=2, arch="vit_tiny_moe")
+    cfg.MODEL.MOE.IMPL = "dispatch"
+    cfg.MODEL.MOE.CAPACITY_FACTOR = 0.25
+    _, metrics, *_ = _one_step()
+    assert np.isfinite(metrics["loss"])
+    assert 0.0 < metrics["moe_dropped"] < 1.0
+
+
+def test_dispatch_logits_match_partial_at_ample_capacity():
+    """Same params, ample capacity: the dispatch model's logits equal the
+    partial (exact) model's — the switch path is exact when nothing drops."""
+    rng = np.random.default_rng(4)
+    x = jnp.asarray(rng.standard_normal((8, 32, 32, 3)), jnp.float32)
+
+    _tiny_vit_cfg(model_axis=2, arch="vit_tiny_moe")
+    mesh = mesh_lib.mesh_from_cfg(cfg)
+    pmodel = trainer.build_model_from_cfg()  # partial (default)
+    pstate = trainer.create_train_state(pmodel, jax.random.key(0), mesh, 32)
+    plogits = jax.jit(
+        lambda p, a: pmodel.apply({"params": p}, a, train=False)
+    )(pstate.params, x)
+
+    cfg.MODEL.MOE.IMPL = "dispatch"
+    cfg.MODEL.MOE.CAPACITY_FACTOR = float(cfg.MODEL.MOE.NUM_EXPERTS)
+    dmodel = trainer.build_model_from_cfg()
+    dlogits = jax.jit(
+        lambda p, a: dmodel.apply({"params": p}, a, train=False)
+    )(pstate.params, x)
+    np.testing.assert_allclose(
+        np.asarray(plogits), np.asarray(dlogits), atol=2e-4
+    )
+
+
 def test_pipe_refused_for_cnn_and_moe():
     _tiny_vit_cfg(pipe=4, arch="resnet18")
     with pytest.raises(ValueError, match="uniform-stage"):

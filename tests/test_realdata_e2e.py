@@ -1,0 +1,152 @@
+"""Real-JPEG ImageFolder training through the actual CLI (VERDICT r2 #1).
+
+Every other e2e test sets ``MODEL.DUMMY_INPUT True``; these drive
+``python train_net.py`` / ``test_net.py`` as subprocesses over a real tree
+of JPEG files (tools/make_imagefolder.py), exercising the full
+decode → augment → shard → step seam: threaded prefetch against dispatch,
+the native C++ decode backend under load, epoch reshuffle across workers,
+auto-resume, and PIL↔native eval agreement.
+
+Mirrors the reference's primary documented workflow (ref:
+/root/reference/README.md:94-107 — ImageFolder training; loaders
+/root/reference/distribuuuu/utils.py:121-152).
+"""
+
+import json
+import os
+import re
+import subprocess
+import sys
+
+import pytest
+
+pytestmark = pytest.mark.slow  # subprocess compiles on the 1-core CPU mesh
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if REPO not in sys.path:  # `pytest` without `python -m` lacks cwd on path
+    sys.path.insert(0, REPO)
+
+N_CLASSES = 4
+
+
+def _run_cli(script, *overrides, check=True):
+    env = dict(os.environ)
+    env["XLA_FLAGS"] = "--xla_force_host_platform_device_count=8"
+    proc = subprocess.run(
+        [
+            sys.executable, os.path.join(REPO, script),
+            "--cfg", os.path.join(REPO, "config", "resnet18.yaml"),
+            *map(str, overrides),
+        ],
+        capture_output=True, text=True, timeout=900, env=env, cwd=REPO,
+    )
+    if check and proc.returncode != 0:
+        raise AssertionError(
+            f"{script} failed ({proc.returncode}):\n"
+            f"stdout:\n{proc.stdout[-3000:]}\nstderr:\n{proc.stderr[-3000:]}"
+        )
+    return proc
+
+
+def _common_overrides(tree, out, backend="pil"):
+    return [
+        "DEVICE.PLATFORM", "cpu",
+        "DEVICE.COMPUTE_DTYPE", "float32",
+        "MODEL.NUM_CLASSES", N_CLASSES,
+        "TRAIN.DATASET", tree, "TEST.DATASET", tree,
+        "TRAIN.IM_SIZE", 32, "TEST.IM_SIZE", 48,
+        "TRAIN.BATCH_SIZE", 2, "TEST.BATCH_SIZE", 4,
+        "TRAIN.PRINT_FREQ", 2, "TRAIN.WORKERS", 2,
+        # global BN: the default ghost groups of TRAIN.BATCH_SIZE=2 are
+        # too noisy to learn in ~24 steps (tuned by hand; SYNCBN is also
+        # the collective-in-forward path worth exercising on real data)
+        "MODEL.SYNCBN", True,
+        # linear-scaled for global batch 16 (ref recipe: 0.1 per 128)
+        "OPTIM.BASE_LR", 0.0125, "OPTIM.WARMUP_EPOCHS", 0,
+        "RNG_SEED", 1,
+        "DATA.BACKEND", backend,
+        "OUT_DIR", out,
+    ]
+
+
+@pytest.fixture(scope="module")
+def jpeg_tree(tmp_path_factory):
+    from tools.make_imagefolder import make_tree
+
+    root = str(tmp_path_factory.mktemp("synthfolder"))
+    # 4×48 train (12 steps/epoch at global batch 16), 4×12 val (48 = 1.5
+    # eval batches → the ragged-tail masking path runs on real files too)
+    make_tree(
+        root, n_classes=N_CLASSES, train_per_class=48, val_per_class=12,
+        min_size=48, max_size=96, seed=3,
+    )
+    return root
+
+
+@pytest.fixture(scope="module")
+def trained_run(jpeg_tree, tmp_path_factory):
+    """One 2-epoch PIL-backend training run shared by the assertions."""
+    out = str(tmp_path_factory.mktemp("realdata_out"))
+    _run_cli(
+        "train_net.py",
+        *_common_overrides(jpeg_tree, out),
+        "OPTIM.MAX_EPOCH", 2,
+    )
+    return out
+
+
+def _read_metrics(out):
+    with open(os.path.join(out, "metrics.jsonl")) as f:
+        return [json.loads(line) for line in f]
+
+
+def test_loss_falls_on_real_jpegs(trained_run):
+    recs = _read_metrics(trained_run)
+    train = [r for r in recs if r["kind"] == "train"]
+    evals = [r for r in recs if r["kind"] == "eval"]
+    assert train and len(evals) == 2
+    # the meter's within-epoch running average at the last window of the
+    # final epoch must sit well below the first window of epoch 0
+    assert train[-1]["loss"] < train[0]["loss"]
+    # hue-separable classes: a resnet18 must beat 25% chance by a margin
+    assert evals[-1]["top1"] > 60.0
+    assert evals[-1]["samples"] == N_CLASSES * 12
+
+
+def test_auto_resume_from_real_jpegs(trained_run, jpeg_tree):
+    """Raising MAX_EPOCH resumes from the epoch-1 checkpoint — and the
+    resumed run exercises the native C++ decode backend through the CLI."""
+    proc = _run_cli(
+        "train_net.py",
+        *_common_overrides(jpeg_tree, trained_run, backend="native"),
+        "OPTIM.MAX_EPOCH", 3,
+    )
+    log = proc.stderr + proc.stdout
+    assert re.search(r"resumed from .*ckpt_ep_001", log), log[-2000:]
+    assert os.path.isdir(
+        os.path.join(trained_run, "checkpoints", "ckpt_ep_002")
+    )
+
+
+def _eval_top1(proc):
+    m = re.search(r"TEST\s+Acc@1\s+([\d.]+)", proc.stderr + proc.stdout)
+    assert m, (proc.stdout + proc.stderr)[-2000:]
+    return float(m.group(1))
+
+
+def test_backends_agree_on_eval_metrics(trained_run, jpeg_tree):
+    """PIL and native decode produce the same eval accuracy on the same
+    checkpoint (pixel differences are bounded by resampler quantization —
+    tests/test_native_decode.py — and must not move the metric)."""
+    best = os.path.join(trained_run, "checkpoints", "best")
+    top1 = {}
+    for backend in ("pil", "native"):
+        proc = _run_cli(
+            "test_net.py",
+            *_common_overrides(jpeg_tree, trained_run, backend=backend),
+            "MODEL.WEIGHTS", best,
+        )
+        top1[backend] = _eval_top1(proc)
+    assert top1["pil"] > 60.0
+    # 48 val samples → one flipped prediction = 2.08pp; allow at most one
+    assert abs(top1["pil"] - top1["native"]) <= 2.1, top1

@@ -160,11 +160,18 @@ def test_linear_numerics_match_torch():
 
 
 @pytest.mark.parametrize(
-    "arch", ["resnet18", "resnet50", "densenet121", "botnet50", "vit_tiny"]
+    "arch",
+    [
+        "resnet18", "resnet50", "densenet121", "botnet50", "vit_tiny",
+        "regnety_160", "efficientnet_b0",
+    ],
 )
 def test_full_model_roundtrip(arch):
     """botnet50/vit_tiny exercise the 'embed' slot kind (rel_height/
-    rel_width, pos_embed) that r1 refused (VERDICT r1 item 5)."""
+    rel_width, pos_embed) that r1 refused (VERDICT r1 item 5);
+    regnety_160/efficientnet_b0 exercise the depthwise-conv ([O,1,kh,kw])
+    and biased-SE-1x1 layouts the published timm baselines need
+    (VERDICT r2 #5)."""
     kw = {}
     if arch == "botnet50":
         kw["fmap_size"] = (4, 4)  # attention grid for the 64px test input
@@ -194,6 +201,55 @@ def test_full_model_roundtrip(arch):
         train=False,
     )
     assert out.shape == (1, 10)
+
+
+def test_depthwise_se_numerics_match_torch():
+    """Depthwise conv + squeeze-excite weights ingested from torch
+    reproduce torch's forward exactly — the two layouts where order-based
+    alignment could plausibly misalign (VERDICT r2 #5): depthwise kernels
+    ([C,1,kh,kw] ↔ [kh,kw,1,C]) and SE's biased 1×1 convs."""
+    import flax.linen as nn
+
+    from distribuuuu_tpu.models.layers import SqueezeExcite
+
+    C, se_w = 8, 4
+
+    class TorchDWSE(torch.nn.Module):
+        def __init__(self):
+            super().__init__()
+            self.dw = torch.nn.Conv2d(C, C, 3, padding=1, groups=C, bias=False)
+            self.fc1 = torch.nn.Conv2d(C, se_w, 1)
+            self.fc2 = torch.nn.Conv2d(se_w, C, 1)
+
+        def forward(self, x):
+            x = self.dw(x)
+            s = x.mean((2, 3), keepdim=True)
+            s = torch.sigmoid(self.fc2(torch.relu(self.fc1(s))))
+            return x * s
+
+    class FlaxDWSE(nn.Module):
+        @nn.compact
+        def __call__(self, x):
+            x = nn.Conv(
+                C, (3, 3), feature_group_count=C, use_bias=False,
+                dtype=jnp.float32, param_dtype=jnp.float32,
+            )(x)
+            return SqueezeExcite(se_w, act=nn.relu, dtype=jnp.float32)(x)
+
+    tmod = TorchDWSE().eval()
+    x = np.random.default_rng(9).standard_normal((2, 6, 6, C)).astype(np.float32)
+    with torch.no_grad():
+        want = (
+            tmod(torch.from_numpy(np.transpose(x, (0, 3, 1, 2))))
+            .numpy().transpose(0, 2, 3, 1)
+        )
+
+    fmod = FlaxDWSE()
+    variables = fmod.init(jax.random.key(0), jnp.asarray(x))
+    sd = {k: v.detach().numpy() for k, v in tmod.state_dict().items()}
+    conv = torch_ingest.convert_state_dict(sd, variables)
+    got = fmod.apply({"params": conv["params"]}, jnp.asarray(x))
+    np.testing.assert_allclose(np.asarray(got), want, rtol=1e-4, atol=1e-5)
 
 
 def test_botnet_mhsa_numerics_match_torch():

@@ -1,41 +1,45 @@
-"""Reproduce the flash-attention performance claims (PERF.md r2 section).
+"""Reproduce the flash-attention performance comparison (PERF.md).
 
 Benchmarks the three long-sequence attention paths at a chosen shape —
 the hand-tiled Pallas flash kernel (ops/flash_attention.py), the lax.scan
 blockwise path (ops/ring_attention.blockwise_attention), and dense XLA —
-forward and forward+backward, with the dispatch-amortized methodology this
-environment requires (N applications folded inside ONE jit via lax.scan
-with output feedback; per-call timing on a tunneled transport measures the
-~5-10 ms dispatch floor, not the kernel).
+forward and forward+backward.
+
+Methodology (both hazards burned earlier rounds):
+
+1. **Dispatch amortization**: N applications folded inside ONE jit via
+   lax.scan with output feedback; per-call timing on a tunneled transport
+   measures the ~5-10 ms dispatch floor, not the kernel. The fwd+bwd
+   feedback MUST depend on all three grads — feeding back only dq lets
+   XLA dead-code-eliminate the dK/dV backward (a separable pallas_call on
+   the flash path).
+2. **Interleaved paired rounds** (VERDICT r2 #2): tunnel load drifts the
+   absolute ms by up to ~2× within and between sessions, so timing path A
+   in one block of windows and path B in another measures the drift, not
+   the kernels. Every round times one window of EVERY path back-to-back;
+   the reported ratio is the MEDIAN of per-round ratios (paired samples),
+   with per-path median ± [min, max] spread printed alongside.
 
 Usage (defaults are the canonical ViT-Ti/1024px shape [4, 3, 4096, 64]):
 
     python tools/flash_bench.py [--batch 4] [--heads 3] [--seq 4096]
-        [--dim 64] [--iters 10] [--skip-dense]
-
-Reference numbers (v5e, bf16, 2026-07, this script): fwd flash 6.96 ms /
-scan 7.99 / dense 8.11; fwd+bwd flash 7.89 / scan 9.67 / dense 14.69 —
-flash 1.15× scan fwd, **1.23× fwd+bwd**, 1.9× dense fwd+bwd. NOTES:
-(1) absolute ms on the tunneled transport vary with load by up to ~2×
-between sessions, and the fwd ratio varies with it (1.15-1.54× observed);
-the fwd+bwd ratio is the steadier claim. (2) the fwd+bwd feedback MUST
-depend on all three grads — feeding back only dq lets XLA dead-code-
-eliminate the dK/dV backward (a separable pallas_call on the flash path)
-and inflates the flash ratio. (3) --iters ≥ 20: shorter windows
-under-amortize the dispatch floor.
+        [--dim 64] [--iters 20] [--rounds 5] [--skip-dense]
+        [--blk-q 1024] [--blk-k 1024]
 """
 
 from __future__ import annotations
 
 import argparse
+import statistics
 import time
 
 import _path  # noqa: F401  (repo root onto sys.path)
 import numpy as np
 
 
-def bench_folded(fn, q, k, v, iters: int) -> float:
-    """Best-of-3 windows of ``iters`` applications inside one jit."""
+def make_fwd_runner(fn, q, k, v, iters: int):
+    """One jitted callable folding ``iters`` applications; returns a timing
+    closure that runs one window and fences on a scalar of the result."""
     import jax
     import jax.numpy as jnp
 
@@ -48,18 +52,17 @@ def bench_folded(fn, q, k, v, iters: int) -> float:
         out, _ = jax.lax.scan(body, q, None, length=iters)
         return out
 
-    o = run(q, k, v)
-    float(jnp.sum(o.astype(jnp.float32)))  # tunnel-safe fence
-    best = float("inf")
-    for _ in range(3):
+    def window():
         t0 = time.perf_counter()
         o = run(q, k, v)
-        float(jnp.sum(o.astype(jnp.float32)))
-        best = min(best, (time.perf_counter() - t0) / iters)
-    return best
+        float(jnp.sum(o.astype(jnp.float32)))  # tunnel-safe fence
+        return (time.perf_counter() - t0) / iters
+
+    window()  # compile + warm
+    return window
 
 
-def bench_grad_folded(fn, q, k, v, iters: int) -> float:
+def make_bwd_runner(fn, q, k, v, iters: int):
     import jax
     import jax.numpy as jnp
 
@@ -72,22 +75,60 @@ def bench_grad_folded(fn, q, k, v, iters: int) -> float:
     def run(q, k, v):
         def body(c, _):
             dq, dk, dv = grad(c, k, v)
-            # feedback must depend on ALL grads or XLA dead-code-eliminates
-            # the dK/dV backward (a separable pallas_call on the flash path)
+            # feedback must depend on ALL grads (hazard 1 in the docstring)
             return (dq + dk + dv).astype(c.dtype), ()
 
         out, _ = jax.lax.scan(body, q, None, length=iters)
         return out
 
-    o = run(q, k, v)
-    float(jnp.sum(o.astype(jnp.float32)))
-    best = float("inf")
-    for _ in range(3):
+    def window():
         t0 = time.perf_counter()
         o = run(q, k, v)
         float(jnp.sum(o.astype(jnp.float32)))
-        best = min(best, (time.perf_counter() - t0) / iters)
-    return best
+        return (time.perf_counter() - t0) / iters
+
+    window()
+    return window
+
+
+def interleaved(runners: dict, rounds: int) -> dict:
+    """rounds × one window per path, adjacent in time. → {name: [s, ...]}"""
+    times = {name: [] for name in runners}
+    for _ in range(rounds):
+        for name, window in runners.items():
+            times[name].append(window())
+    return times
+
+
+def report(tag: str, times: dict, flops: float | None = None):
+    med = {n: statistics.median(ts) for n, ts in times.items()}
+    for name, ts in times.items():
+        extra = (
+            f" ({flops / med[name] / 1e12:5.1f} TFLOP/s)" if flops else ""
+        )
+        print(
+            f"{tag} {name:5s}: median {med[name] * 1e3:7.3f} ms "
+            f"[{min(ts) * 1e3:.3f}, {max(ts) * 1e3:.3f}]{extra}"
+        )
+    if "flash" in times and "scan" in times:
+        ratios = sorted(
+            s / f for s, f in zip(times["scan"], times["flash"])
+        )
+        print(
+            f"{tag} flash-vs-scan per-round ratios: "
+            f"median {statistics.median(ratios):.2f}x "
+            f"[{ratios[0]:.2f}, {ratios[-1]:.2f}]"
+        )
+    if "flash" in times and "dense" in times:
+        ratios = sorted(
+            d / f for d, f in zip(times["dense"], times["flash"])
+        )
+        print(
+            f"{tag} flash-vs-dense per-round ratios: "
+            f"median {statistics.median(ratios):.2f}x "
+            f"[{ratios[0]:.2f}, {ratios[-1]:.2f}]"
+        )
+    return med
 
 
 def main():
@@ -96,7 +137,13 @@ def main():
     ap.add_argument("--heads", type=int, default=3)
     ap.add_argument("--seq", type=int, default=4096)
     ap.add_argument("--dim", type=int, default=64)
-    ap.add_argument("--iters", type=int, default=20)
+    ap.add_argument("--iters", type=int, default=20,
+                    help="applications folded per window (≥20: shorter "
+                         "windows under-amortize the dispatch floor)")
+    ap.add_argument("--rounds", type=int, default=5,
+                    help="interleaved timing rounds (paired ratios)")
+    ap.add_argument("--blk-q", type=int, default=None)
+    ap.add_argument("--blk-k", type=int, default=None)
     ap.add_argument("--skip-dense", action="store_true",
                     help="skip the O(L²)-memory dense baseline")
     args = ap.parse_args()
@@ -109,7 +156,8 @@ def main():
 
     B, H, L, D = args.batch, args.heads, args.seq, args.dim
     print(f"backend={jax.default_backend()} "
-          f"device={jax.devices()[0].device_kind} shape=[{B},{H},{L},{D}]")
+          f"device={jax.devices()[0].device_kind} shape=[{B},{H},{L},{D}] "
+          f"iters={args.iters} rounds={args.rounds}")
     rng = np.random.default_rng(0)
     q, k, v = (
         jnp.asarray(rng.standard_normal((B, H, L, D)), jnp.bfloat16)
@@ -117,24 +165,29 @@ def main():
     )
     flops = 2 * 2 * B * H * L * L * D
 
+    fkw = {}
+    if args.blk_q:
+        fkw["blk_q"] = args.blk_q
+    if args.blk_k:
+        fkw["blk_k"] = args.blk_k
     paths = {
-        "flash": lambda q, k, v: fa.flash_attention(q, k, v),
+        "flash": lambda q, k, v: fa.flash_attention(q, k, v, **fkw),
         "scan": lambda q, k, v: ra.blockwise_attention(q, k, v),
     }
     if not args.skip_dense:
         paths["dense"] = lambda q, k, v: ra.reference_attention(q, k, v)
 
-    fwd, bwd = {}, {}
-    for name, fn in paths.items():
-        fwd[name] = bench_folded(fn, q, k, v, args.iters)
-        print(f"fwd     {name:5s}: {fwd[name] * 1e3:7.3f} ms "
-              f"({flops / fwd[name] / 1e12:5.1f} TFLOP/s)")
-    for name, fn in paths.items():
-        bwd[name] = bench_grad_folded(fn, q, k, v, args.iters)
-        print(f"fwd+bwd {name:5s}: {bwd[name] * 1e3:7.3f} ms")
-    if "flash" in fwd and "scan" in fwd:
-        print(f"flash vs scan: fwd {fwd['scan'] / fwd['flash']:.2f}x, "
-              f"fwd+bwd {bwd['scan'] / bwd['flash']:.2f}x")
+    fwd_runners = {
+        n: make_fwd_runner(fn, q, k, v, args.iters)
+        for n, fn in paths.items()
+    }
+    report("fwd    ", interleaved(fwd_runners, args.rounds), flops)
+    del fwd_runners
+    bwd_runners = {
+        n: make_bwd_runner(fn, q, k, v, args.iters)
+        for n, fn in paths.items()
+    }
+    report("fwd+bwd", interleaved(bwd_runners, args.rounds))
 
 
 if __name__ == "__main__":

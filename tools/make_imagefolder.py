@@ -1,0 +1,146 @@
+"""Synthesize a learnable ImageFolder JPEG tree (train/val splits).
+
+The reference's primary documented workflow trains from a real ImageFolder
+directory of JPEGs (ref: /root/reference/README.md:94-107, loaders
+/root/reference/distribuuuu/utils.py:121-152). This environment has no
+ImageNet, so this tool manufactures a stand-in with the properties that
+matter for exercising the real data path end to end:
+
+- real JPEG files on disk, decoded by libjpeg (native C++ kernel) or PIL;
+- varied non-square dimensions, so resize/RandomResizedCrop geometry runs
+  on every sample rather than degenerating to a no-op;
+- class-conditional structure a small CNN can actually learn (each class
+  gets a distinct base hue + stripe orientation/frequency), so "loss
+  falls over real files" is a meaningful assertion;
+- per-sample noise, random gradients and JPEG quality jitter so images
+  within a class are not near-duplicates.
+
+Everything is deterministic in (seed, class, index) — two invocations with
+the same arguments produce byte-identical trees (same PIL/libjpeg encoder).
+
+Usage:
+    python tools/make_imagefolder.py --out /tmp/synthfolder \
+        --classes 10 --train-per-class 300 --val-per-class 30 \
+        --min-size 160 --max-size 320
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+
+import numpy as np
+from PIL import Image
+
+
+def _class_palette(n_classes: int, rng: np.random.Generator):
+    """Distinct (hue base rgb, stripe angle, stripe frequency) per class."""
+    specs = []
+    for c in range(n_classes):
+        hue = c / n_classes
+        # crude hsv→rgb on the hue wheel, full saturation, value 0.8
+        h6 = hue * 6.0
+        x = 1.0 - abs(h6 % 2 - 1.0)
+        rgb = [(1, x, 0), (x, 1, 0), (0, 1, x), (0, x, 1), (x, 0, 1), (1, 0, x)][
+            int(h6) % 6
+        ]
+        angle = np.pi * c / n_classes
+        freq = 2.0 + 1.5 * (c % 4)
+        specs.append((np.asarray(rgb, np.float32) * 0.8, angle, freq))
+    return specs
+
+
+def render_image(
+    cls_spec, w: int, h: int, rng: np.random.Generator
+) -> np.ndarray:
+    """One [h, w, 3] uint8 image: class hue + oriented stripes + noise."""
+    base, angle, freq = cls_spec
+    yy, xx = np.mgrid[0:h, 0:w].astype(np.float32)
+    yy /= h
+    xx /= w
+    phase = rng.uniform(0, 2 * np.pi)
+    stripes = 0.5 + 0.5 * np.sin(
+        2 * np.pi * freq * (np.cos(angle) * xx + np.sin(angle) * yy) + phase
+    )
+    # random linear shading so global mean alone is a weaker cue than hue
+    gdir = rng.uniform(-1, 1, size=2).astype(np.float32)
+    shade = 0.75 + 0.25 * (gdir[0] * (xx - 0.5) + gdir[1] * (yy - 0.5))
+    img = (
+        base[None, None, :] * (0.55 + 0.45 * stripes[..., None]) * shade[..., None]
+    )
+    img = img + rng.normal(0.0, 0.06, size=img.shape).astype(np.float32)
+    return (np.clip(img, 0.0, 1.0) * 255).astype(np.uint8)
+
+
+def make_tree(
+    out: str,
+    n_classes: int = 10,
+    train_per_class: int = 300,
+    val_per_class: int = 30,
+    min_size: int = 160,
+    max_size: int = 320,
+    seed: int = 0,
+) -> str:
+    """Write ``out/{train,val}/class_XX/img_XXXX.jpg``; returns ``out``.
+
+    Idempotent: if the finished-marker file exists with matching args the
+    tree is reused (the real-chip bench calls this every run).
+    """
+    stamp = os.path.join(out, ".complete")
+    sig = f"{n_classes}/{train_per_class}/{val_per_class}/{min_size}/{max_size}/{seed}"
+    if os.path.exists(stamp):
+        with open(stamp) as f:
+            if f.read().strip() == sig:
+                return out
+    # different-args regeneration: clear the old tree first — leftover
+    # class dirs / higher-index files would silently pollute the dataset
+    import shutil
+
+    for split in ("train", "val"):
+        shutil.rmtree(os.path.join(out, split), ignore_errors=True)
+    if os.path.exists(stamp):
+        os.remove(stamp)
+    palette = _class_palette(n_classes, np.random.default_rng(seed))
+    for split_id, (split, per_class) in enumerate(
+        (("train", train_per_class), ("val", val_per_class))
+    ):
+        for c in range(n_classes):
+            cdir = os.path.join(out, split, f"class_{c:02d}")
+            os.makedirs(cdir, exist_ok=True)
+            for i in range(per_class):
+                rng = np.random.default_rng(
+                    np.random.SeedSequence([seed, split_id, c, i])
+                )
+                w = int(rng.integers(min_size, max_size + 1))
+                h = int(rng.integers(min_size, max_size + 1))
+                arr = render_image(palette[c], w, h, rng)
+                q = int(rng.integers(78, 95))
+                Image.fromarray(arr).save(
+                    os.path.join(cdir, f"img_{i:04d}.jpg"),
+                    quality=q,
+                )
+    with open(stamp, "w") as f:
+        f.write(sig)
+    return out
+
+
+def main():
+    p = argparse.ArgumentParser(description=__doc__.split("\n")[0])
+    p.add_argument("--out", required=True)
+    p.add_argument("--classes", type=int, default=10)
+    p.add_argument("--train-per-class", type=int, default=300)
+    p.add_argument("--val-per-class", type=int, default=30)
+    p.add_argument("--min-size", type=int, default=160)
+    p.add_argument("--max-size", type=int, default=320)
+    p.add_argument("--seed", type=int, default=0)
+    args = p.parse_args()
+    out = make_tree(
+        args.out, args.classes, args.train_per_class, args.val_per_class,
+        args.min_size, args.max_size, args.seed,
+    )
+    n = sum(len(files) for _, _, files in os.walk(out))
+    print(f"wrote {out}: {args.classes} classes, ~{n} files")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,53 @@
+"""Probe: pallas flash kernel inside shard_map + lax.scan + ppermute on TPU.
+
+The exact program structure PipelinedViT's pipeline produces (pp.pipelined:
+shard_map over the mesh, lax.scan over schedule ticks, ppermute hops), with
+the flash pallas_call in the stage body. 1 real chip => pipe axis size 1
+(ppermute is an identity hop, but the collective + custom-call coexistence
+is what Mosaic/XLA must accept).
+
+RESULT (v5e, 2026-07-31, VERDICT r2 #7): compiles and runs, forward AND
+backward — max fwd err vs the exact-attention oracle 4.9e-4, finite grads.
+The r2 refusal of flash inside pipeline stages was conservative, not a
+Mosaic limitation; PipelinedViT now accepts attn_impl='flash'/'blockwise'
+(models/vit.py), with the CPU-mesh composition test in
+tests/test_pp_ep_trainer.py::test_pipe_with_flash_attention. Multi-chip
+ppermute (pipe axis > 1) remains hardware-unverified in this 1-chip
+environment — the driver's 8-device CPU dryrun covers the multi-stage
+schedule with the scan fallback.
+"""
+# run on the real chip: python tools/pp_flash_probe.py
+import _path  # noqa: F401  (repo root onto sys.path)
+import numpy as np
+import jax, jax.numpy as jnp
+from jax.sharding import Mesh, PartitionSpec as P
+from distribuuuu_tpu.parallel.compat import shard_map
+from distribuuuu_tpu.ops.flash_attention import flash_attention
+from distribuuuu_tpu.ops.ring_attention import reference_attention
+
+mesh = Mesh(np.array(jax.devices()[:1]), ("pipe",))
+rng = np.random.default_rng(0)
+q, k, v = (jnp.asarray(rng.standard_normal((2, 3, 2048, 64)), jnp.bfloat16)
+           for _ in range(3))
+
+def per_device(q, k, v):
+    def tick(carry, t):
+        o = flash_attention(carry, k, v)
+        o = jax.lax.ppermute(o, "pipe", [(i, (i + 1) % 1) for i in range(1)])
+        return o.astype(carry.dtype), ()
+    out, _ = jax.lax.scan(tick, q, jnp.arange(2))
+    return out
+
+f = jax.jit(shard_map(per_device, mesh=mesh,
+                      in_specs=(P(), P(), P()), out_specs=P()))
+got = np.asarray(f(q, k, v), np.float32)
+
+# oracle: two sequential applications of exact attention
+want = reference_attention(reference_attention(q, k, v).astype(q.dtype), k, v)
+err = np.abs(got - np.asarray(want, np.float32)).max()
+print("PP-structure flash probe: max err", err)
+assert err < 0.05, err
+# grad through the same structure (the training path)
+g = jax.jit(jax.grad(lambda q: jnp.sum(f(q, k, v).astype(jnp.float32))))(q)
+assert bool(jnp.isfinite(g.astype(jnp.float32)).all()), "non-finite grads"
+print("grad ok: True")
