@@ -333,6 +333,11 @@ _C.DATA = CfgNode()
 # Decode backend: "auto" uses the C++ kernel (native/decode.cc) when it
 # builds, else PIL; "native" requires it; "pil" forces pure Python.
 _C.DATA.BACKEND = "auto"
+# Ship uint8 pixels and run (x/255 - mean)/std in-graph on device instead
+# of on the host: 4× fewer host→device bytes per batch (PCIe / tunnel)
+# and less host CPU, numerically equivalent (pixels are uint8 after
+# resampling either way — transforms.normalize_in_graph).
+_C.DATA.DEVICE_NORMALIZE = False
 
 # ------------------------------- profiler ------------------------------------
 # jax.profiler trace capture (TensorBoard/XProf format). When enabled, the

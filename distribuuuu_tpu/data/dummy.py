@@ -12,16 +12,24 @@ import numpy as np
 
 
 class DummyDataset:
-    """length random NHWC images of ``size``×``size``, label 0."""
+    """length random NHWC images of ``size``×``size``, label 0.
 
-    def __init__(self, length: int = 6400, size: int = 224):
+    ``raw_u8`` mirrors ``DATA.DEVICE_NORMALIZE``: uint8 samples so the
+    dummy pipeline ships the same dtype the real one would."""
+
+    def __init__(self, length: int = 6400, size: int = 224,
+                 raw_u8: bool = False):
         self.length = length
         self.size = size
+        self.raw_u8 = raw_u8
 
     def __len__(self):
         return self.length
 
     def __getitem__(self, idx: int):
         rng = np.random.default_rng(idx)
+        if self.raw_u8:
+            return rng.integers(0, 256, (self.size, self.size, 3),
+                                dtype=np.uint8), 0
         img = rng.standard_normal((self.size, self.size, 3), dtype=np.float32)
         return img, 0

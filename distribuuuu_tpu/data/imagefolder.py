@@ -55,6 +55,7 @@ class ImageFolderDataset:
         base_seed: int = 0,
         crop_size: int | None = None,
         backend: str = "auto",
+        raw_u8: bool = False,
     ):
         self.dir = os.path.join(root, split)
         self.samples, self.classes = scan_image_folder(self.dir)
@@ -68,6 +69,11 @@ class ImageFolderDataset:
         if backend not in ("auto", "native", "pil"):
             raise ValueError(f"DATA.BACKEND must be auto|native|pil, got {backend}")
         self.backend = backend
+        # DATA.DEVICE_NORMALIZE: emit resampled uint8 RGB; normalization
+        # runs in-graph on device (transforms.normalize_in_graph) — 4×
+        # fewer host→device bytes, numerics unchanged (pixels are uint8
+        # after PIL/native resampling either way)
+        self.raw_u8 = raw_u8
 
     def _use_native(self) -> bool:
         if self.backend == "pil":
@@ -102,9 +108,10 @@ class ImageFolderDataset:
         labels = np.asarray(
             [self.samples[int(i)][1] for i in idxs], np.int32
         )
+        out_dtype = np.uint8 if self.raw_u8 else np.float32
         if not self._use_native():
             images = np.stack([self[int(i)][0] for i in idxs])
-            return images.astype(np.float32), labels
+            return images.astype(out_dtype), labels
 
         from distribuuuu_tpu import native
         from distribuuuu_tpu.data import transforms as T
@@ -127,10 +134,15 @@ class ImageFolderDataset:
             else:
                 g = T.val_geom(w, h, self.im_size, self.crop_size)
             geoms[pos] = g + (0,)  # trailing struct padding field
-        images, statuses = native.load_batch(
-            paths, geoms, (out_size, out_size),
-            T.IMAGENET_MEAN, T.IMAGENET_STD, n_threads,
-        )
+        if self.raw_u8:
+            images, statuses = native.load_batch_u8(
+                paths, geoms, (out_size, out_size), n_threads,
+            )
+        else:
+            images, statuses = native.load_batch(
+                paths, geoms, (out_size, out_size),
+                T.IMAGENET_MEAN, T.IMAGENET_STD, n_threads,
+            )
         for pos in set(fallback) | set(np.nonzero(statuses)[0].tolist()):
             images[pos] = self[int(idxs[pos])][0]
         return images, labels
@@ -148,7 +160,13 @@ class ImageFolderDataset:
         with Image.open(path) as img:
             img = img.convert("RGB")
             if self.train:
-                arr = train_transform(img, self.im_size, self._rng(idx))
+                arr = train_transform(
+                    img, self.im_size, self._rng(idx),
+                    normalize=not self.raw_u8,
+                )
             else:
-                arr = val_transform(img, self.im_size, self.crop_size)
+                arr = val_transform(
+                    img, self.im_size, self.crop_size,
+                    normalize=not self.raw_u8,
+                )
         return arr, label

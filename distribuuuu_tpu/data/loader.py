@@ -83,15 +83,20 @@ class Loader:
             images = np.stack([p[0] for p in pairs])
             labels = np.asarray([p[1] for p in pairs], np.int32)
         n = len(images)
+        images = np.asarray(images)
+        # DATA.DEVICE_NORMALIZE ships uint8 (4× fewer H2D bytes; the
+        # trainer normalizes in-graph); otherwise float32 as before
+        img_dtype = np.uint8 if images.dtype == np.uint8 else np.float32
         batch = {
-            "image": np.asarray(images, np.float32),
+            "image": images.astype(img_dtype, copy=False),
             "label": labels.astype(np.int32),
             "mask": np.ones((n,), np.float32),
         }
         if n < self.batch_size:  # pad ragged final eval batch, mask it out
             pad = self.batch_size - n
             batch["image"] = np.concatenate(
-                [batch["image"], np.zeros((pad,) + batch["image"].shape[1:], np.float32)]
+                [batch["image"],
+                 np.zeros((pad,) + batch["image"].shape[1:], img_dtype)]
             )
             batch["label"] = np.concatenate([batch["label"], np.zeros(pad, np.int32)])
             batch["mask"] = np.concatenate([batch["mask"], np.zeros(pad, np.float32)])
@@ -123,11 +128,13 @@ class Loader:
 
 
 def _build_dataset(split: str, train: bool):
+    raw_u8 = bool(cfg.DATA.DEVICE_NORMALIZE)
     if cfg.MODEL.DUMMY_INPUT:
         # dummy images are model-input-sized for both splits (the reference
         # likewise uses 224² dummies everywhere, utils.py:125,159)
         return DummyDataset(
-            length=cfg.TRAIN.BATCH_SIZE * 64, size=cfg.TRAIN.IM_SIZE
+            length=cfg.TRAIN.BATCH_SIZE * 64, size=cfg.TRAIN.IM_SIZE,
+            raw_u8=raw_u8,
         )
     from distribuuuu_tpu.data.imagefolder import ImageFolderDataset
 
@@ -141,6 +148,7 @@ def _build_dataset(split: str, train: bool):
         base_seed=cfg.RNG_SEED or 0,
         crop_size=None if train else cfg.TRAIN.IM_SIZE,
         backend=cfg.DATA.BACKEND,
+        raw_u8=raw_u8,
     )
 
 

@@ -98,17 +98,45 @@ def to_normalized_array(img: Image.Image) -> np.ndarray:
     return (arr - IMAGENET_MEAN) / IMAGENET_STD
 
 
-def train_transform(img: Image.Image, im_size: int, rng: np.random.Generator):
+def to_u8_array(img: Image.Image) -> np.ndarray:
+    """Raw uint8 NHWC — the ``DATA.DEVICE_NORMALIZE`` host output. Lossless
+    vs ``to_normalized_array``: PIL ops keep pixels uint8 anyway, so the
+    only change is WHERE (x/255 − mean)/std runs (in-graph, fp32)."""
+    arr = np.asarray(img, np.uint8)
+    if arr.ndim == 2:  # grayscale
+        arr = np.stack([arr] * 3, axis=-1)
+    return arr
+
+
+def normalize_in_graph(images, mean=None, std=None):
+    """The device-side half of ``DATA.DEVICE_NORMALIZE``: uint8 NHWC →
+    normalized float32, same formula/order as ``to_normalized_array``.
+    Works on jax or numpy arrays (pure jnp ops; call inside jit)."""
+    import jax.numpy as jnp
+
+    mean = IMAGENET_MEAN if mean is None else mean
+    std = IMAGENET_STD if std is None else std
+    x = images.astype(jnp.float32) / 255.0
+    return (x - jnp.asarray(mean)) / jnp.asarray(std)
+
+
+def train_transform(
+    img: Image.Image, im_size: int, rng: np.random.Generator,
+    normalize: bool = True,
+):
     img = random_resized_crop(img, im_size, rng)
     if rng.random() < 0.5:
         img = img.transpose(Image.FLIP_LEFT_RIGHT)
-    return to_normalized_array(img)
+    return to_normalized_array(img) if normalize else to_u8_array(img)
 
 
-def val_transform(img: Image.Image, resize_size: int, crop_size: int):
+def val_transform(
+    img: Image.Image, resize_size: int, crop_size: int,
+    normalize: bool = True,
+):
     img = resize_shorter(img, resize_size)
     img = center_crop(img, crop_size)
-    return to_normalized_array(img)
+    return to_normalized_array(img) if normalize else to_u8_array(img)
 
 
 # ---------------------------------------------------------------------------

@@ -22,7 +22,7 @@ import numpy as np
 
 _SRC = os.path.join(os.path.dirname(__file__), "decode.cc")
 _LIB = os.path.join(os.path.dirname(__file__), "_libdtpu_decode.so")
-_ABI_VERSION = 2
+_ABI_VERSION = 3
 
 _lock = threading.Lock()
 _lib = None
@@ -102,6 +102,17 @@ def _load():
             ctypes.POINTER(ctypes.c_float),
             ctypes.POINTER(ctypes.c_int32),
         ]
+        lib.dtpu_load_batch_u8.restype = None
+        lib.dtpu_load_batch_u8.argtypes = [
+            ctypes.POINTER(ctypes.c_char_p),
+            ctypes.c_void_p,
+            ctypes.c_int32,
+            ctypes.c_int32,
+            ctypes.c_int32,
+            ctypes.c_int32,
+            ctypes.POINTER(ctypes.c_uint8),
+            ctypes.POINTER(ctypes.c_int32),
+        ]
         _lib = lib
         return _lib
 
@@ -162,6 +173,37 @@ def load_batch(
         std32.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
         n_threads,
         images.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
+        statuses.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
+    )
+    return images, statuses
+
+
+def load_batch_u8(
+    paths: list[str],
+    geoms: np.ndarray,  # structured array matching Geom, len n
+    out_size: tuple[int, int],  # (h, w)
+    n_threads: int,
+) -> tuple[np.ndarray, np.ndarray]:
+    """Raw-u8 batch (``DATA.DEVICE_NORMALIZE``): decode+resample+flip, no
+    normalize. Returns (images [n,h,w,3] uint8, statuses [n])."""
+    lib = _load()
+    if lib is None:
+        raise RuntimeError(f"native decode unavailable: {_build_error}")
+    n = len(paths)
+    out_h, out_w = out_size
+    images = np.empty((n, out_h, out_w, 3), np.uint8)
+    statuses = np.empty((n,), np.int32)
+    c_paths = (ctypes.c_char_p * n)(*[p.encode() for p in paths])
+    geoms = np.ascontiguousarray(geoms)
+    assert geoms.nbytes == n * ctypes.sizeof(Geom), "geom layout mismatch"
+    lib.dtpu_load_batch_u8(
+        c_paths,
+        geoms.ctypes.data_as(ctypes.c_void_p),
+        n,
+        out_w,
+        out_h,
+        n_threads,
+        images.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)),
         statuses.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
     )
     return images, statuses

@@ -40,10 +40,13 @@ _NEG_BIG = -0.7 * float(jnp.finfo(jnp.float32).max)
 _VMEM_BUDGET_BYTES = 12 * 1024 * 1024
 _VMEM_BYTES_PER_TOKEN_DIM = 10
 
-# Defaults tuned on a v5e at the reference shape [4, 3, 4096, 64]
-# (ViT-Ti/1024px): fwd 1.5x, fwd+bwd 1.3x over the lax.scan blockwise path.
-BLK_Q = 1024
-BLK_K = 1024
+# Defaults re-tuned r3 on a v5e at the reference shape [4, 3, 4096, 64]
+# (ViT-Ti/1024px) with the interleaved paired-rounds harness
+# (tools/flash_bench.py): 512² beats the old 1024² on the paired
+# flash-vs-scan ratio both directions (fwd 1.09x vs 1.01x; fwd+bwd 1.43x
+# vs 1.19x — the smaller q-block speeds the dK/dV kernel's inner loop).
+BLK_Q = 512
+BLK_K = 512
 
 
 def _round_up(x: int, m: int) -> int:

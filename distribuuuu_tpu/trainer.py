@@ -247,8 +247,10 @@ def _train_step_body(model, optimizer, topk: int, accum_steps: int = 1):
     # values into ``intermediates``); captured at step-build time. Zero
     # overhead for dense archs: the collection stays empty.
     moe_aux_weight = float(cfg.MODEL.MOE.AUX_WEIGHT)
+    prep_images = _make_image_prep()
 
     def loss_fn(params, stats, images, labels, key):
+        images = prep_images(images)
         logits, mutated = model.apply(
             {"params": params, "batch_stats": stats},
             images,
@@ -348,14 +350,26 @@ def make_scan_train_step(model, optimizer, topk: int, fold: int,
     return jax.jit(scan_steps, donate_argnums=0)
 
 
+def _make_image_prep():
+    """In-graph half of ``DATA.DEVICE_NORMALIZE`` (captured at step-build
+    time): the loader ships raw uint8, the step normalizes in fp32 —
+    identical formula/order to the host path (data/transforms.py)."""
+    if not cfg.DATA.DEVICE_NORMALIZE:
+        return lambda images: images
+    from distribuuuu_tpu.data.transforms import normalize_in_graph
+
+    return normalize_in_graph
+
+
 def make_eval_step(model, topk: int):
     """Masked eval step: per-batch metric sums + valid count
     (≙ validate body, ref: trainer.py:77-89)."""
+    prep_images = _make_image_prep()
 
     def eval_step(state: TrainState, batch):
         logits = model.apply(
             {"params": state.params, "batch_stats": state.batch_stats},
-            batch["image"],
+            prep_images(batch["image"]),
             train=False,
         )
         mask = batch["mask"]

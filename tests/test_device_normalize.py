@@ -1,0 +1,114 @@
+"""DATA.DEVICE_NORMALIZE: ship uint8, normalize in-graph.
+
+Motivated by a direct r3 measurement (PERF.md "Real-JPEG"): this
+environment's host→device path moves ~3.5 MB/s raw, so a float32 batch is
+4× the bytes of the information it carries — pixels are uint8 after
+PIL/native resampling either way. These tests pin the equivalence: the
+uint8 pipeline + in-graph normalize produces the SAME tensors as the
+host-normalized float pipeline, end to end.
+"""
+
+import numpy as np
+import pytest
+from PIL import Image
+
+import jax.numpy as jnp
+
+from distribuuuu_tpu.config import cfg
+from distribuuuu_tpu.data.loader import Loader, construct_train_loader
+from distribuuuu_tpu.data.imagefolder import ImageFolderDataset
+from distribuuuu_tpu.data.transforms import (
+    normalize_in_graph,
+    to_normalized_array,
+    to_u8_array,
+)
+
+
+def _tree(root, n_per_class=3):
+    rng = np.random.default_rng(0)
+    for cls in ("a", "b"):
+        d = root / "train" / cls
+        d.mkdir(parents=True)
+        for i in range(n_per_class):
+            w, h = int(rng.integers(50, 90)), int(rng.integers(50, 90))
+            arr = rng.integers(0, 256, size=(h, w, 3), dtype=np.uint8)
+            Image.fromarray(arr).save(d / f"{i}.jpg", "JPEG", quality=92)
+    import shutil
+
+    shutil.copytree(root / "train", root / "val")
+    return str(root)
+
+
+def test_normalize_in_graph_matches_host_normalize():
+    rng = np.random.default_rng(1)
+    u8 = rng.integers(0, 256, size=(2, 8, 8, 3), dtype=np.uint8)
+    img0 = Image.fromarray(u8[0])
+    host = to_normalized_array(img0)
+    dev = np.asarray(normalize_in_graph(jnp.asarray(u8)))[0]
+    np.testing.assert_allclose(dev, host, atol=1e-6)
+    assert np.array_equal(to_u8_array(img0), u8[0])
+
+
+@pytest.mark.parametrize("train", [True, False])
+def test_u8_dataset_plus_device_normalize_equals_float_dataset(
+    tmp_path, train
+):
+    """Same files, same augmentation stream: uint8 pipeline + in-graph
+    normalize == host-normalized float pipeline (both splits)."""
+    root = _tree(tmp_path)
+    kw = dict(
+        root=root, split="train" if train else "val",
+        im_size=32 if train else 48, train=train, base_seed=7,
+        crop_size=None if train else 32, backend="pil",
+    )
+    ds_f = ImageFolderDataset(**kw)
+    ds_u = ImageFolderDataset(**kw, raw_u8=True)
+    ds_f.set_epoch_seed(2)
+    ds_u.set_epoch_seed(2)
+    idxs = np.arange(len(ds_f))
+    imgs_f, labels_f = ds_f.load_batch(idxs)
+    imgs_u, labels_u = ds_u.load_batch(idxs)
+    assert imgs_u.dtype == np.uint8
+    np.testing.assert_array_equal(labels_f, labels_u)
+    np.testing.assert_allclose(
+        np.asarray(normalize_in_graph(jnp.asarray(imgs_u))),
+        imgs_f, atol=1e-6,
+    )
+
+
+def test_loader_ships_uint8_batches_with_uint8_padding():
+    cfg.MODEL.DUMMY_INPUT = True
+    cfg.DATA.DEVICE_NORMALIZE = True
+    cfg.TRAIN.BATCH_SIZE = 2
+    cfg.TRAIN.IM_SIZE = 16
+    loader = construct_train_loader()
+    batch = next(iter(loader))
+    assert batch["image"].dtype == np.uint8
+    # ragged-tail padding path keeps the dtype
+    ds = loader.dataset
+    small = Loader(ds, batch_size=len(ds) + 8, shuffle=False,
+                   drop_last=False, workers=1)
+    padded = next(iter(small))
+    assert padded["image"].dtype == np.uint8
+    assert padded["mask"].sum() < len(padded["mask"])
+
+
+def test_native_u8_matches_pil_u8(tmp_path):
+    """The C++ raw-u8 kernel agrees with the PIL uint8 path within the
+    resampler quantization bound (≤3 counts — same bound the normalized
+    parity test uses)."""
+    from distribuuuu_tpu import native
+
+    if not native.available():
+        pytest.skip(f"native kernel unavailable: {native.build_error()}")
+    root = _tree(tmp_path, n_per_class=4)
+    kw = dict(root=root, split="train", im_size=32, train=True,
+              base_seed=5, raw_u8=True)
+    ds_nat = ImageFolderDataset(**kw, backend="native")
+    ds_pil = ImageFolderDataset(**kw, backend="pil")
+    idxs = np.arange(len(ds_nat))
+    imgs_n, _ = ds_nat.load_batch(idxs)
+    imgs_p, _ = ds_pil.load_batch(idxs)
+    assert imgs_n.dtype == imgs_p.dtype == np.uint8
+    diff = np.abs(imgs_n.astype(np.int16) - imgs_p.astype(np.int16))
+    assert diff.max() <= 3, diff.max()

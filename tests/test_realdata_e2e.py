@@ -137,16 +137,27 @@ def _eval_top1(proc):
 def test_backends_agree_on_eval_metrics(trained_run, jpeg_tree):
     """PIL and native decode produce the same eval accuracy on the same
     checkpoint (pixel differences are bounded by resampler quantization —
-    tests/test_native_decode.py — and must not move the metric)."""
+    tests/test_native_decode.py — and must not move the metric). The
+    uint8 device-normalize path (DATA.DEVICE_NORMALIZE) must match its
+    host-normalized float twin EXACTLY — same pixels, normalize merely
+    moves in-graph."""
     best = os.path.join(trained_run, "checkpoints", "best")
     top1 = {}
-    for backend in ("pil", "native"):
+    for name, extra in (
+        ("pil", ()),
+        ("native", ()),
+        ("pil+devnorm", ("DATA.DEVICE_NORMALIZE", "True")),
+    ):
         proc = _run_cli(
             "test_net.py",
-            *_common_overrides(jpeg_tree, trained_run, backend=backend),
+            *_common_overrides(
+                jpeg_tree, trained_run, backend=name.split("+")[0]
+            ),
             "MODEL.WEIGHTS", best,
+            *extra,
         )
-        top1[backend] = _eval_top1(proc)
+        top1[name] = _eval_top1(proc)
     assert top1["pil"] > 60.0
     # 48 val samples → one flipped prediction = 2.08pp; allow at most one
     assert abs(top1["pil"] - top1["native"]) <= 2.1, top1
+    assert top1["pil+devnorm"] == top1["pil"], top1
