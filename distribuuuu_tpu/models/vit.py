@@ -42,6 +42,19 @@ class Mlp(nn.Module):
         return nn.Dropout(self.dropout, deterministic=not train)(x)
 
 
+def _axis_is_bound(name: str) -> bool:
+    """True when ``name`` is a bound mesh axis in the current trace (i.e.
+    we are inside a shard_map body). Trace-time check — resolves before
+    compilation, so both branches stay jit-compatible."""
+    import jax
+
+    try:
+        jax.lax.axis_size(name)
+        return True
+    except NameError:
+        return False
+
+
 class MoeMlp(nn.Module):
     """Mixture-of-experts FFN block (expert parallelism, ops/moe.py).
 
@@ -74,6 +87,11 @@ class MoeMlp(nn.Module):
     mesh: Any = None
     impl: str = "partial"
     capacity_factor: float = 2.0
+    # True inside an enclosing shard_map (pipeline stages): run the
+    # expert-partials body inline on bound axes instead of opening a
+    # (nested, illegal) shard_map. Outside any shard_map this flag is
+    # inert — the dense reference path runs (init, sequential fallback).
+    axes_bound: bool = False
 
     @nn.compact
     def __call__(self, x, train: bool = False):
@@ -123,7 +141,34 @@ class MoeMlp(nn.Module):
                 f"MODEL.MOE.IMPL must be 'partial' or 'dispatch', "
                 f"got {self.impl!r}"
             )
-        if (
+        if self.axes_bound and _axis_is_bound(MODEL_AXIS):
+            # inside an enclosing shard_map (a pipeline stage): mesh axes
+            # are already bound — run the expert-partials body INLINE
+            # (nested shard_map is illegal). x is this rank's token shard;
+            # params are full (replicated inside the stage shard_map) —
+            # slice this rank's experts and psum the partials over model.
+            # Exact math (partial strategy drops nothing); collapses to
+            # the dense loop + free psum at model-axis size 1.
+            n = jax.lax.axis_size(MODEL_AXIS)
+            r = jax.lax.axis_index(MODEL_AXIS)
+            if E % n:
+                raise ValueError(
+                    f"model axis size {n} must divide num_experts {E}"
+                )
+            local_E = E // n
+            local = {
+                "gate": params["gate"],
+                **{
+                    k: jax.lax.dynamic_slice_in_dim(
+                        params[k], r * local_E, local_E, 0
+                    )
+                    for k in ("w_in", "b_in", "w_out", "b_out")
+                },
+            }
+            out = moe_ops._rank_partials(
+                local, x.reshape(B * S, d), MODEL_AXIS, self.top_k
+            ).reshape(B, S, d)
+        elif (
             self.mesh is not None
             and self.mesh.shape.get(MODEL_AXIS, 1) > 1
             and B % data_size == 0
@@ -254,6 +299,7 @@ class Block(nn.Module):
     moe_top_k: int = 2
     moe_impl: str = "partial"
     moe_capacity_factor: float = 2.0
+    moe_axes_bound: bool = False  # inside a pipeline stage's shard_map
 
     @nn.compact
     def __call__(self, x, train: bool = False):
@@ -269,6 +315,7 @@ class Block(nn.Module):
                 self.moe_top_k, self.dtype, self.mesh,
                 impl=self.moe_impl,
                 capacity_factor=self.moe_capacity_factor,
+                axes_bound=self.moe_axes_bound,
             )
         else:
             ffn = Mlp(
@@ -368,13 +415,28 @@ class ViTStage(nn.Module):
     dtype: Any
     blocks_per_stage: int
     attn_impl: str = "xla"
+    moe_experts: int = 0  # PP×EP: MoE FFN in every moe_every-th block
+    moe_top_k: int = 2
+    moe_every: int = 2
 
     @nn.compact
     def __call__(self, x, train: bool = False):
-        for _ in range(self.blocks_per_stage):
+        for j in range(self.blocks_per_stage):
+            # uniform per-stage placement; PipelinedViT enforces
+            # blocks_per_stage % moe_every == 0 so the LOCAL pattern
+            # coincides with the flat model's GLOBAL i % moe_every one
+            # (checkpoint converters keep working)
+            moe = (
+                self.moe_experts
+                if self.moe_experts > 0
+                and j % self.moe_every == self.moe_every - 1
+                else 0
+            )
             x = Block(
                 self.dim, self.num_heads, self.mlp_ratio, self.dropout,
                 self.dtype, self.attn_impl, None,
+                moe_experts=moe, moe_top_k=self.moe_top_k,
+                moe_axes_bound=True,
             )(x, train=train)
         return x
 
@@ -393,6 +455,17 @@ class PipelinedViT(_ViTCommon):
     order) — used when the batch cannot be microbatched (e.g. ``init``) and
     as the correctness oracle in tests: GPipe is math-preserving, so both
     paths agree.
+
+    PP×EP (``moe_experts > 0``): MoE blocks inside stages run the exact
+    expert-partials strategy INLINE on the already-bound ``model`` axis
+    (models/vit.MoeMlp ``axes_bound`` — a nested shard_map would be
+    illegal). Expert placement must be uniform per stage:
+    ``depth/pipe_stages`` divisible by ``moe_every`` (then it coincides
+    with the flat model's placement and the checkpoint converters keep
+    working). Two caveats vs flat EP: the switch ``dispatch`` strategy is
+    not available under PP, and the load-balancing aux is not collected
+    (stage apply carries no mutable collections) — harmless for the
+    partial strategy, which is exact regardless of balance.
     """
 
     num_classes: int = 1000
@@ -407,6 +480,9 @@ class PipelinedViT(_ViTCommon):
     mesh: Any = None
     pipe_stages: int = 2
     pipe_microbatches: int = 0  # 0 → 2 × pipe_stages
+    moe_experts: int = 0  # PP×EP (partial strategy; see _stage_module)
+    moe_top_k: int = 2
+    moe_every: int = 2
 
     def _stage_module(self):
         if self.depth % self.pipe_stages:
@@ -414,6 +490,19 @@ class PipelinedViT(_ViTCommon):
                 f"depth {self.depth} not divisible by pipe_stages "
                 f"{self.pipe_stages}"
             )
+        if self.moe_experts > 0:
+            k = self.depth // self.pipe_stages
+            if k % self.moe_every:
+                # local placement j % every must equal the flat model's
+                # global i % every (i = s·k + j) on every stage — holds
+                # iff every | k; otherwise checkpoints/conversions and
+                # the uniform-stage contract would silently diverge
+                raise ValueError(
+                    f"PP×MoE needs blocks-per-stage ({k} = depth "
+                    f"{self.depth} / pipe {self.pipe_stages}) divisible "
+                    f"by MODEL.MOE.EVERY ({self.moe_every}); adjust "
+                    "MESH.PIPE or MODEL.MOE.EVERY"
+                )
         if self.dropout > 0:
             raise ValueError(
                 "dropout inside pipeline stages is not supported (stage "
@@ -437,6 +526,8 @@ class PipelinedViT(_ViTCommon):
             self.dim, self.num_heads, self.mlp_ratio, 0.0, self.dtype,
             self.depth // self.pipe_stages,
             attn_impl=self.attn_impl,
+            moe_experts=self.moe_experts, moe_top_k=self.moe_top_k,
+            moe_every=self.moe_every,
         )
 
     @nn.compact
@@ -587,15 +678,12 @@ def _vit(num_classes, kw, **defaults):
     pipe = kw.pop("pipe_stages", 0)
     if pipe and pipe > 1:
         kw.setdefault("pipe_microbatches", 0)
-        for unsupported in ("moe_experts",):
-            if kw.get(unsupported):
-                raise ValueError(
-                    "MoE FFN does not compose with the pipeline axis yet; "
-                    "use MESH.PIPE=1 for the *_moe archs"
-                )
-        kw.pop("moe_experts", None)
-        kw.pop("moe_top_k", None)
-        kw.pop("moe_every", None)
+        if kw.get("moe_experts") and kw.get("moe_impl", "partial") != "partial":
+            raise ValueError(
+                "PP×MoE runs the exact partial strategy only (the switch "
+                "dispatch path needs its own shard_map); set "
+                "MODEL.MOE.IMPL partial with MESH.PIPE>1"
+            )
         kw.pop("moe_impl", None)
         kw.pop("moe_capacity_factor", None)
         return PipelinedViT(num_classes=num_classes, pipe_stages=pipe, **kw)

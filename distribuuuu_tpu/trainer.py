@@ -72,11 +72,24 @@ def check_trainer_mesh():
                 "for those archs"
             )
         if cfg.MODEL.ARCH.endswith("_moe"):
-            raise ValueError(
-                "MESH.PIPE>1 does not compose with the *_moe archs yet "
-                "(expert shard_map inside a pipeline stage); use "
-                "MESH.MODEL for expert parallelism"
-            )
+            if cfg.MODEL.MOE.IMPL != "partial":
+                raise ValueError(
+                    "MESH.PIPE>1 composes with MoE via the exact partial "
+                    "strategy only (the dispatch path needs its own "
+                    "shard_map); set MODEL.MOE.IMPL partial"
+                )
+            if cfg.MODEL.MOE.AUX_WEIGHT:
+                import warnings
+
+                warnings.warn(
+                    "PP×MoE: the load-balancing aux is NOT collected "
+                    "inside pipeline stages (stage apply carries no "
+                    "mutable collections) — MODEL.MOE.AUX_WEIGHT "
+                    f"{cfg.MODEL.MOE.AUX_WEIGHT} will contribute nothing. "
+                    "Harmless for the exact partial strategy; set it to 0 "
+                    "to silence this warning.",
+                    stacklevel=2,
+                )
         if cfg.MESH.SEQ not in (0, 1, -1):
             raise ValueError(
                 f"MESH.PIPE={cfg.MESH.PIPE} with MESH.SEQ={cfg.MESH.SEQ}: "

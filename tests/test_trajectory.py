@@ -120,10 +120,16 @@ def test_trajectories_match_across_modes():
     dptp = _run_per_step(model_axis=2)
     for name, traj in (("folded", folded), ("accum", accum), ("dptp", dptp)):
         assert np.isfinite(traj).all(), (name, traj)
-        # exact-math window: first three steps, before chaotic growth
-        # (measured cross-mode drift: ~5e-7 at step 0, ≤7e-3 by step 2)
+        # exact-math window before chaotic growth. Recalibrated r3: the
+        # centered-variance BN (ADVICE fix) rounds x−mean elementwise,
+        # and the per-step ghost path (grouped reshape broadcast) rounds
+        # it differently from the accum micro-batch path (whole-batch
+        # mean) — measured drift now ~2e-7 step 0, ~2e-3 step 1, ~0.13
+        # step 2 for accum (was ≤7e-3 at step 2 with E[x²]−E[x]², whose
+        # elementwise x² was mode-identical). Steps 0-1 carry the
+        # exactness claim; the family assertion below covers the rest.
         np.testing.assert_allclose(
-            traj[:3], base[:3], rtol=0, atol=2e-2, err_msg=name
+            traj[:2], base[:2], rtol=0, atol=2e-2, err_msg=name
         )
         # same convergence family: every mode learns the stream
         assert np.mean(traj[-4:]) < 0.6 * np.mean(traj[:3]), (name, traj)

@@ -90,7 +90,7 @@ def report(steps: int, top: int):
 
     for plane in xs.planes:
         pname = plane.name.lower()
-        if "tpu" not in pname or "device" in pname and "tpu" not in pname:
+        if "tpu" not in pname:
             continue
         if not plane.lines:
             continue

@@ -13,6 +13,10 @@ axes of scale as first-class, and they all hang off the same
 These are the primitives; the trainer reaches PP and EP straight from
 YAML too — ``train_net.py --cfg config/vit_tiny.yaml MESH.PIPE 4`` and
 ``--cfg config/vit_tiny_moe.yaml MESH.MODEL 2`` (see README "Mesh axes").
+The axes compose from YAML as well: PP×EP
+(``vit_tiny_moe MESH.PIPE 2 MESH.MODEL 2``), PP×flash attention
+(``MESH.PIPE 2 DEVICE.ATTN_IMPL flash``), and the scalable switch-routed
+EP (``MODEL.MOE.IMPL dispatch`` — watch the ``moe_dropped`` metric).
 
 Run:
 
