@@ -42,12 +42,12 @@ def run_cli(tree, out, args, backend):
         "TRAIN.BATCH_SIZE", str(args.batch),
         "TEST.BATCH_SIZE", str(args.batch),
         "TRAIN.IM_SIZE", str(args.im_size),
+        # val: shorter-side resize keeps the train/test 224/256 ratio
+        "TEST.IM_SIZE", str(max(args.im_size, int(args.im_size * 8 / 7))),
         "TRAIN.WORKERS", str(args.workers),
         "TRAIN.PRINT_FREQ", "4",
         "OPTIM.MAX_EPOCH", str(args.epochs),
-        # conservative for a ~30-step from-scratch run with no warmup
-        # (the linear-scaled 0.05 for batch 64 diverges in the first steps)
-        "OPTIM.BASE_LR", "0.0125", "OPTIM.WARMUP_EPOCHS", "0",
+        "OPTIM.BASE_LR", str(args.lr), "OPTIM.WARMUP_EPOCHS", "0",
         "DATA.BACKEND", backend,
         "DATA.DEVICE_NORMALIZE", str(bool(args.device_normalize)),
         "RNG_SEED", "1",
@@ -101,6 +101,12 @@ def main():
     ap.add_argument("--classes", type=int, default=10)
     ap.add_argument("--per-class", type=int, default=100)
     ap.add_argument("--im-size", type=int, default=224)
+    # conservative default for a ~30-step from-scratch run with no warmup
+    # (the linear-scaled 0.05 for batch 64 diverges in the first steps)
+    ap.add_argument("--lr", type=float, default=0.0125)
+    ap.add_argument("--min-size", type=int, default=256,
+                    help="source JPEG shorter bound")
+    ap.add_argument("--max-size", type=int, default=320)
     ap.add_argument("--workers", type=int, default=os.cpu_count() or 4)
     ap.add_argument("--out", default="/tmp/realdata_bench")
     ap.add_argument("--tree", default="/tmp/distribuuuu_synth_rd")
@@ -111,7 +117,7 @@ def main():
     make_tree(
         args.tree, n_classes=args.classes, train_per_class=args.per_class,
         val_per_class=max(4, args.per_class // 10),
-        min_size=256, max_size=320,
+        min_size=args.min_size, max_size=args.max_size,
     )
 
     import shutil
