@@ -43,7 +43,7 @@ def run_cli(tree, out, args, backend):
         "TEST.BATCH_SIZE", str(args.batch),
         "TRAIN.IM_SIZE", str(args.im_size),
         # val: shorter-side resize keeps the train/test 224/256 ratio
-        "TEST.IM_SIZE", str(max(args.im_size, int(args.im_size * 8 / 7))),
+        "TEST.IM_SIZE", str(int(args.im_size * 8 / 7)),
         "TRAIN.WORKERS", str(args.workers),
         "TRAIN.PRINT_FREQ", "4",
         "OPTIM.MAX_EPOCH", str(args.epochs),
