@@ -35,8 +35,17 @@ PEAK_BF16 = {
 }
 
 
-def main():
+def build_workload(fold: int = 4, per_chip_batch: int = 128):
+    """Build the bench's compiled+warmed train step.
+
+    Returns ``(window, meta)`` — ``window(iters)`` runs ``iters`` calls
+    (``fold`` optimizer steps each) and returns elapsed seconds, fenced on
+    a value fetch; ``meta`` has batch geometry. Factored out so
+    ``tools/ab_bench.py`` can build the SAME workload under two different
+    trace-time environments and interleave paired timing windows.
+    """
     import jax
+    import jax.numpy as jnp
     import numpy as np
 
     import distribuuuu_tpu.config as config
@@ -49,14 +58,7 @@ def main():
     cfg.MODEL.ARCH = "resnet50"
     cfg.MODEL.NUM_CLASSES = 1000
     n_chips = len(jax.devices())
-    per_chip_batch = 128
     batch = per_chip_batch * n_chips
-
-    # The framework's folded dispatch mode (≙ TRAIN.STEPS_PER_CALL in the
-    # trainer): FOLD optimizer steps per compiled call via lax.scan,
-    # removing the per-step host dispatch (~4 ms on tunneled transports)
-    # from the critical path. Same train-step math.
-    fold = 4
 
     mesh = mesh_lib.build_mesh()
     model = trainer.build_model_from_cfg()
@@ -79,29 +81,50 @@ def main():
     # block_until_ready was observed returning before the work ran (a
     # 8192³ matmul "finished" at 100+ PFLOP/s), so syncing on a scalar
     # derived from the updated params is the reliable fence.
-    import jax.numpy as jnp
-
     def fence(state):
         leaf = jax.tree.leaves(state.params)[0]
         return float(jnp.sum(leaf))
 
+    box = {"state": state}
+
+    def window(iters: int) -> float:
+        st = box["state"]
+        t0 = time.perf_counter()
+        for _ in range(iters):
+            st, _metrics = train_step(st, gbatch)
+        fence(st)
+        dt = time.perf_counter() - t0
+        box["state"] = st
+        return dt
+
     # compile + warmup
-    state, metrics = train_step(state, gbatch)
-    fence(state)
-    for _ in range(3):
-        state, metrics = train_step(state, gbatch)
-    fence(state)
+    window(1)
+    window(3)
+
+    meta = {
+        "n_chips": n_chips,
+        "batch": batch,
+        "fold": fold,
+        "per_chip_batch": per_chip_batch,
+        "device_kind": jax.devices()[0].device_kind,
+    }
+    return window, meta
+
+
+def main():
+    import jax
+
+    # The framework's folded dispatch mode (≙ TRAIN.STEPS_PER_CALL in the
+    # trainer): FOLD optimizer steps per compiled call via lax.scan,
+    # removing the per-step host dispatch (~4 ms on tunneled transports)
+    # from the critical path. Same train-step math.
+    window, meta = build_workload(fold=4, per_chip_batch=128)
+    n_chips, batch, fold = meta["n_chips"], meta["batch"], meta["fold"]
+    per_chip_batch = meta["per_chip_batch"]
 
     # timed steady state — best of three windows (tunnel jitter is ±3%)
     iters = 10  # calls; fold steps each
-    best_dt = float("inf")
-    for _ in range(3):
-        t0 = time.perf_counter()
-        for _ in range(iters):
-            state, metrics = train_step(state, gbatch)
-        fence(state)
-        best_dt = min(best_dt, time.perf_counter() - t0)
-    dt = best_dt
+    dt = min(window(iters) for _ in range(3))
 
     img_per_sec = batch * fold * iters / dt
     img_per_sec_per_chip = img_per_sec / n_chips

@@ -80,11 +80,30 @@ def test_global_bn_matches_torch_full_batch():
     np.testing.assert_allclose(leaves[1], tb.running_var.numpy(), rtol=1e-5)
 
 
+def _bn_apply_warm(group_size, x, warm_mean, train=True):
+    """Apply BN with the running mean pre-set to ``warm_mean`` — the
+    steady-state the one-pass *shifted* variance (shift = running mean,
+    r4) is designed for. The shift only has to land within ~√(var/ε_fp32)
+    of the batch mean for the E[d²]−E[d]² identity to be exact to fp32."""
+    bn = BatchNorm(dtype=jnp.float32, group_size=group_size)
+    vs = bn.init(jax.random.key(0), x, train=False)
+    vs = jax.tree.map(lambda v: v, vs)  # unfreeze-safe shallow copy
+    vs["batch_stats"]["BatchNorm_0"]["mean"] = jnp.full(
+        (x.shape[-1],), warm_mean, jnp.float32
+    )
+    y, mut = bn.apply(vs, x, train=train, mutable=["batch_stats"])
+    return np.asarray(y), jax.tree.map(np.asarray, mut["batch_stats"])
+
+
 def test_bn_large_mean_numerics_match_torch():
-    """Large mean relative to spread: the E[x²]−E[x]² formulation cancels
-    catastrophically in fp32 (var ~1e-4 under mean ~1e3 drowns in the
-    ~0.1 absolute rounding of the 1e6-scale squares); the centered
-    two-pass variance matches torch's centered computation (ADVICE r2)."""
+    """Large mean relative to spread (mean ~1e3, spread ~1e-2): with a
+    running mean tracking the input scale — the steady state after any
+    training — the one-pass shifted variance (r4, var = E[d²]−E[d]² with
+    d = x − running_mean) is exact where E[x²]−E[x]² cancels
+    catastrophically (var ~1e-4 drowns in the ~0.1 absolute rounding of
+    1e6-scale squares; ADVICE r2). The shift need not be exact: anything
+    within ~√(var/ε_fp32) ≈ 4 of the true mean suffices; 1e3 vs the
+    batch's 1e3+O(1e-2) is far inside that."""
     torch = pytest.importorskip("torch")
     rng = np.random.default_rng(7)
     x = (1e3 + 1e-2 * rng.standard_normal((32, 2, 2, 4))).astype(np.float32)
@@ -95,20 +114,40 @@ def test_bn_large_mean_numerics_match_torch():
     # global (SyncBN) path: the running-var estimate is the direct probe
     # of the variance formulation (cancellation gives ≤0 or garbage); the
     # normalized output tolerates fp32 mean-accumulation rounding, which
-    # differs between jnp and torch at this scale
-    y, stats = _bn_apply(0, jnp.asarray(x))
+    # differs between jnp and torch at this scale. torch's own running
+    # mean starts at 0, so compare running var only (the momentum-mixed
+    # running mean trivially agrees: both are 0.1·batch_mean).
+    y, stats = _bn_apply_warm(0, jnp.asarray(x), 1e3)
     np.testing.assert_allclose(
         y, yt.transpose(0, 2, 3, 1), atol=0.1
     )
     np.testing.assert_allclose(
         jax.tree.leaves(stats)[1], tb.running_var.numpy(), rtol=0.02
     )
-    # ghost path: each group must still normalize to ~N(0,1) — the
-    # cancelling formulation gives a negative variance here (⇒ NaN)
-    yg, _ = _bn_apply(16, jnp.asarray(x))
+    # ghost path: each group must still normalize to ~N(0,1) — an
+    # unshifted cancelling formulation gives negative variance (⇒ NaN)
+    yg, _ = _bn_apply_warm(16, jnp.asarray(x), 1e3)
     assert np.isfinite(yg).all()
     assert abs(float(yg.mean())) < 1e-2
     assert abs(float(yg.std()) - 1.0) < 0.1
+
+
+def test_bn_large_mean_cold_start_stays_finite():
+    """The documented regime bound of the shifted one-pass variance: a
+    cold-start batch (running mean still 0) with |mean| ≫ spread rounds
+    like the uncentered form. The var ≥ 0 clamp guarantees the output is
+    finite (rsqrt never sees a negative), training can proceed, and the
+    running mean converges toward the scale — after which the previous
+    test's exactness applies."""
+    rng = np.random.default_rng(8)
+    x = (1e3 + 1e-2 * rng.standard_normal((32, 2, 2, 4))).astype(np.float32)
+    for gs in (0, 16):
+        y, stats = _bn_apply(gs, jnp.asarray(x))
+        assert np.isfinite(y).all()
+        # running mean moved toward the batch mean (momentum 0.9 ⇒ 0.1·1e3)
+        np.testing.assert_allclose(
+            jax.tree.leaves(stats)[0], 100.0, rtol=1e-3
+        )
 
 
 def test_group_stats_differ_from_global_on_sharded_batch():

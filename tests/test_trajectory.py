@@ -120,17 +120,25 @@ def test_trajectories_match_across_modes():
     dptp = _run_per_step(model_axis=2)
     for name, traj in (("folded", folded), ("accum", accum), ("dptp", dptp)):
         assert np.isfinite(traj).all(), (name, traj)
-        # exact-math window before chaotic growth. Recalibrated r3: the
-        # centered-variance BN (ADVICE fix) rounds x−mean elementwise,
-        # and the per-step ghost path (grouped reshape broadcast) rounds
-        # it differently from the accum micro-batch path (whole-batch
-        # mean) — measured drift now ~2e-7 step 0, ~2e-3 step 1, ~0.13
-        # step 2 for accum (was ≤7e-3 at step 2 with E[x²]−E[x]², whose
-        # elementwise x² was mode-identical). Steps 0-1 carry the
-        # exactness claim; the family assertion below covers the rest.
+        # exact-math window before chaotic growth. Measured r4 (shifted
+        # one-pass BN variance): drift ~2e-7 step 0, ~1.6e-3 step 1,
+        # ~0.13 step 2 for accum — essentially unchanged from r3's
+        # centered form, which revises r3's explanation: the step-2
+        # drift is NOT the variance formulation but the running stats
+        # themselves, which diverge across modes in exact math (accum
+        # mixes micro-batch stats sequentially, per-step averages group
+        # stats in one update) and seed mode-dependent rounding in the
+        # train path (via the shift; via x−mean rounding in r3). Steps
+        # 0-1 carry the fp32 exactness claim; the step-2 bound below
+        # catches genuine math regressions (ADVICE r3); the fp64 test in
+        # test_trajectory_x64.py pins an 8-step exact window where
+        # rounding vanishes; the family assertion covers the rest.
         np.testing.assert_allclose(
             traj[:2], base[:2], rtol=0, atol=2e-2, err_msg=name
         )
+        # step-2 drift is rounding-order amplification only (~0.13
+        # measured); a real math regression would blow far past this
+        assert abs(traj[2] - base[2]) < 0.5, (name, traj[2], base[2])
         # same convergence family: every mode learns the stream
         assert np.mean(traj[-4:]) < 0.6 * np.mean(traj[:3]), (name, traj)
     assert np.mean(base[-4:]) < 0.6 * np.mean(base[:3]), base
