@@ -143,12 +143,12 @@ class MoeMlp(nn.Module):
             )
         if self.axes_bound and _axis_is_bound(MODEL_AXIS):
             # inside an enclosing shard_map (a pipeline stage): mesh axes
-            # are already bound — run the expert-partials body INLINE
-            # (nested shard_map is illegal). x is this rank's token shard;
-            # params are full (replicated inside the stage shard_map) —
-            # slice this rank's experts and psum the partials over model.
-            # Exact math (partial strategy drops nothing); collapses to
-            # the dense loop + free psum at model-axis size 1.
+            # are already bound — run the strategy body INLINE (nested
+            # shard_map is illegal; the collectives compose fine on the
+            # bound axes). x is this rank's token shard; params are full
+            # (replicated inside the stage shard_map) — slice this rank's
+            # experts. Collapses to the dense loop + free collectives at
+            # model-axis size 1.
             n = jax.lax.axis_size(MODEL_AXIS)
             r = jax.lax.axis_index(MODEL_AXIS)
             if E % n:
@@ -165,9 +165,23 @@ class MoeMlp(nn.Module):
                     for k in ("w_in", "b_in", "w_out", "b_out")
                 },
             }
-            out = moe_ops._rank_partials(
-                local, x.reshape(B * S, d), MODEL_AXIS, self.top_k
-            ).reshape(B, S, d)
+            if self.impl == "dispatch":
+                # switch-style all_to_all routing on the bound axis
+                # (VERDICT r3 #3); dropped fraction rides the stage-aux
+                # channel (parallel/pp.pipelined stage_aux) to the trainer
+                out, dropped = moe_ops.dispatch_inline(
+                    local, x, axis=MODEL_AXIS, top_k=self.top_k,
+                    capacity_factor=self.capacity_factor,
+                )
+                self.sow(
+                    "moe_stats", "dropped", dropped,
+                    reduce_fn=lambda a, b: a + b, init_fn=lambda: 0.0,
+                )
+            else:
+                # expert-partials: exact math (drops nothing), one psum
+                out = moe_ops._rank_partials(
+                    local, x.reshape(B * S, d), MODEL_AXIS, self.top_k
+                ).reshape(B, S, d)
         elif (
             self.mesh is not None
             and self.mesh.shape.get(MODEL_AXIS, 1) > 1
@@ -196,8 +210,19 @@ class MoeMlp(nn.Module):
             # aux from the same router function on the same tokens/gate the
             # expert paths used (identical values up to reduction order)
             probs = moe_ops.gating_probs(x.reshape(B * S, d), params["gate"])
-            aux = moe_ops.load_balancing_loss_from_probs(probs, self.top_k)
-            self.sow("intermediates", "moe_aux", aux)
+            f, p = moe_ops.balance_stats(probs, self.top_k)
+            self.sow(
+                "intermediates", "moe_aux",
+                moe_ops.aux_from_balance_stats(f, p),
+            )
+            # the same (f, p) vectors, sown unreduced: means over disjoint
+            # token subsets AVERAGE exactly, so pipeline stages accumulate
+            # these per microbatch and the full-batch aux is reconstructed
+            # outside (PipelinedViT / parallel/pp.pipelined stage_aux).
+            # Dead (DCE'd) whenever the ``moe_balance`` collection is not
+            # mutable — i.e. always in flat mode, where the scalar above
+            # is used instead.
+            self.sow("moe_balance", "fp", jnp.stack([f, p]))
         return out
 
 
@@ -418,6 +443,8 @@ class ViTStage(nn.Module):
     moe_experts: int = 0  # PP×EP: MoE FFN in every moe_every-th block
     moe_top_k: int = 2
     moe_every: int = 2
+    moe_impl: str = "partial"
+    moe_capacity_factor: float = 2.0
 
     @nn.compact
     def __call__(self, x, train: bool = False):
@@ -436,6 +463,8 @@ class ViTStage(nn.Module):
                 self.dim, self.num_heads, self.mlp_ratio, self.dropout,
                 self.dtype, self.attn_impl, None,
                 moe_experts=moe, moe_top_k=self.moe_top_k,
+                moe_impl=self.moe_impl,
+                moe_capacity_factor=self.moe_capacity_factor,
                 moe_axes_bound=True,
             )(x, train=train)
         return x
@@ -456,16 +485,22 @@ class PipelinedViT(_ViTCommon):
     as the correctness oracle in tests: GPipe is math-preserving, so both
     paths agree.
 
-    PP×EP (``moe_experts > 0``): MoE blocks inside stages run the exact
-    expert-partials strategy INLINE on the already-bound ``model`` axis
-    (models/vit.MoeMlp ``axes_bound`` — a nested shard_map would be
-    illegal). Expert placement must be uniform per stage:
-    ``depth/pipe_stages`` divisible by ``moe_every`` (then it coincides
-    with the flat model's placement and the checkpoint converters keep
-    working). Two caveats vs flat EP: the switch ``dispatch`` strategy is
-    not available under PP, and the load-balancing aux is not collected
-    (stage apply carries no mutable collections) — harmless for the
-    partial strategy, which is exact regardless of balance.
+    PP×EP (``moe_experts > 0``): MoE blocks inside stages run their
+    strategy INLINE on the already-bound ``model`` axis (models/vit.MoeMlp
+    ``axes_bound`` — a nested shard_map would be illegal; the partial
+    psum and the dispatch all_to_alls compose fine on bound axes). Expert
+    placement must be uniform per stage: ``depth/pipe_stages`` divisible
+    by ``moe_every`` (then it coincides with the flat model's placement
+    and the checkpoint converters keep working). The load-balancing aux
+    IS collected under PP (r4): MoE blocks sow their (f, p) balance
+    vectors, the pipeline accumulates them per microbatch through the
+    scan carry (``pp.pipelined`` ``stage_aux``), and ``_sow_moe_aux``
+    reconstructs the full-batch aux exactly (the vectors are token means,
+    so equal-size subsets average exactly — ops/moe.balance_stats); the
+    dispatch strategy's dropped fraction rides the same channel. One
+    caveat vs flat EP: stage params enter the stage shard_map replicated
+    over ``model``, so per-device parameter memory is O(E), not O(E/n)
+    (compute and activations are still parallel; ADVICE r3 #1).
     """
 
     num_classes: int = 1000
@@ -480,9 +515,11 @@ class PipelinedViT(_ViTCommon):
     mesh: Any = None
     pipe_stages: int = 2
     pipe_microbatches: int = 0  # 0 → 2 × pipe_stages
-    moe_experts: int = 0  # PP×EP (partial strategy; see _stage_module)
+    moe_experts: int = 0  # PP×EP (see _stage_module)
     moe_top_k: int = 2
     moe_every: int = 2
+    moe_impl: str = "partial"
+    moe_capacity_factor: float = 2.0
 
     def _stage_module(self):
         if self.depth % self.pipe_stages:
@@ -527,8 +564,43 @@ class PipelinedViT(_ViTCommon):
             self.depth // self.pipe_stages,
             attn_impl=self.attn_impl,
             moe_experts=self.moe_experts, moe_top_k=self.moe_top_k,
-            moe_every=self.moe_every,
+            moe_every=self.moe_every, moe_impl=self.moe_impl,
+            moe_capacity_factor=self.moe_capacity_factor,
         )
+
+    def _sow_moe_aux(self, aux):
+        """Reconstruct full-batch MoE statistics from per-stage collections
+        (each leaf [S, ...]: stage dim from ``pp.pipelined``'s gather or the
+        sequential fallback's stack) and sow them where the trainer looks:
+
+        - ``intermediates/moe_aux``: ONE scalar — the mean over all MoE
+          blocks of the switch aux computed from the ACCUMULATED (f, p)
+          vectors. Exactly the flat model's ``mean(per-block aux)`` (up to
+          reduction order): f/p are token means, so per-microbatch values
+          average to the full-batch value before the bilinear E·Σf·p.
+        - ``moe_stats/dropped``: the blocks' mean dropped fraction
+          (dispatch strategy only; microbatch fractions average exactly —
+          every microbatch has the same assignment total).
+        """
+        from distribuuuu_tpu.ops import moe as moe_ops
+
+        bal = jax.tree.leaves(aux.get("moe_balance", {}))  # [S, 2, E] each
+        if bal:
+            per_block = [
+                jax.vmap(
+                    lambda fp: moe_ops.aux_from_balance_stats(fp[0], fp[1])
+                )(fp)  # [S]
+                for fp in bal
+            ]
+            self.sow(
+                "intermediates", "moe_aux", jnp.stack(per_block).mean()
+            )
+        drp = jax.tree.leaves(aux.get("moe_stats", {}))  # [S] each
+        if drp:
+            self.sow(
+                "moe_stats", "dropped", jnp.stack(drp).mean(),
+                reduce_fn=lambda a, b: a + b, init_fn=lambda: 0.0,
+            )
 
     @nn.compact
     def __call__(self, x, train: bool = False):
@@ -565,8 +637,17 @@ class PipelinedViT(_ViTCommon):
         stages = self.param("stages", init_stages)
         B = x.shape[0]
 
+        # collect MoE statistics (balance aux + dispatch drop fraction)
+        # through the stage-aux channel whenever they exist
+        collect = train and self.moe_experts > 0
+
         def stage_fn(p, a):
-            return stage_mod.apply({"params": p}, a, train=train)
+            if not collect:
+                return stage_mod.apply({"params": p}, a, train=train)
+            return stage_mod.apply(
+                {"params": p}, a, train=train,
+                mutable=["moe_balance", "moe_stats"],
+            )
 
         mesh = self.mesh
         pipe_on_mesh = mesh is not None and mesh.shape.get("pipe", 1) == S
@@ -579,14 +660,32 @@ class PipelinedViT(_ViTCommon):
                     f"per data shard (need a multiple of {need}; "
                     "MESH.MICROBATCH × data axis)"
                 )
-            x = pp.pipelined(
-                stage_fn, mesh=mesh, num_microbatches=M
-            )(stages, x)
+            piped = pp.pipelined(
+                stage_fn, mesh=mesh, num_microbatches=M, stage_aux=collect
+            )
+            if collect:
+                x, aux = piped(stages, x)
+                self._sow_moe_aux(aux)
+            else:
+                x = piped(stages, x)
         else:
             # sequential fallback: same params, same math (used for the
             # tiny init-time dummy batch and on meshes without a pipe axis)
+            muts = []
             for s in range(S):
-                x = stage_fn(jax.tree.map(lambda a: a[s], stages), x)
+                out = stage_fn(jax.tree.map(lambda a: a[s], stages), x)
+                if collect:
+                    x, mut = out
+                    muts.append(mut)
+                else:
+                    x = out
+            if collect:
+                # stack per-stage collections into the same [S, ...] layout
+                # the pipelined path gathers (stats here are full-batch per
+                # stage — no microbatching — so the combiner is exact too)
+                self._sow_moe_aux(
+                    jax.tree.map(lambda *xs: jnp.stack(xs), *muts)
+                )
         return self._head(x)
 
 
@@ -678,14 +777,6 @@ def _vit(num_classes, kw, **defaults):
     pipe = kw.pop("pipe_stages", 0)
     if pipe and pipe > 1:
         kw.setdefault("pipe_microbatches", 0)
-        if kw.get("moe_experts") and kw.get("moe_impl", "partial") != "partial":
-            raise ValueError(
-                "PP×MoE runs the exact partial strategy only (the switch "
-                "dispatch path needs its own shard_map); set "
-                "MODEL.MOE.IMPL partial with MESH.PIPE>1"
-            )
-        kw.pop("moe_impl", None)
-        kw.pop("moe_capacity_factor", None)
         return PipelinedViT(num_classes=num_classes, pipe_stages=pipe, **kw)
     kw.pop("pipe_microbatches", None)
     return ViT(num_classes=num_classes, **kw)

@@ -56,19 +56,28 @@ def pipeline_apply(
     microbatches: jax.Array,
     *,
     axis: str = "pipe",
+    stage_aux: bool = False,
 ):
     """Run the GPipe schedule. Call INSIDE shard_map/jit with ``axis`` bound.
 
     Args:
       stage_fn: ``(params_for_one_stage, x) -> y`` with ``y.shape == x.shape``
-        (uniform activation contract; see module docstring).
+        (uniform activation contract; see module docstring). With
+        ``stage_aux=True``: ``(params, x) -> (y, aux)`` where ``aux`` is a
+        small pytree of per-application statistics (fixed structure/shapes).
       stacked_params: per-device slice of the stacked stage params — inside
         shard_map each device sees leading dim 1: its own stage's params.
       microbatches: ``[M, mb, ...]`` input microbatches (replicated over the
         pipe axis; only stage 0 reads them).
     Returns:
       ``[M, mb, ...]`` outputs of the LAST stage, valid on every device
-      (broadcast via psum so the loss can be computed anywhere).
+      (broadcast via psum so the loss can be computed anywhere). With
+      ``stage_aux=True``: ``(outputs, aux_mean)`` where ``aux_mean`` is THIS
+      device's stage aux averaged over its M valid applications — fill/drain
+      ticks, whose stage inputs are schedule garbage, are masked out of the
+      accumulation (VERDICT r3 #2: the MoE balancing stats ride this
+      channel; gradients flow through the scan carry, so an aux-derived
+      loss term trains correctly through the pipeline).
     """
     S = jax.lax.axis_size(axis)
     s = jax.lax.axis_index(axis)
@@ -79,8 +88,20 @@ def pipeline_apply(
 
     perm = [(i, (i + 1) % S) for i in range(S)]  # stage i → i+1 ring
 
+    if stage_aux:
+        aux_shapes = jax.eval_shape(
+            lambda p, x: stage_fn(p, x)[1],
+            my_params, jax.ShapeDtypeStruct(mb_shape, microbatches.dtype),
+        )
+        aux_zero = jax.tree.map(
+            lambda a: jnp.zeros(a.shape, a.dtype), aux_shapes
+        )
+
     def tick(carry, t):
-        incoming, outputs = carry
+        if stage_aux:
+            incoming, outputs, aux_acc = carry
+        else:
+            incoming, outputs = carry
         # stage 0 consumes microbatch t (clamped into range during drain);
         # other stages consume what arrived from the previous stage
         mb_idx = jnp.clip(t, 0, M - 1)
@@ -88,7 +109,18 @@ def pipeline_apply(
             microbatches, mb_idx, axis=0, keepdims=False
         )
         x = jnp.where(s == 0, x0, incoming)
-        y = stage_fn(my_params, x)
+        if stage_aux:
+            y, aux = stage_fn(my_params, x)
+            # stage s processes microbatch t−s at tick t; anything else
+            # (fill for s>t, drain re-runs on clamped inputs) is schedule
+            # garbage and must not pollute the statistics
+            aux_valid = jnp.logical_and(t >= s, t - s < M)
+            aux_acc = jax.tree.map(
+                lambda acc, a: acc + jnp.where(aux_valid, a, 0).astype(acc.dtype),
+                aux_acc, aux,
+            )
+        else:
+            y = stage_fn(my_params, x)
         # the last stage finished microbatch t-(S-1) at this tick
         out_idx = t - (S - 1)
         valid = jnp.logical_and(s == S - 1, out_idx >= 0)
@@ -103,18 +135,27 @@ def pipeline_apply(
         # hop to the next stage (the wrap S-1 → 0 carries garbage that stage
         # 0 never reads — it always selects the microbatch path)
         incoming = jax.lax.ppermute(y, axis, perm)
+        if stage_aux:
+            return (incoming, outputs, aux_acc), None
         return (incoming, outputs), None
 
     init = (
         jnp.zeros(mb_shape, microbatches.dtype),
         jnp.zeros((M,) + mb_shape, microbatches.dtype),
     )
-    (_, outputs), _ = jax.lax.scan(tick, init, jnp.arange(T))
+    if stage_aux:
+        init = init + (aux_zero,)
+        (_, outputs, aux_acc), _ = jax.lax.scan(tick, init, jnp.arange(T))
+    else:
+        (_, outputs), _ = jax.lax.scan(tick, init, jnp.arange(T))
 
     # broadcast last-stage outputs to every pipe rank so downstream loss /
     # metrics code is position-independent
     outputs = jnp.where(s == S - 1, outputs, jnp.zeros_like(outputs))
-    return jax.lax.psum(outputs, axis)
+    outputs = jax.lax.psum(outputs, axis)
+    if stage_aux:
+        return outputs, jax.tree.map(lambda a: a / M, aux_acc)
+    return outputs
 
 
 def pipelined(
@@ -124,6 +165,7 @@ def pipelined(
     num_microbatches: int,
     axis: str = "pipe",
     data_axis: str | None = "data",
+    stage_aux: bool = False,
 ):
     """Wrap ``stage_fn`` into ``fn(stacked_params, batch) -> outputs`` that
     runs the pipeline over ``mesh`` under jit (shard_map inside).
@@ -131,15 +173,39 @@ def pipelined(
     ``batch`` is ``[B, ...]`` (global); it is split into ``num_microbatches``
     equal microbatches. When ``data_axis`` is present in the mesh the batch
     dim is additionally sharded over it (PP × DP composition).
+
+    ``stage_aux=True``: ``stage_fn`` returns ``(y, aux)`` and the wrapped
+    function returns ``(outputs, aux_stacked)`` where each ``aux`` leaf
+    gains a leading stage dim ``[S, ...]`` and holds that stage's statistic
+    averaged over ALL the microbatches it processed — pmean'd over the data
+    axis, so token-mean statistics equal the flat (non-pipelined) model's
+    full-batch values exactly (see ops/moe.balance_stats). Replicated on
+    every device.
     """
     S = mesh.shape[axis]
     M = num_microbatches
 
+    data_sharded = bool(data_axis) and mesh.shape.get(data_axis, 1) > 1
+
     def per_device(stacked_params, batch):
         mb = batch.reshape((M, batch.shape[0] // M) + batch.shape[1:])
-        return pipeline_apply(stage_fn, stacked_params, mb, axis=axis)
+        if not stage_aux:
+            return pipeline_apply(stage_fn, stacked_params, mb, axis=axis)
+        out, aux = pipeline_apply(
+            stage_fn, stacked_params, mb, axis=axis, stage_aux=True
+        )
+        if data_sharded:
+            # each data shard accumulated stats over its own tokens; the
+            # microbatch/shard token counts are equal, so the pmean IS the
+            # full-batch token mean
+            aux = jax.tree.map(
+                lambda a: jax.lax.pmean(a, data_axis), aux
+            )
+        # stage s holds only its own stats — gather the stage dim so every
+        # device returns the full [S, ...] (replicated ⇒ out_spec P())
+        aux = jax.tree.map(lambda a: jax.lax.all_gather(a, axis), aux)
+        return out, aux
 
-    data_sharded = bool(data_axis) and mesh.shape.get(data_axis, 1) > 1
     batch_spec = P(data_axis) if data_sharded else P()
     # per-device output is [M, mb, ...]: microbatch index replicated, the
     # per-microbatch batch dim sharded over data (when present)
@@ -149,11 +215,12 @@ def pipelined(
         per_device,
         mesh=mesh,
         in_specs=(P(axis), batch_spec),
-        out_specs=out_spec,
+        out_specs=(out_spec, P()) if stage_aux else out_spec,
     )
 
     def apply(stacked_params, batch):
-        out = fn(stacked_params, batch)  # [M, mb_global, ...]
+        res = fn(stacked_params, batch)
+        out = res[0] if stage_aux else res  # [M, mb_global, ...]
         if data_sharded:
             # each data shard microbatched its OWN contiguous slice of the
             # batch, so the gathered dim 1 is [dp × mb]; restore the original
@@ -161,7 +228,8 @@ def pipelined(
             dp = mesh.shape[data_axis]
             out = out.reshape((M, dp, -1) + out.shape[2:])
             out = jnp.moveaxis(out, 1, 0)
-        return out.reshape((-1,) + out.shape[out.ndim - (batch.ndim - 1):])
+        out = out.reshape((-1,) + out.shape[out.ndim - (batch.ndim - 1):])
+        return (out, res[1]) if stage_aux else out
 
     apply.num_stages = S
     apply.num_microbatches = M

@@ -71,25 +71,10 @@ def check_trainer_mesh():
                 "shrinking stage pyramid does not — use MESH.DATA/MODEL "
                 "for those archs"
             )
-        if cfg.MODEL.ARCH.endswith("_moe"):
-            if cfg.MODEL.MOE.IMPL != "partial":
-                raise ValueError(
-                    "MESH.PIPE>1 composes with MoE via the exact partial "
-                    "strategy only (the dispatch path needs its own "
-                    "shard_map); set MODEL.MOE.IMPL partial"
-                )
-            if cfg.MODEL.MOE.AUX_WEIGHT:
-                import warnings
-
-                warnings.warn(
-                    "PP×MoE: the load-balancing aux is NOT collected "
-                    "inside pipeline stages (stage apply carries no "
-                    "mutable collections) — MODEL.MOE.AUX_WEIGHT "
-                    f"{cfg.MODEL.MOE.AUX_WEIGHT} will contribute nothing. "
-                    "Harmless for the exact partial strategy; set it to 0 "
-                    "to silence this warning.",
-                    stacklevel=2,
-                )
+        # PP×MoE (r4): both strategies run inline on the bound axes inside
+        # stages, and the balancing aux + dispatch drop fraction are
+        # collected through the pipeline's stage-aux channel — no special
+        # casing needed here (models/vit.PipelinedViT, parallel/pp.py)
         if cfg.MESH.SEQ not in (0, 1, -1):
             raise ValueError(
                 f"MESH.PIPE={cfg.MESH.PIPE} with MESH.SEQ={cfg.MESH.SEQ}: "

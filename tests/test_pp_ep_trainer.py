@@ -240,15 +240,9 @@ def test_pipe_refusals():
     _tiny_vit_cfg(pipe=4, arch="resnet18")
     with pytest.raises(ValueError, match="uniform-stage"):
         trainer.check_trainer_mesh()
-    # PP×MoE is partial-strategy only (r3) — dispatch still refused
-    _tiny_vit_cfg(pipe=4, arch="vit_tiny_moe")
-    cfg.MODEL.MOE.IMPL = "dispatch"
-    with pytest.raises(ValueError, match="partial"):
-        trainer.check_trainer_mesh()
     # uneven expert placement across stages refused at model build:
     # depth 12 / pipe 4 = 3 blocks per stage, not divisible by EVERY 2
     _tiny_vit_cfg(pipe=4, arch="vit_tiny_moe")
-    cfg.MODEL.MOE.IMPL = "partial"  # _tiny_vit_cfg doesn't reset MOE keys
     trainer.check_trainer_mesh()
     with pytest.raises(ValueError, match="blocks-per-stage"):
         trainer.build_model_from_cfg()._stage_module()
@@ -260,9 +254,7 @@ def test_vit_tiny_moe_trains_with_pipeline():
     on the bound model axis inside the pipeline's shard_map."""
     _tiny_vit_cfg(pipe=2, model_axis=2, arch="vit_tiny_moe")
     cfg.MESH.MICROBATCH = 2
-    # the balancing aux is not collected under PP — loudly said up front
-    with pytest.warns(UserWarning, match="aux"):
-        trainer.check_trainer_mesh()
+    trainer.check_trainer_mesh()
     state, metrics, model, mesh, _ = _one_step()
     assert type(model).__name__ == "PipelinedViT"
     assert dict(mesh.shape) == {"data": 2, "model": 2, "seq": 1, "pipe": 2}
@@ -306,6 +298,113 @@ def test_pipelined_moe_matches_flat_reference():
         else:
             params[name] = jax.tree.map(np.asarray, sub)
     dlogits = dense.apply({"params": params}, x, train=False)
+    np.testing.assert_allclose(
+        np.asarray(plogits), np.asarray(dlogits), atol=2e-4
+    )
+
+
+def _scatter_stages_to_flat(pstate_params, depth, pipe):
+    """Stacked stage params → flat ViT Block_i params (host arrays)."""
+    k = depth // pipe
+    params = {}
+    for name, sub in pstate_params.items():
+        if name == "stages":
+            for s in range(pipe):
+                for j in range(k):
+                    params[f"Block_{s * k + j}"] = jax.tree.map(
+                        lambda a: np.asarray(a[s]), sub[f"Block_{j}"]
+                    )
+        else:
+            params[name] = jax.tree.map(np.asarray, sub)
+    return params
+
+
+def test_pp_moe_aux_matches_flat_reference():
+    """VERDICT r3 #2: the balancing aux collected through the pipeline's
+    stage-aux channel (per-microbatch (f, p) accumulation → full-batch
+    reconstruction) equals the flat model's full-batch aux to float
+    tolerance — not just 'some aux exists'."""
+    _tiny_vit_cfg(pipe=2, model_axis=2, arch="vit_tiny_moe")
+    cfg.MESH.MICROBATCH = 2
+    mesh = mesh_lib.mesh_from_cfg(cfg)
+    pmodel = trainer.build_model_from_cfg()
+    pstate = trainer.create_train_state(pmodel, jax.random.key(0), mesh, 32)
+
+    rng = np.random.default_rng(11)
+    x = jnp.asarray(rng.standard_normal((8, 32, 32, 3)), jnp.float32)
+    _, pmut = jax.jit(
+        lambda p, a: pmodel.apply(
+            {"params": p}, a, train=True, mutable=["intermediates"]
+        )
+    )(pstate.params, x)
+    paux = jax.tree.leaves(pmut["intermediates"])
+    assert len(paux) == 1  # ONE scalar: the mean over all MoE blocks
+
+    dense = models.build_model(
+        "vit_tiny_moe", num_classes=10, dtype=jnp.float32
+    )
+    params = _scatter_stages_to_flat(pstate.params, dense.depth, 2)
+    _, dmut = dense.apply(
+        {"params": params}, x, train=True, mutable=["intermediates"]
+    )
+    daux = jax.tree.leaves(dmut["intermediates"])
+    assert len(daux) == dense.depth // dense.moe_every  # one per MoE block
+    np.testing.assert_allclose(
+        float(paux[0]), float(np.mean([float(a) for a in daux])), rtol=1e-5
+    )
+
+
+def test_pp_moe_aux_weight_reaches_the_loss():
+    """MODEL.MOE.AUX_WEIGHT moves the PIPELINED loss (r4 — it contributed
+    nothing under PP in r3)."""
+    losses = {}
+    for w in (0.0, 10.0):
+        _tiny_vit_cfg(pipe=2, model_axis=2, arch="vit_tiny_moe")
+        cfg.MESH.MICROBATCH = 2
+        cfg.MODEL.MOE.AUX_WEIGHT = w
+        _, metrics, model, *_ = _one_step(seed=0)
+        assert type(model).__name__ == "PipelinedViT"
+        losses[w] = metrics["loss"]
+    assert losses[10.0] > losses[0.0]  # aux ≥ 1 by construction
+
+
+def test_vit_tiny_moe_trains_with_pipeline_dispatch():
+    """PP×EP-dispatch (VERDICT r3 #3): the switch all_to_all strategy runs
+    inline inside pipeline stages on the bound model axis; the dropped
+    fraction rides the stage-aux channel to the ``moe_dropped`` metric."""
+    _tiny_vit_cfg(pipe=2, model_axis=2, arch="vit_tiny_moe")
+    cfg.MESH.MICROBATCH = 2
+    cfg.MODEL.MOE.IMPL = "dispatch"
+    cfg.MODEL.MOE.CAPACITY_FACTOR = float(cfg.MODEL.MOE.NUM_EXPERTS)
+    trainer.check_trainer_mesh()
+    state, metrics, model, mesh, _ = _one_step()
+    assert type(model).__name__ == "PipelinedViT"
+    assert model.moe_impl == "dispatch"
+    assert np.isfinite(metrics["loss"])
+    assert metrics["moe_dropped"] == 0.0  # ample capacity drops nothing
+
+
+def test_pp_dispatch_logits_match_pp_partial():
+    """Ample capacity: the PP-dispatch model's logits equal the PP-partial
+    (exact) model's on the same stacked params."""
+    rng = np.random.default_rng(13)
+    x = jnp.asarray(rng.standard_normal((8, 32, 32, 3)), jnp.float32)
+
+    _tiny_vit_cfg(pipe=2, model_axis=2, arch="vit_tiny_moe")
+    cfg.MESH.MICROBATCH = 2
+    mesh = mesh_lib.mesh_from_cfg(cfg)
+    pmodel = trainer.build_model_from_cfg()  # partial (default)
+    pstate = trainer.create_train_state(pmodel, jax.random.key(0), mesh, 32)
+    plogits = jax.jit(
+        lambda p, a: pmodel.apply({"params": p}, a, train=False)
+    )(pstate.params, x)
+
+    cfg.MODEL.MOE.IMPL = "dispatch"
+    cfg.MODEL.MOE.CAPACITY_FACTOR = float(cfg.MODEL.MOE.NUM_EXPERTS)
+    dmodel = trainer.build_model_from_cfg()
+    dlogits = jax.jit(
+        lambda p, a: dmodel.apply({"params": p}, a, train=False)
+    )(pstate.params, x)
     np.testing.assert_allclose(
         np.asarray(plogits), np.asarray(dlogits), atol=2e-4
     )
