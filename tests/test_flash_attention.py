@@ -76,10 +76,117 @@ def test_cpu_fallback_is_blockwise():
     np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=2e-5)
 
 
-def test_causal_refused():
-    q = jnp.zeros((1, 1, 128, 32), jnp.float32)
-    with pytest.raises(NotImplementedError):
-        fa.flash_attention(q, q, q, causal=True)
+@pytest.mark.parametrize(
+    "B,H,L,D",
+    [
+        (2, 2, 512, 64),   # block multiple: exercises the block-skip bounds
+        (1, 2, 300, 32),   # padded L: causal ∧ pad masks compose
+    ],
+)
+def test_causal_forward_matches_reference(B, H, L, D):
+    """Causal in-kernel (r4): fully-masked K blocks are skipped by loop
+    bound, diagonal blocks masked elementwise — must equal dense causal."""
+    rng = np.random.default_rng(4)
+    q, k, v = (
+        jnp.asarray(rng.standard_normal((B, H, L, D)), jnp.float32)
+        for _ in range(3)
+    )
+    out = fa.flash_attention(q, k, v, causal=True, interpret=True, **BLK)
+    ref = reference_attention(q, k, v, causal=True)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=2e-5)
+
+
+@pytest.mark.parametrize("L", [512, 300])
+def test_causal_gradients_match_reference(L):
+    """All three causal backward paths (dq block-skip, dk/dv start-offset,
+    diagonal masks) against the dense causal reference."""
+    rng = np.random.default_rng(5)
+    q, k, v = (
+        jnp.asarray(rng.standard_normal((1, 2, L, 64)), jnp.float32)
+        for _ in range(3)
+    )
+    w = jnp.asarray(rng.standard_normal((64,)), jnp.float32)
+
+    def loss(fn):
+        return lambda q, k, v: jnp.sum(fn(q, k, v) * w)
+
+    gf = jax.grad(
+        loss(lambda q, k, v: fa.flash_attention(
+            q, k, v, causal=True, interpret=True, **BLK
+        )),
+        argnums=(0, 1, 2),
+    )(q, k, v)
+    gr = jax.grad(
+        loss(lambda q, k, v: reference_attention(q, k, v, causal=True)),
+        argnums=(0, 1, 2),
+    )(q, k, v)
+    for a, b, name in zip(gf, gr, ("dq", "dk", "dv")):
+        np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), atol=5e-5, err_msg=name
+        )
+
+
+def test_causal_matches_blockwise_scan():
+    """The causal kernel against the scan path it previously fell back to
+    (the VERDICT r3 #4 'exactness test vs the causal blockwise path')."""
+    from distribuuuu_tpu.ops.ring_attention import blockwise_attention
+
+    rng = np.random.default_rng(6)
+    q, k, v = (
+        jnp.asarray(rng.standard_normal((1, 3, 384, 64)), jnp.float32)
+        for _ in range(3)
+    )
+    out = fa.flash_attention(q, k, v, causal=True, interpret=True, **BLK)
+    ref = blockwise_attention(q, k, v, causal=True)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=2e-5)
+
+
+def test_with_lse_matches_and_differentiates():
+    """flash_attention_with_lse: the lse output equals the dense
+    log-sum-exp, and a loss that consumes BOTH outputs gets exact
+    gradients (the lse cotangent folds into the kernels' delta — the
+    property ring attention's flash block updates rely on)."""
+    rng = np.random.default_rng(7)
+    B, H, L, D = 1, 2, 256, 32
+    q, k, v = (
+        jnp.asarray(rng.standard_normal((B, H, L, D)), jnp.float32)
+        for _ in range(3)
+    )
+    scale = D ** -0.5
+
+    o, lse = fa.flash_attention_with_lse(q, k, v, interpret=True, **BLK)
+    s = jnp.einsum(
+        "bhqd,bhkd->bhqk", q, k, preferred_element_type=jnp.float32
+    ) * scale
+    np.testing.assert_allclose(
+        np.asarray(lse), np.asarray(jax.nn.logsumexp(s, axis=-1)),
+        atol=2e-5,
+    )
+    np.testing.assert_allclose(
+        np.asarray(o), np.asarray(reference_attention(q, k, v)), atol=2e-5
+    )
+
+    wo = jnp.asarray(rng.standard_normal((D,)), jnp.float32)
+
+    def loss_flash(q, k, v):
+        o, lse = fa.flash_attention_with_lse(
+            q, k, v, interpret=True, **BLK
+        )
+        return jnp.sum(o * wo) + jnp.sum(jnp.sin(lse))
+
+    def loss_ref(q, k, v):
+        s = jnp.einsum(
+            "bhqd,bhkd->bhqk", q, k, preferred_element_type=jnp.float32
+        ) * scale
+        o = jnp.einsum("bhqk,bhkd->bhqd", jax.nn.softmax(s, -1), v)
+        return jnp.sum(o * wo) + jnp.sum(jnp.sin(jax.nn.logsumexp(s, -1)))
+
+    gf = jax.grad(loss_flash, argnums=(0, 1, 2))(q, k, v)
+    gr = jax.grad(loss_ref, argnums=(0, 1, 2))(q, k, v)
+    for a, b, name in zip(gf, gr, ("dq", "dk", "dv")):
+        np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), atol=5e-5, err_msg=name
+        )
 
 
 def test_auto_resolution_threshold():

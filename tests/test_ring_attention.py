@@ -81,3 +81,38 @@ def test_ulysses_rejects_indivisible_heads(mesh):
     q, k, v = _qkv(h=2)  # 2 heads, seq axis 4
     with pytest.raises(Exception):
         ra.ulysses_attention(q, k, v, mesh)
+
+
+@pytest.mark.parametrize("causal", [False, True])
+def test_ring_flash_impl_matches_reference(mesh, causal):
+    """r4 (VERDICT r3 #4): ring block updates through the Pallas flash
+    kernel (interpreter on this CPU mesh) — the (o, lse) state merge plus
+    the causal cond-skip of fully-masked source blocks must reproduce the
+    exact reference, like the einsum path does."""
+    q, k, v = _qkv(s=32, d=16, seed=3)
+    want = ra.reference_attention(q, k, v, causal=causal)
+    got = ra.ring_attention(q, k, v, mesh, causal=causal, impl="flash")
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               rtol=1e-5, atol=1e-5)
+
+
+@pytest.mark.slow  # pallas-interpreter backward on an 8-device CPU mesh
+def test_ring_flash_gradients_match(mesh):
+    """Gradients through the flash-state ring: custom-vjp blocks, the lse
+    cotangent (corr_b depends on lse_b), and the cond-skip all compose."""
+    q, k, v = _qkv(s=16, seed=4)
+
+    def loss_ref(q, k, v):
+        return (ra.reference_attention(q, k, v, causal=True) ** 2).sum()
+
+    def loss_ring(q, k, v):
+        return (
+            ra.ring_attention(q, k, v, mesh, causal=True, impl="flash") ** 2
+        ).sum()
+
+    gr = jax.grad(loss_ref, argnums=(0, 1, 2))(q, k, v)
+    gf = jax.grad(loss_ring, argnums=(0, 1, 2))(q, k, v)
+    for a, b, name in zip(gf, gr, ("dq", "dk", "dv")):
+        np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), rtol=1e-4, atol=1e-4, err_msg=name
+        )

@@ -146,6 +146,9 @@ def main():
     ap.add_argument("--blk-k", type=int, default=None)
     ap.add_argument("--skip-dense", action="store_true",
                     help="skip the O(L²)-memory dense baseline")
+    ap.add_argument("--causal", action="store_true",
+                    help="benchmark the causal paths (r4 kernels with "
+                         "block-skip vs causal scan/dense)")
     args = ap.parse_args()
 
     import jax
@@ -163,19 +166,24 @@ def main():
         jnp.asarray(rng.standard_normal((B, H, L, D)), jnp.bfloat16)
         for _ in range(3)
     )
-    flops = 2 * 2 * B * H * L * L * D
+    # causal touches only the lower triangle — half the score/PV work
+    flops = 2 * 2 * B * H * L * L * D * (0.5 if args.causal else 1.0)
 
-    fkw = {}
+    fkw = {"causal": args.causal}
     if args.blk_q:
         fkw["blk_q"] = args.blk_q
     if args.blk_k:
         fkw["blk_k"] = args.blk_k
     paths = {
         "flash": lambda q, k, v: fa.flash_attention(q, k, v, **fkw),
-        "scan": lambda q, k, v: ra.blockwise_attention(q, k, v),
+        "scan": lambda q, k, v: ra.blockwise_attention(
+            q, k, v, causal=args.causal
+        ),
     }
     if not args.skip_dense:
-        paths["dense"] = lambda q, k, v: ra.reference_attention(q, k, v)
+        paths["dense"] = lambda q, k, v: ra.reference_attention(
+            q, k, v, causal=args.causal
+        )
 
     fwd_runners = {
         n: make_fwd_runner(fn, q, k, v, args.iters)
