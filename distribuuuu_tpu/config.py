@@ -336,8 +336,12 @@ _C.DATA.BACKEND = "auto"
 # Ship uint8 pixels and run (x/255 - mean)/std in-graph on device instead
 # of on the host: 4× fewer host→device bytes per batch (PCIe / tunnel)
 # and less host CPU, numerically equivalent (pixels are uint8 after
-# resampling either way — transforms.normalize_in_graph).
-_C.DATA.DEVICE_NORMALIZE = False
+# resampling either way — transforms.normalize_in_graph). Default ON
+# since r4 (VERDICT r3 #6): measured strictly better (2.7× faster fenced
+# H2D), eval metrics bit-identical on both decode backends
+# (tests/test_device_normalize.py); False restores the reference's
+# host-normalized float pipeline byte-for-byte.
+_C.DATA.DEVICE_NORMALIZE = True
 
 # ------------------------------- profiler ------------------------------------
 # jax.profiler trace capture (TensorBoard/XProf format). When enabled, the

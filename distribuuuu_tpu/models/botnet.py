@@ -24,6 +24,7 @@ from distribuuuu_tpu.models.layers import (
     Dense,
     conv_kernel_init_default,
     global_avg_pool,
+    head_dtype,
     max_pool_3x3_s2,
 )
 from distribuuuu_tpu.models.resnet import Bottleneck
@@ -183,7 +184,9 @@ class BoTNet50(nn.Module):
                 bn_group=self.bn_group,
             )(x, train=train)
         x = global_avg_pool(x)
-        return Dense(self.num_classes, dtype=jnp.float32)(x.astype(jnp.float32))
+        return Dense(self.num_classes, dtype=head_dtype(x.dtype))(
+            x.astype(head_dtype(x.dtype))
+        )
 
 
 def botnet50(num_classes: int = 1000, fmap_size=(14, 14), **kw):

@@ -22,8 +22,9 @@ from distribuuuu_tpu.models.layers import (
     BatchNorm,
     Dense,
     StemConv7x7,
-    global_avg_pool,
     conv_kernel_init,
+    global_avg_pool,
+    head_dtype,
     max_pool_3x3_s2,
 )
 
@@ -118,7 +119,9 @@ class DenseNet(nn.Module):
         x = BatchNorm(dtype=self.dtype, group_size=self.bn_group)(x, train=train)
         x = nn.relu(x)
         x = global_avg_pool(x)
-        return Dense(self.num_classes, dtype=jnp.float32)(x.astype(jnp.float32))
+        return Dense(self.num_classes, dtype=head_dtype(x.dtype))(
+            x.astype(head_dtype(x.dtype))
+        )
 
 
 def densenet121(num_classes=1000, **kw):

@@ -20,8 +20,9 @@ from distribuuuu_tpu.models.layers import (
     BatchNorm,
     Dense,
     SqueezeExcite,
-    global_avg_pool,
     conv_kernel_init,
+    global_avg_pool,
+    head_dtype,
 )
 
 # (expand_ratio, channels, repeats, stride, kernel)
@@ -115,7 +116,9 @@ class EfficientNet(nn.Module):
         x = nn.silu(x)
         x = global_avg_pool(x)
         x = nn.Dropout(self.dropout_rate, deterministic=not train)(x)
-        return Dense(self.num_classes, dtype=jnp.float32)(x.astype(jnp.float32))
+        return Dense(self.num_classes, dtype=head_dtype(x.dtype))(
+            x.astype(head_dtype(x.dtype))
+        )
 
 
 def efficientnet_b0(num_classes=1000, **kw):

@@ -44,9 +44,14 @@ dense_kernel_init = tp.column_init(torch_linear_init)
 
 
 def resolve_dtype(name: str):
-    return {"bfloat16": jnp.bfloat16, "float32": jnp.float32, "float16": jnp.float16}[
-        name
-    ]
+    # float64 needs jax_enable_x64 (CPU-mesh equivalence tests — the fp64
+    # trajectory suite; TPUs have no f64 units)
+    return {
+        "bfloat16": jnp.bfloat16,
+        "float32": jnp.float32,
+        "float16": jnp.float16,
+        "float64": jnp.float64,
+    }[name]
 
 
 class StemConv7x7(nn.Module):
@@ -247,9 +252,13 @@ class _BNCore(nn.Module):
         ra_var = self.variable(
             "batch_stats", "var", lambda: jnp.ones((feat,), jnp.float32)
         )
+        # stats compute in fp32 — promoted to fp64 only when the input is
+        # f64 (the x64 CPU equivalence tests, where reduction-order
+        # rounding must vanish); bf16/f32 production inputs stay fp32
+        stats_dtype = jnp.promote_types(jnp.float32, x.dtype)
         if not train:
             inv = jax.lax.rsqrt(ra_var.value + self.epsilon) * scale
-            y = (x.astype(jnp.float32) - ra_mean.value) * inv + bias
+            y = (x.astype(stats_dtype) - ra_mean.value) * inv + bias
             return y.astype(self.dtype)
 
         n = x.shape[0]
@@ -284,7 +293,7 @@ class _BNCore(nn.Module):
         mode = os.environ.get("DISTRIBUUUU_BN_VARIANCE", "shifted")
         if mode not in ("shifted", "centered", "uncentered"):
             raise ValueError(f"DISTRIBUUUU_BN_VARIANCE={mode!r}")
-        xf = x.astype(jnp.float32)
+        xf = x.astype(stats_dtype)
 
         def moments(v, axes, bshape):
             """(mean, biased var) over ``axes``; bshape re-broadcasts."""
@@ -331,8 +340,15 @@ class _BNCore(nn.Module):
             mean_upd, var_upd = mean, var * count / max(count - 1, 1)
         if not self.is_initializing():
             m = self.momentum
-            ra_mean.value = m * ra_mean.value + (1.0 - m) * mean_upd
-            ra_var.value = m * ra_var.value + (1.0 - m) * var_upd
+            # cast back to the stored (fp32) dtype: under promoted-f64
+            # stats the update expression is f64 and must not change the
+            # batch_stats tree's dtype between steps
+            ra_mean.value = (
+                m * ra_mean.value + (1.0 - m) * mean_upd
+            ).astype(ra_mean.value.dtype)
+            ra_var.value = (
+                m * ra_var.value + (1.0 - m) * var_upd
+            ).astype(ra_var.value.dtype)
         return y.astype(self.dtype)
 
 
@@ -399,6 +415,15 @@ class Dense(nn.Module):
             param_dtype=jnp.float32,
             kernel_init=dense_kernel_init,
         )(x)
+
+
+def head_dtype(dtype):
+    """Classifier-head / loss compute dtype: fp32 regardless of a
+    low-precision compute dtype (bf16/f16 softmax is unstable), PROMOTED
+    to fp64 when the activations already are — a hard ``jnp.float32``
+    here would silently re-round f64 runs (the x64 CPU equivalence
+    tests) at the loss boundary."""
+    return jnp.promote_types(jnp.float32, dtype)
 
 
 def global_avg_pool(x):

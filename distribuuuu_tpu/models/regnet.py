@@ -25,6 +25,7 @@ from distribuuuu_tpu.models.layers import (
     Dense,
     SqueezeExcite,
     global_avg_pool,
+    head_dtype,
 )
 
 
@@ -118,7 +119,9 @@ class RegNet(nn.Module):
                 )(x, train=train)
                 in_w = w
         x = global_avg_pool(x)
-        return Dense(self.num_classes, dtype=jnp.float32)(x.astype(jnp.float32))
+        return Dense(self.num_classes, dtype=head_dtype(x.dtype))(
+            x.astype(head_dtype(x.dtype))
+        )
 
 
 # ---------------------------------------------------------------------------

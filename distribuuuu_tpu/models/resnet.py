@@ -25,6 +25,7 @@ from distribuuuu_tpu.models.layers import (
     ConvBN,
     Dense,
     global_avg_pool,
+    head_dtype,
     max_pool_3x3_s2,
 )
 
@@ -151,7 +152,9 @@ class ResNet(nn.Module):
                 )(x, train=train)
                 in_features = feats * self.block.expansion
         x = global_avg_pool(x)
-        x = Dense(self.num_classes, dtype=jnp.float32)(x.astype(jnp.float32))
+        x = Dense(self.num_classes, dtype=head_dtype(x.dtype))(
+            x.astype(head_dtype(x.dtype))
+        )
         return x
 
 

@@ -45,13 +45,20 @@ class Mlp(nn.Module):
 def _axis_is_bound(name: str) -> bool:
     """True when ``name`` is a bound mesh axis in the current trace (i.e.
     we are inside a shard_map body). Trace-time check — resolves before
-    compilation, so both branches stay jit-compatible."""
+    compilation, so both branches stay jit-compatible.
+
+    Pinned JAX behavior (ADVICE r3 #3): ``jax.lax.axis_size`` raises
+    ``NameError`` for an unbound axis name as of jax 0.4-0.7. That
+    exception type is not a stable API, so any exception here is treated
+    as 'unbound' — the safe default: selecting the fallback path at worst
+    costs the inline optimization, while crashing at trace time would
+    take the whole PP-MoE step down with a future JAX."""
     import jax
 
     try:
         jax.lax.axis_size(name)
         return True
-    except NameError:
+    except Exception:
         return False
 
 
@@ -92,6 +99,12 @@ class MoeMlp(nn.Module):
     # (nested, illegal) shard_map. Outside any shard_map this flag is
     # inert — the dense reference path runs (init, sequential fallback).
     axes_bound: bool = False
+    # >0: the expert tensors this module RECEIVES hold only this many
+    # (this rank's) experts — the PP×EP sharded-entry layout, where the
+    # pipeline shard_map's in_specs split the expert dim over ``model``
+    # (ADVICE r3 #1: O(E/n) per-device param memory, not O(E)). The gate
+    # and the routing space stay global (num_experts). 0 = full tensors.
+    experts_local: int = 0
 
     @nn.compact
     def __call__(self, x, train: bool = False):
@@ -99,6 +112,7 @@ class MoeMlp(nn.Module):
         from distribuuuu_tpu.parallel.tp import MODEL_AXIS
 
         E = self.num_experts
+        EL = self.experts_local or E
         d, f = self.dim, self.hidden
         scale_in = 1.0 / np.sqrt(d)
         scale_out = 1.0 / np.sqrt(f)
@@ -111,22 +125,22 @@ class MoeMlp(nn.Module):
             "w_in": self.param(
                 "w_in",
                 nn.with_partitioning(normal(scale_in), (MODEL_AXIS, None, None)),
-                (E, d, f), jnp.float32,
+                (EL, d, f), jnp.float32,
             ),
             "b_in": self.param(
                 "b_in",
                 nn.with_partitioning(nn.initializers.zeros, (MODEL_AXIS, None)),
-                (E, f), jnp.float32,
+                (EL, f), jnp.float32,
             ),
             "w_out": self.param(
                 "w_out",
                 nn.with_partitioning(normal(scale_out), (MODEL_AXIS, None, None)),
-                (E, f, d), jnp.float32,
+                (EL, f, d), jnp.float32,
             ),
             "b_out": self.param(
                 "b_out",
                 nn.with_partitioning(nn.initializers.zeros, (MODEL_AXIS, None)),
-                (E, d), jnp.float32,
+                (EL, d), jnp.float32,
             ),
         }
         B, S, _ = x.shape
@@ -141,14 +155,18 @@ class MoeMlp(nn.Module):
                 f"MODEL.MOE.IMPL must be 'partial' or 'dispatch', "
                 f"got {self.impl!r}"
             )
+        if EL != E and not (self.axes_bound and _axis_is_bound(MODEL_AXIS)):
+            raise ValueError(
+                f"experts_local={EL} (sharded-entry expert tensors) is "
+                "only valid inside a pipeline stage's shard_map with the "
+                "model axis bound"
+            )
         if self.axes_bound and _axis_is_bound(MODEL_AXIS):
             # inside an enclosing shard_map (a pipeline stage): mesh axes
             # are already bound — run the strategy body INLINE (nested
             # shard_map is illegal; the collectives compose fine on the
-            # bound axes). x is this rank's token shard; params are full
-            # (replicated inside the stage shard_map) — slice this rank's
-            # experts. Collapses to the dense loop + free collectives at
-            # model-axis size 1.
+            # bound axes). x is this rank's token shard. Collapses to the
+            # dense loop + free collectives at model-axis size 1.
             n = jax.lax.axis_size(MODEL_AXIS)
             r = jax.lax.axis_index(MODEL_AXIS)
             if E % n:
@@ -156,15 +174,28 @@ class MoeMlp(nn.Module):
                     f"model axis size {n} must divide num_experts {E}"
                 )
             local_E = E // n
-            local = {
-                "gate": params["gate"],
-                **{
-                    k: jax.lax.dynamic_slice_in_dim(
-                        params[k], r * local_E, local_E, 0
+            if EL != E:
+                # sharded entry (experts_local): the pipeline's in_specs
+                # already split the expert dim over ``model`` — the
+                # received tensors ARE this rank's experts (no slice, no
+                # replicated copy; ADVICE r3 #1)
+                if EL != local_E:
+                    raise ValueError(
+                        f"experts_local={EL} != num_experts {E} / "
+                        f"model-axis size {n}"
                     )
-                    for k in ("w_in", "b_in", "w_out", "b_out")
-                },
-            }
+                local = params
+            else:
+                # replicated entry: slice this rank's experts
+                local = {
+                    "gate": params["gate"],
+                    **{
+                        k: jax.lax.dynamic_slice_in_dim(
+                            params[k], r * local_E, local_E, 0
+                        )
+                        for k in ("w_in", "b_in", "w_out", "b_out")
+                    },
+                }
             if self.impl == "dispatch":
                 # switch-style all_to_all routing on the bound axis
                 # (VERDICT r3 #3); dropped fraction rides the stage-aux
@@ -325,6 +356,7 @@ class Block(nn.Module):
     moe_impl: str = "partial"
     moe_capacity_factor: float = 2.0
     moe_axes_bound: bool = False  # inside a pipeline stage's shard_map
+    moe_experts_local: int = 0  # PP×EP sharded entry (MoeMlp.experts_local)
 
     @nn.compact
     def __call__(self, x, train: bool = False):
@@ -341,6 +373,7 @@ class Block(nn.Module):
                 impl=self.moe_impl,
                 capacity_factor=self.moe_capacity_factor,
                 axes_bound=self.moe_axes_bound,
+                experts_local=self.moe_experts_local,
             )
         else:
             ffn = Mlp(
@@ -381,11 +414,12 @@ class _ViTCommon(nn.Module):
         return nn.Dropout(self.dropout, deterministic=not train)(x)
 
     def _head(self, x):
+        from distribuuuu_tpu.models.layers import head_dtype
+
         x = nn.LayerNorm(dtype=self.dtype, param_dtype=jnp.float32)(x)
         x = x.mean(axis=1)  # GAP over tokens
-        return Dense(self.num_classes, dtype=jnp.float32)(
-            x.astype(jnp.float32)
-        )
+        hd = head_dtype(x.dtype)
+        return Dense(self.num_classes, dtype=hd)(x.astype(hd))
 
 
 class ViT(_ViTCommon):
@@ -445,6 +479,7 @@ class ViTStage(nn.Module):
     moe_every: int = 2
     moe_impl: str = "partial"
     moe_capacity_factor: float = 2.0
+    moe_experts_local: int = 0  # PP×EP sharded entry (MoeMlp.experts_local)
 
     @nn.compact
     def __call__(self, x, train: bool = False):
@@ -466,6 +501,7 @@ class ViTStage(nn.Module):
                 moe_impl=self.moe_impl,
                 moe_capacity_factor=self.moe_capacity_factor,
                 moe_axes_bound=True,
+                moe_experts_local=self.moe_experts_local,
             )(x, train=train)
         return x
 
@@ -497,10 +533,11 @@ class PipelinedViT(_ViTCommon):
     scan carry (``pp.pipelined`` ``stage_aux``), and ``_sow_moe_aux``
     reconstructs the full-batch aux exactly (the vectors are token means,
     so equal-size subsets average exactly — ops/moe.balance_stats); the
-    dispatch strategy's dropped fraction rides the same channel. One
-    caveat vs flat EP: stage params enter the stage shard_map replicated
-    over ``model``, so per-device parameter memory is O(E), not O(E/n)
-    (compute and activations are still parallel; ADVICE r3 #1).
+    dispatch strategy's dropped fraction rides the same channel. Expert
+    tensors enter the stage shard_map SPLIT over ``model``
+    (``_stage_param_specs`` + ``MoeMlp.experts_local``), so per-device
+    parameter memory is O(E/n) like flat EP — the r3 replicated-entry
+    O(E) caveat is closed (ADVICE r3 #1).
     """
 
     num_classes: int = 1000
@@ -521,7 +558,7 @@ class PipelinedViT(_ViTCommon):
     moe_impl: str = "partial"
     moe_capacity_factor: float = 2.0
 
-    def _stage_module(self):
+    def _stage_module(self, experts_local: int = 0):
         if self.depth % self.pipe_stages:
             raise ValueError(
                 f"depth {self.depth} not divisible by pipe_stages "
@@ -566,6 +603,39 @@ class PipelinedViT(_ViTCommon):
             moe_experts=self.moe_experts, moe_top_k=self.moe_top_k,
             moe_every=self.moe_every, moe_impl=self.moe_impl,
             moe_capacity_factor=self.moe_capacity_factor,
+            moe_experts_local=experts_local,
+        )
+
+    def _stage_param_specs(self, stage_mod):
+        """Per-leaf shard_map in_specs for the stacked stage params:
+        expert tensors (Partitioned with ``model`` on dim 0) get
+        ``P('pipe', 'model', ...)`` so each device receives ONLY its
+        experts — O(E/n) param memory instead of the replicated O(E)
+        (ADVICE r3 #1); everything else enters ``P('pipe')`` (replicated
+        over model — the stage body computes dense layers locally, with
+        no TP collectives inside)."""
+        from jax.sharding import PartitionSpec as P
+
+        from distribuuuu_tpu.parallel.tp import MODEL_AXIS
+
+        dummy = jnp.zeros((1, 8, self.dim), jnp.float32)
+        template = jax.eval_shape(
+            lambda: stage_mod.init(
+                jax.random.key(0), dummy, train=False
+            )["params"]
+        )
+
+        def spec(t):
+            if (
+                isinstance(t, nn.Partitioned)
+                and t.names
+                and t.names[0] == MODEL_AXIS
+            ):
+                return P("pipe", MODEL_AXIS)
+            return P("pipe")
+
+        return jax.tree.map(
+            spec, template, is_leaf=lambda x: isinstance(x, nn.Partitioned)
         )
 
     def _sow_moe_aux(self, aux):
@@ -641,13 +711,16 @@ class PipelinedViT(_ViTCommon):
         # through the stage-aux channel whenever they exist
         collect = train and self.moe_experts > 0
 
-        def stage_fn(p, a):
-            if not collect:
-                return stage_mod.apply({"params": p}, a, train=train)
-            return stage_mod.apply(
-                {"params": p}, a, train=train,
-                mutable=["moe_balance", "moe_stats"],
-            )
+        def make_stage_fn(mod):
+            def stage_fn(p, a):
+                if not collect:
+                    return mod.apply({"params": p}, a, train=train)
+                return mod.apply(
+                    {"params": p}, a, train=train,
+                    mutable=["moe_balance", "moe_stats"],
+                )
+
+            return stage_fn
 
         mesh = self.mesh
         pipe_on_mesh = mesh is not None and mesh.shape.get("pipe", 1) == S
@@ -660,8 +733,26 @@ class PipelinedViT(_ViTCommon):
                     f"per data shard (need a multiple of {need}; "
                     "MESH.MICROBATCH × data axis)"
                 )
+            # PP×EP sharded entry (ADVICE r3 #1): split the expert dim over
+            # ``model`` in the shard_map in_specs and give the stage a
+            # module declaring the LOCAL expert count — O(E/n) per-device
+            # param memory; the inline MoE paths skip their slice
+            ep_n = mesh.shape.get("model", 1)
+            sharded_ep = (
+                self.moe_experts > 0
+                and ep_n > 1
+                and self.moe_experts % ep_n == 0
+            )
+            if sharded_ep:
+                run_mod = self._stage_module(
+                    experts_local=self.moe_experts // ep_n
+                )
+                param_specs = self._stage_param_specs(stage_mod)
+            else:
+                run_mod, param_specs = stage_mod, None
             piped = pp.pipelined(
-                stage_fn, mesh=mesh, num_microbatches=M, stage_aux=collect
+                make_stage_fn(run_mod), mesh=mesh, num_microbatches=M,
+                stage_aux=collect, param_specs=param_specs,
             )
             if collect:
                 x, aux = piped(stages, x)
@@ -671,6 +762,7 @@ class PipelinedViT(_ViTCommon):
         else:
             # sequential fallback: same params, same math (used for the
             # tiny init-time dummy batch and on meshes without a pipe axis)
+            stage_fn = make_stage_fn(stage_mod)
             muts = []
             for s in range(S):
                 out = stage_fn(jax.tree.map(lambda a: a[s], stages), x)

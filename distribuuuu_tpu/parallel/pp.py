@@ -166,6 +166,7 @@ def pipelined(
     axis: str = "pipe",
     data_axis: str | None = "data",
     stage_aux: bool = False,
+    param_specs=None,
 ):
     """Wrap ``stage_fn`` into ``fn(stacked_params, batch) -> outputs`` that
     runs the pipeline over ``mesh`` under jit (shard_map inside).
@@ -173,6 +174,13 @@ def pipelined(
     ``batch`` is ``[B, ...]`` (global); it is split into ``num_microbatches``
     equal microbatches. When ``data_axis`` is present in the mesh the batch
     dim is additionally sharded over it (PP × DP composition).
+
+    ``param_specs``: optional pytree of ``PartitionSpec``s (same structure
+    as the stacked params) replacing the default ``P(axis)`` — lets the
+    caller split selected param dims over OTHER mesh axes at shard_map
+    entry instead of replicating them per device (PP×EP expert tensors:
+    ``P('pipe', 'model', ...)`` keeps per-device expert memory at O(E/n);
+    ADVICE r3 #1). The stage_fn must expect the per-device local shards.
 
     ``stage_aux=True``: ``stage_fn`` returns ``(y, aux)`` and the wrapped
     function returns ``(outputs, aux_stacked)`` where each ``aux`` leaf
@@ -214,7 +222,8 @@ def pipelined(
     fn = shard_map(
         per_device,
         mesh=mesh,
-        in_specs=(P(axis), batch_spec),
+        in_specs=(param_specs if param_specs is not None else P(axis),
+                  batch_spec),
         out_specs=(out_spec, P()) if stage_aux else out_spec,
     )
 

@@ -351,12 +351,23 @@ def make_scan_train_step(model, optimizer, topk: int, fold: int,
 def _make_image_prep():
     """In-graph half of ``DATA.DEVICE_NORMALIZE`` (captured at step-build
     time): the loader ships raw uint8, the step normalizes in fp32 —
-    identical formula/order to the host path (data/transforms.py)."""
+    identical formula/order to the host path (data/transforms.py).
+
+    Dtype-gated at trace time (r4, when the flag became default-True):
+    only uint8 batches are normalized. Float batches are ALREADY
+    normalized — by the host pipeline, or synthetic (bench.py, tests) —
+    and must pass through untouched, else flipping the default would have
+    silently re-normalized every float-feeding caller."""
     if not cfg.DATA.DEVICE_NORMALIZE:
         return lambda images: images
     from distribuuuu_tpu.data.transforms import normalize_in_graph
 
-    return normalize_in_graph
+    def prep(images):
+        if images.dtype == jnp.uint8:
+            return normalize_in_graph(images)
+        return images
+
+    return prep
 
 
 def make_eval_step(model, topk: int):
@@ -371,7 +382,9 @@ def make_eval_step(model, topk: int):
             train=False,
         )
         mask = batch["mask"]
-        logp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
+        logp = jax.nn.log_softmax(
+            logits.astype(head_dtype(logits.dtype)), axis=-1
+        )
         nll = -jnp.take_along_axis(logp, batch["label"][:, None], axis=-1)[:, 0]
         _, pred = jax.lax.top_k(logits, topk)  # topk pre-clamped (effective_topk)
         hits = pred == batch["label"][:, None]
@@ -808,41 +821,62 @@ def _resume(
     )
 
 
-def check_batch_geometry(mesh):
+def check_batch_geometry(mesh, eval_only: bool = False):
     """Validate every batch-divisibility constraint before the expensive
     state init/compile, in the user's config units: grad-accum split, data
     axis sharding, GPipe microbatching (TRAIN **and** the padded eval
     batch — the val loader pads each batch to the full TEST.BATCH_SIZE, so
     an indivisible eval batch would otherwise train a whole epoch and then
-    crash inside validate(), ADVICE r2), and ghost BN grouping."""
-    accum = max(1, cfg.TRAIN.GRAD_ACCUM_STEPS)
-    per_host_batch = cfg.TRAIN.BATCH_SIZE * jax.local_device_count()
-    if per_host_batch % accum:
-        raise ValueError(
-            f"TRAIN.BATCH_SIZE={cfg.TRAIN.BATCH_SIZE} × "
-            f"{jax.local_device_count()} local chips = {per_host_batch} "
-            f"per host, not divisible by TRAIN.GRAD_ACCUM_STEPS={accum}"
-        )
-    global_micro = per_host_batch * jax.process_count() // accum
+    crash inside validate(), ADVICE r2), and ghost BN grouping.
+
+    ``eval_only`` (ADVICE r3 #2): test_model() never trains, so it runs
+    only the eval-batch checks — a train-invalid but eval-valid config
+    (e.g. an accum setting left in a YAML) must not block evaluation.
+    Returns the per-optimizer-step forward batch (None when eval_only).
+    """
     data_size = dict(mesh.shape).get("data", 1)
-    if accum > 1 and global_micro % data_size:
-        raise ValueError(
-            f"micro-batch {global_micro} (global batch "
-            f"{per_host_batch * jax.process_count()} / "
-            f"TRAIN.GRAD_ACCUM_STEPS={accum}) does not shard over the "
-            f"data axis of size {data_size}; raise TRAIN.BATCH_SIZE or "
-            "lower GRAD_ACCUM_STEPS"
-        )
     pipe_size = dict(mesh.shape).get("pipe", 1)
-    if pipe_size > 1:
-        pipe_mb = cfg.MESH.MICROBATCH or 2 * pipe_size
-        per_shard = global_micro // data_size
-        if per_shard % pipe_mb:
+    pipe_mb = cfg.MESH.MICROBATCH or 2 * pipe_size
+
+    if not eval_only:
+        accum = max(1, cfg.TRAIN.GRAD_ACCUM_STEPS)
+        per_host_batch = cfg.TRAIN.BATCH_SIZE * jax.local_device_count()
+        if per_host_batch % accum:
             raise ValueError(
-                f"per-data-shard batch {per_shard} not divisible by the "
-                f"{pipe_mb} GPipe microbatches (MESH.MICROBATCH, 0 → "
-                "2×PIPE); adjust TRAIN.BATCH_SIZE or MESH.MICROBATCH"
+                f"TRAIN.BATCH_SIZE={cfg.TRAIN.BATCH_SIZE} × "
+                f"{jax.local_device_count()} local chips = {per_host_batch} "
+                f"per host, not divisible by TRAIN.GRAD_ACCUM_STEPS={accum}"
             )
+        global_micro = per_host_batch * jax.process_count() // accum
+        if accum > 1 and global_micro % data_size:
+            raise ValueError(
+                f"micro-batch {global_micro} (global batch "
+                f"{per_host_batch * jax.process_count()} / "
+                f"TRAIN.GRAD_ACCUM_STEPS={accum}) does not shard over the "
+                f"data axis of size {data_size}; raise TRAIN.BATCH_SIZE or "
+                "lower GRAD_ACCUM_STEPS"
+            )
+        if pipe_size > 1:
+            per_shard = global_micro // data_size
+            if per_shard % pipe_mb:
+                raise ValueError(
+                    f"per-data-shard batch {per_shard} not divisible by the "
+                    f"{pipe_mb} GPipe microbatches (MESH.MICROBATCH, 0 → "
+                    "2×PIPE); adjust TRAIN.BATCH_SIZE or MESH.MICROBATCH"
+                )
+        bn_g = 0 if cfg.MODEL.ARCH.startswith("vit") else bn_group_from_cfg()
+        if bn_g > 0 and global_micro > bn_g and global_micro % bn_g:
+            # _BNCore would raise the same condition at first train-step trace
+            raise ValueError(
+                f"ghost BN group {bn_g} (MODEL.BN_GROUP, 0 → "
+                f"TRAIN.BATCH_SIZE) does not divide the per-step forward "
+                f"batch {global_micro}; adjust MODEL.BN_GROUP / "
+                "TRAIN.BATCH_SIZE / GRAD_ACCUM_STEPS"
+            )
+    else:
+        global_micro = None
+
+    if pipe_size > 1:
         eval_global = (
             cfg.TEST.BATCH_SIZE * jax.local_device_count()
             * jax.process_count()
@@ -857,14 +891,6 @@ def check_batch_geometry(mesh):
                 f"the {pipe_mb} GPipe microbatches; adjust TEST.BATCH_SIZE "
                 "or MESH.MICROBATCH"
             )
-    bn_g = 0 if cfg.MODEL.ARCH.startswith("vit") else bn_group_from_cfg()
-    if bn_g > 0 and global_micro > bn_g and global_micro % bn_g:
-        # _BNCore would raise the same condition at first train-step trace
-        raise ValueError(
-            f"ghost BN group {bn_g} (MODEL.BN_GROUP, 0 → TRAIN.BATCH_SIZE) "
-            f"does not divide the per-step forward batch {global_micro}; "
-            "adjust MODEL.BN_GROUP / TRAIN.BATCH_SIZE / GRAD_ACCUM_STEPS"
-        )
     return global_micro
 
 
@@ -1023,7 +1049,9 @@ def test_model():
     check_trainer_mesh()
     logger = setup_logger()
     mesh = mesh_lib.mesh_from_cfg(cfg)
-    check_batch_geometry(mesh)  # eval GPipe divisibility, before the compile
+    # eval-only checks (GPipe eval divisibility), before the compile — a
+    # train-invalid config must not block a pure evaluation (ADVICE r3 #2)
+    check_batch_geometry(mesh, eval_only=True)
     model = build_model_from_cfg()
     key = jax.random.key(cfg.RNG_SEED or 0)
     state = create_train_state(model, key, mesh, cfg.TRAIN.IM_SIZE)

@@ -40,8 +40,12 @@ def accuracy(logits, targets, topk=(1,)):
 
 def cross_entropy(logits, targets):
     """Mean softmax cross-entropy with integer labels (≙ nn.CrossEntropyLoss,
-    ref: trainer.py:139). Loss math in fp32 regardless of compute dtype."""
-    logp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
+    ref: trainer.py:139). Loss math in fp32 regardless of a low-precision
+    compute dtype — promoted, not hard-cast, so f64 logits (the x64
+    equivalence tests) are not re-rounded at the loss boundary."""
+    from distribuuuu_tpu.models.layers import head_dtype
+
+    logp = jax.nn.log_softmax(logits.astype(head_dtype(logits.dtype)), axis=-1)
     nll = -jnp.take_along_axis(logp, targets[:, None], axis=-1)[:, 0]
     return nll.mean()
 

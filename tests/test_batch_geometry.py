@@ -70,3 +70,19 @@ def test_ghost_bn_indivisible_raises():
     mesh = mesh_lib.mesh_from_cfg(cfg)
     with pytest.raises(ValueError, match="ghost BN group"):
         trainer.check_batch_geometry(mesh)
+
+
+def test_eval_only_skips_train_constraints():
+    """ADVICE r3 #2: a train-invalid but eval-valid config must not block
+    a pure evaluation — test_model() runs only the eval half."""
+    mesh = _vit_pipe_cfg()
+    # train-invalid: per-host batch 8*8=64 not divisible by accum 7
+    cfg.TRAIN.GRAD_ACCUM_STEPS = 7
+    with pytest.raises(ValueError, match="GRAD_ACCUM_STEPS"):
+        trainer.check_batch_geometry(mesh)
+    assert trainer.check_batch_geometry(mesh, eval_only=True) is None
+
+    # but an eval-invalid config still fails in eval_only mode
+    mesh = _vit_pipe_cfg(test_bs=3, microbatch=8)  # eval per shard 12 % 8
+    with pytest.raises(ValueError, match="eval batch"):
+        trainer.check_batch_geometry(mesh, eval_only=True)

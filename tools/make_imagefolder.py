@@ -33,25 +33,46 @@ import numpy as np
 from PIL import Image
 
 
+def _hue_rgb(hue: float) -> np.ndarray:
+    """Crude hsv→rgb on the hue wheel, full saturation, value 0.8."""
+    h6 = (hue % 1.0) * 6.0
+    x = 1.0 - abs(h6 % 2 - 1.0)
+    rgb = [(1, x, 0), (x, 1, 0), (0, 1, x), (0, x, 1), (x, 0, 1), (1, 0, x)][
+        int(h6) % 6
+    ]
+    return np.asarray(rgb, np.float32) * 0.8
+
+
+def class_spec(
+    c: int,
+    n_classes: int,
+    rng: np.random.Generator | None = None,
+    hue_jitter: float = 0.0,
+):
+    """(hue base rgb, stripe angle, stripe frequency) for class ``c``.
+
+    ``hue_jitter`` (hue-wheel units) draws PER-SAMPLE Gaussian offsets for
+    both the hue and the stripe angle. At ≈1× the inter-class gap (1/n)
+    adjacent classes overlap irreducibly — pixel noise alone cannot do
+    that (a CNN averages it away over 50k pixels), which is why the r3
+    tree saturated at 100% held-out top1 (VERDICT r3 #5)."""
+    hue = c / n_classes
+    angle_frac = c / n_classes
+    if hue_jitter > 0:
+        assert rng is not None
+        hue = hue + rng.normal(0.0, hue_jitter)
+        angle_frac = angle_frac + rng.normal(0.0, hue_jitter)
+    freq = 2.0 + 1.5 * (c % 4)
+    return _hue_rgb(hue), np.pi * (angle_frac % 1.0), freq
+
+
 def _class_palette(n_classes: int, rng: np.random.Generator):
-    """Distinct (hue base rgb, stripe angle, stripe frequency) per class."""
-    specs = []
-    for c in range(n_classes):
-        hue = c / n_classes
-        # crude hsv→rgb on the hue wheel, full saturation, value 0.8
-        h6 = hue * 6.0
-        x = 1.0 - abs(h6 % 2 - 1.0)
-        rgb = [(1, x, 0), (x, 1, 0), (0, 1, x), (0, x, 1), (x, 0, 1), (1, 0, x)][
-            int(h6) % 6
-        ]
-        angle = np.pi * c / n_classes
-        freq = 2.0 + 1.5 * (c % 4)
-        specs.append((np.asarray(rgb, np.float32) * 0.8, angle, freq))
-    return specs
+    """Jitter-free per-class specs (the original r2 tree)."""
+    return [class_spec(c, n_classes) for c in range(n_classes)]
 
 
 def render_image(
-    cls_spec, w: int, h: int, rng: np.random.Generator
+    cls_spec, w: int, h: int, rng: np.random.Generator, noise: float = 0.06
 ) -> np.ndarray:
     """One [h, w, 3] uint8 image: class hue + oriented stripes + noise."""
     base, angle, freq = cls_spec
@@ -68,7 +89,7 @@ def render_image(
     img = (
         base[None, None, :] * (0.55 + 0.45 * stripes[..., None]) * shade[..., None]
     )
-    img = img + rng.normal(0.0, 0.06, size=img.shape).astype(np.float32)
+    img = img + rng.normal(0.0, noise, size=img.shape).astype(np.float32)
     return (np.clip(img, 0.0, 1.0) * 255).astype(np.uint8)
 
 
@@ -80,14 +101,30 @@ def make_tree(
     min_size: int = 160,
     max_size: int = 320,
     seed: int = 0,
+    noise: float = 0.06,
+    label_noise: float = 0.0,
+    hue_jitter: float = 0.0,
 ) -> str:
     """Write ``out/{train,val}/class_XX/img_XXXX.jpg``; returns ``out``.
 
     Idempotent: if the finished-marker file exists with matching args the
     tree is reused (the real-chip bench calls this every run).
+
+    Hardness knobs (VERDICT r3 #5 — the 10-class tree saturates at 100%
+    held-out top1, turning the convergence curve into a victory lap
+    instead of a regression detector): ``n_classes ≥ 50`` crowds the hue
+    wheel (adjacent hues ~7° apart), ``noise`` raises per-pixel
+    corruption, and ``label_noise`` renders that fraction of TRAIN
+    samples from a different class's palette while keeping the directory
+    label — conflicting supervision that caps the achievable fit. Val
+    stays clean, so held-out top1 measures real generalization with
+    visible headroom.
     """
     stamp = os.path.join(out, ".complete")
-    sig = f"{n_classes}/{train_per_class}/{val_per_class}/{min_size}/{max_size}/{seed}"
+    sig = (
+        f"{n_classes}/{train_per_class}/{val_per_class}/{min_size}/"
+        f"{max_size}/{seed}/{noise}/{label_noise}/{hue_jitter}"
+    )
     if os.path.exists(stamp):
         with open(stamp) as f:
             if f.read().strip() == sig:
@@ -113,7 +150,21 @@ def make_tree(
                 )
                 w = int(rng.integers(min_size, max_size + 1))
                 h = int(rng.integers(min_size, max_size + 1))
-                arr = render_image(palette[c], w, h, rng)
+                render_c = c
+                if (
+                    split == "train"
+                    and label_noise > 0
+                    and rng.uniform() < label_noise
+                ):
+                    # wrong-content sample: rendered from another class's
+                    # palette, filed under this label (train only)
+                    render_c = int(rng.integers(n_classes))
+                spec = (
+                    class_spec(render_c, n_classes, rng, hue_jitter)
+                    if hue_jitter > 0
+                    else palette[render_c]
+                )
+                arr = render_image(spec, w, h, rng, noise=noise)
                 q = int(rng.integers(78, 95))
                 Image.fromarray(arr).save(
                     os.path.join(cdir, f"img_{i:04d}.jpg"),
@@ -133,10 +184,15 @@ def main():
     p.add_argument("--min-size", type=int, default=160)
     p.add_argument("--max-size", type=int, default=320)
     p.add_argument("--seed", type=int, default=0)
+    p.add_argument("--noise", type=float, default=0.06)
+    p.add_argument("--label-noise", type=float, default=0.0)
+    p.add_argument("--hue-jitter", type=float, default=0.0)
     args = p.parse_args()
     out = make_tree(
         args.out, args.classes, args.train_per_class, args.val_per_class,
         args.min_size, args.max_size, args.seed,
+        noise=args.noise, label_noise=args.label_noise,
+        hue_jitter=args.hue_jitter,
     )
     n = sum(len(files) for _, _, files in os.walk(out))
     print(f"wrote {out}: {args.classes} classes, ~{n} files")
