@@ -327,6 +327,15 @@ _C.MESH.PIPE = 1
 # GPipe microbatches per step when PIPE > 1 (parallel/pp.py schedule);
 # 0 → 2 × PIPE. The per-data-shard batch must divide by it.
 _C.MESH.MICROBATCH = 0
+# ZeRO / FSDP redundancy elimination over the data axis (parallel/zero.py).
+# 0 = off (DDP layout: params + optimizer state replicated per data rank,
+# the reference's topology). 1 = optimizer state sharded over data, grads
+# reduce-scattered into the sharded update (ZeRO-1). 3 = params also
+# sharded at rest (FSDP; weights all-gathered at use). Same math in every
+# stage — only per-rank memory and the compiled collective schedule change.
+# Stage 2 is subsumed: in-graph gradients are transient, the stage-1
+# constraint already materializes them sharded.
+_C.MESH.ZERO = 0
 
 # ------------------------------- data pipeline -------------------------------
 _C.DATA = CfgNode()

@@ -34,10 +34,12 @@ import optax
 from distribuuuu_tpu import models
 from distribuuuu_tpu.config import cfg
 from distribuuuu_tpu.data import construct_train_loader, construct_val_loader
-from distribuuuu_tpu.models.layers import resolve_dtype
+from distribuuuu_tpu.models.layers import head_dtype, resolve_dtype
 from distribuuuu_tpu.parallel import (
     mesh as mesh_lib,
     sharding as sharding_lib,
+    tp,
+    zero,
 )
 from distribuuuu_tpu.utils import checkpoint as ckpt
 from distribuuuu_tpu.utils import preempt
@@ -63,6 +65,19 @@ def check_trainer_mesh():
     """Refuse mesh axes the configured arch cannot use — GSPMD would
     silently replicate the whole computation over an unused axis (N×
     redundant work) rather than erroring."""
+    if cfg.MESH.ZERO not in (0, 1, 3):
+        raise ValueError(
+            f"MESH.ZERO={cfg.MESH.ZERO}: stages are 0 (off), 1 (optimizer "
+            "state sharded over data), 3 (params too — FSDP); stage 2 is "
+            "subsumed by 1 in a fused jit step (parallel/zero.py)"
+        )
+    if cfg.MESH.ZERO == 3 and cfg.MESH.PIPE not in (0, 1):
+        raise ValueError(
+            f"MESH.ZERO=3 with MESH.PIPE={cfg.MESH.PIPE}: FSDP-sharded "
+            "params cannot enter the pipeline stage shard_map, whose "
+            "in_specs describe the pipe/model layout only — use MESH.ZERO=1 "
+            "(optimizer-state sharding composes with PP) or a non-pipe mesh"
+        )
     if cfg.MESH.PIPE not in (0, 1):
         if not cfg.MODEL.ARCH.startswith("vit"):
             raise ValueError(
@@ -168,26 +183,23 @@ def build_model_from_cfg():
     return models.build_model(cfg.MODEL.ARCH, **kwargs)
 
 
-def create_train_state(model, key, mesh, im_size: int) -> TrainState:
+def create_train_state(model, key, mesh, im_size: int, layout=None) -> TrainState:
     """Initialize params/stats/optimizer laid out over the mesh.
 
     Params are placed by their ``nn.with_partitioning`` metadata: replicated
     by default (≙ DDP's init broadcast, ref: trainer.py:134) and sharded over
     the ``model`` axis where a kernel is annotated (tensor parallelism —
     collapses to replication at MESH.MODEL=1). The optimizer's momentum
-    buffers inherit the param layout through GSPMD propagation.
+    buffers inherit the param layout through GSPMD propagation. With
+    ``MESH.ZERO`` on, optimizer state (and at stage 3 the params) rest in
+    the ZeRO layout instead. ``layout`` accepts a precomputed
+    ``_state_layout`` result so callers that also need it for the train
+    step don't trace the abstract init twice.
     """
-    import functools
-
-    from distribuuuu_tpu.parallel import tp
-
-    dummy = jnp.ones((2, im_size, im_size, 3), jnp.float32)
+    shardings = layout or _state_layout(model, mesh, im_size)
     optimizer = construct_optimizer()
-    abstract = jax.eval_shape(
-        functools.partial(model.init, train=False), key, dummy
-    )
-    shardings = tp.param_shardings(mesh, abstract)
     repl = sharding_lib.replicate(mesh)
+    dummy = jnp.ones((2, im_size, im_size, 3), jnp.float32)
 
     def init_all(key):
         variables = flax.linen.meta.unbox(model.init(key, dummy, train=False))
@@ -200,7 +212,7 @@ def create_train_state(model, key, mesh, im_size: int) -> TrainState:
             bs, jax.tree.map(lambda _: repl, bs)
         )
         opt_state = tp.constrain_like(
-            optimizer.init(params), params, shardings["params"]
+            optimizer.init(params), params, shardings["opt"]
         )
         return TrainState(
             params=params,
@@ -213,8 +225,50 @@ def create_train_state(model, key, mesh, im_size: int) -> TrainState:
     return jax.jit(init_all)(key)
 
 
-def _train_step_body(model, optimizer, topk: int, accum_steps: int = 1):
+def _state_layout(model, mesh, im_size: int) -> dict:
+    """Resolved NamedSharding trees for the configured layout regime.
+
+    Returns ``{"params", "opt", "grads"}`` — param-shaped trees. With
+    ``MESH.ZERO`` off all three are the TP/PP-annotated base layout
+    (params replicated over ``data``, the DDP topology). Stage 1 moves
+    ``opt``/``grads`` to the ZeRO layout (``data`` added per leaf,
+    parallel/zero.py); stage 3 moves ``params`` too (FSDP)."""
+    import functools
+
+    dummy = jnp.ones((2, im_size, im_size, 3), jnp.float32)
+    abstract = jax.eval_shape(
+        functools.partial(model.init, train=False),
+        jax.random.key(0), dummy,
+    )
+    base = tp.param_shardings(mesh, abstract)["params"]
+    stage = cfg.MESH.ZERO
+    if not stage:
+        return {"params": base, "opt": base, "grads": base}
+    abstract_params = flax.linen.meta.unbox(abstract)["params"]
+    zsh = zero.zero_shardings(mesh, base, abstract_params)
+    return {
+        "params": zsh if stage == 3 else base,
+        "opt": zsh,
+        "grads": zsh,
+    }
+
+
+def _train_step_body(model, optimizer, topk: int, accum_steps: int = 1,
+                     layout=None):
     """The pure step function shared by the per-step and folded paths.
+
+    ``layout`` (a ``_state_layout`` dict) is required when ``MESH.ZERO`` is
+    on: the gradient is constrained to the ZeRO layout right before the
+    optimizer update — GSPMD satisfies it with a reduce-scatter, fusing the
+    cross-replica grad mean with the shard slicing — and the outputs are
+    pinned back to the state's rest layout so buffer donation stays stable
+    across steps. ``None`` (the default) adds no constraints: GSPMD
+    propagates the replicated DDP layout exactly as before. Building a
+    step WITHOUT a layout while ``MESH.ZERO`` is set is refused — the
+    state (create_train_state) would rest ZeRO-sharded while the step
+    neither reduce-scatters grads nor pins outputs back, silently
+    skipping buffer donation and measuring a layout that is neither DDP
+    nor ZeRO.
 
     ``accum_steps > 1`` runs that many sequential micro-batches, summing
     gradients in-graph before ONE optimizer update (config:
@@ -229,10 +283,20 @@ def _train_step_body(model, optimizer, topk: int, accum_steps: int = 1):
     """
 
     def apply_grads(state, grads, new_stats, metrics):
+        if layout is not None:
+            # ZeRO: reduce-scatter the grad into the sharded update
+            grads = zero.constrain(grads, layout["grads"])
         updates, new_opt_state = optimizer.update(
             grads, state.opt_state, state.params
         )
         new_params = optax.apply_updates(state.params, updates)
+        if layout is not None:
+            # pin rest layouts (stage 1: params re-gathered to replicated;
+            # stage 3: params stay data-sharded) — keeps donation stable
+            new_params = zero.constrain(new_params, layout["params"])
+            new_opt_state = tp.constrain_like(
+                new_opt_state, grads, layout["opt"]
+            )
         return TrainState(
             params=new_params,
             batch_stats=new_stats,
@@ -308,6 +372,11 @@ def _train_step_body(model, optimizer, topk: int, accum_steps: int = 1):
             )
 
         zeros = jax.tree.map(jnp.zeros_like, state.params)
+        if layout is not None:
+            # sharded accumulation buffer: each micro-grad reduce-scatters
+            # into it (ZeRO-2 semantics during accumulation — the standing
+            # grad-sum holds 1/N per rank)
+            zeros = zero.constrain(zeros, layout["grads"])
         (new_stats, gsum, _), micro_metrics = jax.lax.scan(
             body, (state.batch_stats, zeros, jnp.int32(0)), micro,
             length=accum_steps,
@@ -319,17 +388,18 @@ def _train_step_body(model, optimizer, topk: int, accum_steps: int = 1):
     return accum_train_step if accum_steps > 1 else train_step
 
 
-def make_train_step(model, optimizer, topk: int, accum_steps: int = 1):
+def make_train_step(model, optimizer, topk: int, accum_steps: int = 1,
+                    layout=None):
     """Compile-once train step: fwd + CE loss + bwd + SGD + metrics
     (≙ the hot loop body, ref: trainer.py:37-58)."""
     return jax.jit(
-        _train_step_body(model, optimizer, topk, accum_steps),
+        _train_step_body(model, optimizer, topk, accum_steps, layout=layout),
         donate_argnums=0,
     )
 
 
 def make_scan_train_step(model, optimizer, topk: int, fold: int,
-                         accum_steps: int = 1):
+                         accum_steps: int = 1, layout=None):
     """``fold`` optimizer steps in ONE compiled call via ``lax.scan``.
 
     Same math as ``fold`` sequential ``make_train_step`` calls (same body,
@@ -340,7 +410,7 @@ def make_scan_train_step(model, optimizer, topk: int, fold: int,
     Takes a stacked batch pytree with leading dim ``fold`` (leaf shape
     ``(fold, batch, ...)``) and returns stacked per-step metrics ``(fold,)``.
     """
-    body = _train_step_body(model, optimizer, topk, accum_steps)
+    body = _train_step_body(model, optimizer, topk, accum_steps, layout=layout)
 
     def scan_steps(state: TrainState, stacked_batch):
         return jax.lax.scan(body, state, stacked_batch, length=fold)
@@ -799,7 +869,15 @@ def _resume(
     opt_state = state.opt_state
     if cfg.TRAIN.LOAD_OPT and "opt_state" in restored:
         try:
-            opt_state = _place_like(state.opt_state, restored["opt_state"])
+            # rebuild the optax structure against the LIVE optimizer first —
+            # orbax restores namedtuple containers as plain dicts
+            # (utils/checkpoint.pack_opt_state has the full story; before
+            # r4 this mismatch made every auto-resume silently fall through
+            # to a fresh optimizer)
+            opt_state = _place_like(
+                state.opt_state,
+                ckpt.unpack_opt_state(state.opt_state, restored["opt_state"]),
+            )
         except Exception as e:  # graceful weights-only fallback (utils.py:399-405)
             logger.warning("optimizer state not restored (%s); fresh optimizer", e)
     start_epoch = int(restored.get("epoch", -1)) + 1
@@ -910,7 +988,8 @@ def train_model():
     check_batch_geometry(mesh)
 
     model = build_model_from_cfg()
-    state = create_train_state(model, key, mesh, cfg.TRAIN.IM_SIZE)
+    layout = _state_layout(model, mesh, cfg.TRAIN.IM_SIZE)
+    state = create_train_state(model, key, mesh, cfg.TRAIN.IM_SIZE, layout=layout)
     m_params, mb = count_parameters(state.params)
     logger.info(
         "model %s: %.3fM params (%.2f MB fp32), mesh %s",
@@ -920,14 +999,16 @@ def train_model():
     optimizer = construct_optimizer()
     train_loader = construct_train_loader()
     val_loader = construct_val_loader()
+    step_layout = layout if cfg.MESH.ZERO else None
     train_step = make_train_step(
-        model, optimizer, effective_topk(), accum_steps=accum
+        model, optimizer, effective_topk(), accum_steps=accum,
+        layout=step_layout,
     )
     scan_step = None
     if cfg.TRAIN.STEPS_PER_CALL > 1:
         scan_step = make_scan_train_step(
             model, optimizer, effective_topk(), cfg.TRAIN.STEPS_PER_CALL,
-            accum_steps=accum,
+            accum_steps=accum, layout=step_layout,
         )
     eval_step = make_eval_step(model, effective_topk())
 

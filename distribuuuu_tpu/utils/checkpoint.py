@@ -80,6 +80,54 @@ def has_checkpoint() -> bool:
     return bool(_scan(_NAME_PREFIX) or _scan(_PREEMPT_PREFIX))
 
 
+def pack_opt_state(opt_state):
+    """Optax state → a serialization-stable numbered-leaf dict.
+
+    Orbax restores optax's namedtuple containers as plain dicts, which do
+    NOT unflatten back into the namedtuple structure (and matching leaves
+    by alphabetical-key order only works when every namedtuple's field
+    order happens to be alphabetical — a silent-swap hazard for
+    same-shaped leaves like Adam's mu/nu). Stored form: leaves numbered
+    in the template's canonical jax flatten order, so the restore side
+    rebuilds the exact structure from the LIVE optimizer's treedef with
+    no dependence on container serialization at all."""
+    leaves = jax.tree.leaves(opt_state)
+    return {
+        "format": "optax_leaves_v1",
+        "leaves": {f"{i:05d}": leaf for i, leaf in enumerate(leaves)},
+    }
+
+
+def unpack_opt_state(template, stored):
+    """Rebuild an optax state from ``pack_opt_state`` output (or a legacy
+    structured save) against the live ``template``. Raises ValueError on
+    any leaf-count/shape mismatch — the caller's graceful weights-only
+    fallback (ref: utils.py:399-405) handles that."""
+    if (
+        isinstance(stored, dict)
+        and stored.get("format") == "optax_leaves_v1"
+    ):
+        leaves = [stored["leaves"][k] for k in sorted(stored["leaves"])]
+    else:
+        # legacy structured form: flatten order matched the template only
+        # when namedtuple field order was alphabetical — verified below
+        leaves = jax.tree.leaves(stored)
+    tmpl_leaves, tdef = jax.tree.flatten(template)
+    if len(leaves) != len(tmpl_leaves):
+        raise ValueError(
+            f"optimizer state leaf count {len(leaves)} != live optimizer's "
+            f"{len(tmpl_leaves)} (different OPTIM settings?)"
+        )
+    for i, (t, s) in enumerate(zip(tmpl_leaves, leaves)):
+        t_shape = tuple(getattr(t, "shape", ()))
+        if t_shape != tuple(np.shape(s)):
+            raise ValueError(
+                f"optimizer state leaf {i} shape {tuple(np.shape(s))} != "
+                f"live {t_shape}"
+            )
+    return jax.tree.unflatten(tdef, leaves)
+
+
 def _save_full(
     path: str, state_tree: dict, epoch_cursor: int, best_acc1: float,
     extra: dict | None = None,
@@ -89,6 +137,8 @@ def _save_full(
     process participates; array shards written by their owners)."""
     os.makedirs(get_checkpoint_dir(), exist_ok=True)
     payload = dict(state_tree)
+    if "opt_state" in payload:
+        payload["opt_state"] = pack_opt_state(payload["opt_state"])
     payload["epoch"] = np.int32(epoch_cursor)
     payload["best_acc1"] = np.float32(best_acc1)
     if extra:
