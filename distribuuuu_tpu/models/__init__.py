@@ -83,6 +83,10 @@ def build_model(arch: str, **kwargs):
     """Construct a model by name (≙ models.build_model + timm fallback)."""
     if arch not in _REGISTRY:
         raise KeyError(
-            f"Unknown arch '{arch}'. Available: {', '.join(available_models())}"
+            f"Unknown arch '{arch}'. Available: {', '.join(available_models())}. "
+            "This zoo is closed — there is no timm fallback (ref: "
+            "trainer.py:123-128); register a custom arch with "
+            "@distribuuuu_tpu.models.register_model (see README 'Custom "
+            "architectures')."
         )
     return _REGISTRY[arch](**kwargs)

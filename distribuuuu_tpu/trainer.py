@@ -281,6 +281,14 @@ def _train_step_body(model, optimizer, topk: int, accum_steps: int = 1,
     grad); BN stats are per-micro-batch — torch-DDP-with-accumulation
     semantics. HBM holds one micro-batch of activations at a time.
     """
+    if layout is None and cfg.MESH.ZERO:
+        raise ValueError(
+            f"MESH.ZERO={cfg.MESH.ZERO} requires the step to be built with "
+            "the ZeRO state layout (pass layout=_state_layout(...)): the "
+            "state rests ZeRO-sharded, and a layout-less step would neither "
+            "reduce-scatter grads nor pin rest layouts — a silent "
+            "neither-DDP-nor-ZeRO configuration."
+        )
 
     def apply_grads(state, grads, new_stats, metrics):
         if layout is not None:
@@ -878,7 +886,10 @@ def _resume(
                 state.opt_state,
                 ckpt.unpack_opt_state(state.opt_state, restored["opt_state"]),
             )
-        except Exception as e:  # graceful weights-only fallback (utils.py:399-405)
+        except ValueError as e:  # structural mismatch from unpack_opt_state →
+            # graceful weights-only fallback (utils.py:399-405). Deliberately
+            # narrow: placement errors (device_put/OOM) must propagate rather
+            # than silently degrade to a fresh optimizer (ADVICE r4).
             logger.warning("optimizer state not restored (%s); fresh optimizer", e)
     start_epoch = int(restored.get("epoch", -1)) + 1
     best_acc1 = float(restored.get("best_acc1", 0.0))

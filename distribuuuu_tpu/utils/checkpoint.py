@@ -110,7 +110,11 @@ def unpack_opt_state(template, stored):
         leaves = [stored["leaves"][k] for k in sorted(stored["leaves"])]
     else:
         # legacy structured form: flatten order matched the template only
-        # when namedtuple field order was alphabetical — verified below
+        # when namedtuple field order was alphabetical. Only leaf COUNT and
+        # SHAPES are verified below — same-shaped leaves from a
+        # non-alphabetical namedtuple (none among current optax states)
+        # would pass the check swapped; the v1 keyed format above is why
+        # this path is legacy-only (ADVICE r4).
         leaves = jax.tree.leaves(stored)
     tmpl_leaves, tdef = jax.tree.flatten(template)
     if len(leaves) != len(tmpl_leaves):
