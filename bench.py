@@ -14,6 +14,7 @@ that torch DDP ResNet-50 fp32 achieves on the reference's A100-class hardware
 from __future__ import annotations
 
 import json
+import os
 import time
 
 BASELINE_IMG_PER_SEC_PER_CHIP = 400.0  # A100 fp32 DDP resnet50 (see docstring)
@@ -55,7 +56,10 @@ def build_workload(fold: int = 4, per_chip_batch: int = 128):
     from distribuuuu_tpu.utils.optim import construct_optimizer
 
     config.reset_cfg()
-    cfg.MODEL.ARCH = "resnet50"
+    # DISTRIBUUUU_BENCH_ARCH: run the same harness on another zoo arch
+    # (ab_bench env plumbing reaches this at build time) — e.g. the
+    # regnety_160 grouped-conv A/Bs (PERF.md r5).
+    cfg.MODEL.ARCH = os.environ.get("DISTRIBUUUU_BENCH_ARCH", "resnet50")
     cfg.MODEL.NUM_CLASSES = 1000
     n_chips = len(jax.devices())
     batch = per_chip_batch * n_chips
@@ -65,6 +69,19 @@ def build_workload(fold: int = 4, per_chip_batch: int = 128):
     state = trainer.create_train_state(model, jax.random.key(0), mesh, 224)
     optimizer = construct_optimizer()
     train_step = trainer.make_scan_train_step(model, optimizer, topk=5, fold=fold)
+
+    # DISTRIBUUUU_XLA_OPTS="k=v;k=v": per-variant XLA compiler options for
+    # the flag-sweep experiments (tools/xla_flag_sweep.py). An outer jit
+    # re-wrap — the inner jit inlines during tracing, so the options govern
+    # the whole step compilation.
+    xla_opts = os.environ.get("DISTRIBUUUU_XLA_OPTS", "")
+    if xla_opts:
+        copts = dict(
+            p.split("=", 1) for p in xla_opts.split(";") if "=" in p
+        )
+        train_step = jax.jit(
+            train_step, donate_argnums=0, compiler_options=copts
+        )
 
     rng = np.random.default_rng(0)
     host_batch = {

@@ -291,6 +291,10 @@ _C.OPTIM.WARMUP_EPOCHS = 0
 _C.OPTIM.STEPS = []
 _C.OPTIM.MIN_LR = 0.0
 
+# SGD momentum-buffer dtype: "float32" (torch-exact) or "bfloat16"
+# (fp32 master params + half-traffic momentum; utils/optim.py)
+_C.OPTIM.MOMENTUM_DTYPE = "float32"
+
 # ------------------------------- device / mesh (TPU-native additions) -------
 _C.DEVICE = CfgNode()
 # "tpu" | "cpu" | "auto" — jax platform selection.

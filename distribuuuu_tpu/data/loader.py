@@ -24,6 +24,7 @@ import numpy as np
 from distribuuuu_tpu.config import cfg
 from distribuuuu_tpu.data.dummy import DummyDataset
 from distribuuuu_tpu.data.sampler import DistributedSampler
+from distribuuuu_tpu.parallel import mesh as mesh_lib
 
 
 class Loader:
@@ -55,10 +56,14 @@ class Loader:
             except RuntimeError:
                 pass  # surfaces with a clear error at iteration time
         self.prefetch_depth = 2 if native_batch else self.workers
+        # shard by DATA GROUP, not by process: processes sharing a data
+        # row (model/pipe axes spanning hosts) must load identical data
+        # (parallel/mesh.data_process_groups; ≡ (rank, world) in pure DP)
+        data_rank, data_world = mesh_lib.data_process_groups()
         self.sampler = DistributedSampler(
             len(dataset),
-            num_replicas=jax.process_count(),
-            rank=jax.process_index(),
+            num_replicas=data_world,
+            rank=data_rank,
             shuffle=shuffle,
             seed=seed,
             drop_last=False,  # torch pads in the sampler; drop happens per-batch

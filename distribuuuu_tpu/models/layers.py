@@ -138,6 +138,53 @@ class UnrolledGroupConv(nn.Module):
         x = x.astype(self.dtype)  # lax.conv requires matching dtypes
         s = self.strides
         strides = s if isinstance(s, (tuple, list)) else (s, s)
+        use_pallas = (
+            (kh, kw) == (3, 3)
+            and strides == (1, 1)  # Mosaic: no stride-2 VMEM slices
+            and list(map(tuple, self.padding)) == [(1, 1), (1, 1)]
+            # small-spatial stages only: ≥28² grids send the Mosaic
+            # compiler into multi-minute/OOM territory, and XLA's own
+            # lowering is least bad there anyway (PERF.md r5)
+            and x.shape[1] <= 14 and x.shape[2] <= 14
+        )
+        mode = os.environ.get("DISTRIBUUUU_GROUP_CONV", "auto")
+        if use_pallas and mode == "pallas":
+            # hand-tiled Pallas kernel (ops/group_conv.py). Measured
+            # 1.3-1.5× XLA's formulations PER OP, but 0.74× end-to-end:
+            # the custom-call boundaries forfeit XLA's epilogue fusion and
+            # prefetch scheduling (trace: +12 ms DMA waits, +15 ms glue on
+            # regnety_160 — PERF.md r5 "Grouped convs"). NOT in `auto`;
+            # the knob remains for kernel work that fuses the full block.
+            from distribuuuu_tpu.ops.group_conv import group_conv3x3
+
+            # interpret mode off-TPU so the forced knob stays testable on
+            # the CPU mesh (slow but exact); compiled Mosaic on the chip
+            interp = jax.devices()[0].platform != "tpu"
+            return group_conv3x3(x, kernel, 1, self.groups, interp)
+        if mode == "blockdiag":
+            # grouped conv as ONE dense conv over a block-diagonal kernel:
+            # zero blocks kill every cross-group term, so the math — and
+            # the canonical param, and its gradient (autodiff drops the
+            # zero blocks' grads) — is exactly the grouped conv's. Trades
+            # G× more MXU FLOPs for one large well-tiled conv instead of
+            # G small ones (A/B experiment, PERF.md r5).
+            blocks = kernel.reshape(kh, kw, cg, self.groups, fg)
+            dense = jnp.zeros(
+                (kh, kw, self.groups, cg, self.groups, fg), self.dtype
+            )
+            idx = jnp.arange(self.groups)
+            # advanced indices at axes 2 and 4 move to the front: the set
+            # payload is [G, kh, kw, cg, fg]
+            dense = dense.at[:, :, idx, :, idx, :].set(
+                jnp.moveaxis(blocks, 3, 0)
+            )
+            dense = dense.reshape(
+                kh, kw, self.groups * cg, self.features
+            )
+            return jax.lax.conv_general_dilated(
+                x, dense, strides, self.padding,
+                dimension_numbers=("NHWC", "HWIO", "NHWC"),
+            )
         outs = [
             jax.lax.conv_general_dilated(
                 x[..., g * cg : (g + 1) * cg],
@@ -173,6 +220,21 @@ class ConvBN(nn.Module):
     act: Callable | None = None
     s2d_stem: bool = False
 
+    def _group_conv_unrolled(self, in_channels: int) -> bool:
+        """Grouped-conv compute path at trace time. ``auto`` (default):
+        unroll when the per-group width is MXU-wide (≥64, the r1 rule —
+        PERF.md "Grouped convs"). ``DISTRIBUUUU_GROUP_CONV`` forces
+        ``unrolled``/``fused`` for paired A/B runs; params and checkpoints
+        are identical either way (same canonical kernel)."""
+        mode = os.environ.get("DISTRIBUUUU_GROUP_CONV", "auto")
+        if mode in ("unrolled", "blockdiag", "pallas"):
+            return True  # blockdiag/pallas are handled inside UnrolledGroupConv
+        if mode == "fused":
+            return False
+        if mode != "auto":
+            raise ValueError(f"DISTRIBUUUU_GROUP_CONV={mode!r}")
+        return in_channels // self.groups >= 64
+
     @nn.compact
     def __call__(self, x, train: bool = False):
         k = self.kernel_size
@@ -188,7 +250,7 @@ class ConvBN(nn.Module):
                 and list(map(tuple, pad)) == [(3, 3), (3, 3)]
             ), "s2d_stem is specifically the 7x7/s2/pad-3 ungrouped stem"
             x = StemConv7x7(self.features, dtype=self.dtype, name="Conv_0")(x)
-        elif self.groups > 1 and x.shape[-1] // self.groups >= 64:
+        elif self.groups > 1 and self._group_conv_unrolled(x.shape[-1]):
             x = UnrolledGroupConv(
                 self.features, tuple(k), self.strides, pad, self.groups,
                 dtype=self.dtype, name="Conv_0",

@@ -19,6 +19,7 @@ first-class so larger workloads shard without restructuring.
 
 from __future__ import annotations
 
+import functools
 import os
 import subprocess
 
@@ -128,6 +129,52 @@ def get_local_rank() -> int:
 def is_primary() -> bool:
     """True on the logging/checkpointing process (≙ rank == 0 gates)."""
     return jax.process_index() == 0
+
+
+def data_process_groups(mesh=None) -> tuple[int, int]:
+    """``(data_rank, n_data_groups)`` for the host data pipeline.
+
+    In the reference's pure-DP world every process owns a distinct slice
+    of the batch, so ``(process_index, process_count)`` is the sampler
+    shard (ref: utils.py:141-143). Once the model/pipe axes span
+    *processes* (e.g. a 2×2 data×model mesh over 4 single-device hosts),
+    processes in the same data row must load IDENTICAL data — their
+    devices hold the same batch shard. This derives the data-group index
+    from the mesh's device→process layout: processes whose devices cover
+    the same set of data-axis rows form one group; samplers shard by
+    group, not by process. Falls back to the classic (rank, world) in
+    single-process runs and degenerates to exactly that whenever each
+    process owns its own data rows.
+    """
+    if jax.process_count() == 1:
+        return 0, 1
+    if mesh is None:
+        from distribuuuu_tpu.config import cfg
+
+        mesh = mesh_from_cfg(cfg)
+    return _data_groups_of_mesh(mesh)
+
+
+@functools.lru_cache(maxsize=8)
+def _data_groups_of_mesh(mesh) -> tuple[int, int]:
+    # pure in the mesh (and this process's index) — cached because the
+    # sharded-batch placement path calls it every step
+    rows_by_proc: dict[int, set] = {}
+    for idx, dev in np.ndenumerate(mesh.devices):
+        rows_by_proc.setdefault(dev.process_index, set()).add(idx[0])
+    keys = {p: tuple(sorted(s)) for p, s in rows_by_proc.items()}
+    distinct = sorted(set(keys.values()))
+    mine = keys.get(jax.process_index())
+    if mine is None or any(
+        a != b and set(a) & set(b) for a in distinct for b in distinct
+    ):
+        # a process outside the mesh, or groups that PARTIALLY overlap
+        # data rows (a layout the host pipeline cannot feed correctly)
+        raise ValueError(
+            f"mesh device→process layout does not partition the data axis "
+            f"into clean per-process-group row sets: {sorted(keys.items())}"
+        )
+    return distinct.index(mine), len(distinct)
 
 
 def build_mesh(

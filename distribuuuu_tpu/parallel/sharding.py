@@ -36,12 +36,19 @@ def _put_tree(mesh: Mesh, tree, batch_dim: int):
     spec = P(*([None] * batch_dim + ["data"]))
     sharding = NamedSharding(mesh, spec)
 
+    # the batch's global extent scales with DATA GROUPS, not processes:
+    # processes sharing a data row (model/pipe axes spanning hosts) feed
+    # identical copies of the same shard (parallel/mesh.data_process_groups)
+    from distribuuuu_tpu.parallel.mesh import data_process_groups
+
+    _, n_groups = data_process_groups(mesh)
+
     def _put(x):
         x = np.asarray(x)
         if jax.process_count() == 1:
             return jax.device_put(x, sharding)
         global_shape = tuple(
-            d * jax.process_count() if i == batch_dim else d
+            d * n_groups if i == batch_dim else d
             for i, d in enumerate(x.shape)
         )
         return jax.make_array_from_process_local_data(sharding, x, global_shape)

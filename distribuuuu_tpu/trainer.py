@@ -22,6 +22,7 @@ TPU-first redesign of the hot loop (ref call stack: SURVEY.md §3.1):
 
 from __future__ import annotations
 
+import functools
 import time
 from typing import Any
 
@@ -816,14 +817,32 @@ def validate(loader, mesh, state, eval_step, epoch: int, logger):
 
 
 def _place_like(tmpl, new):
-    """Place restored host arrays with the live template's dtype + layout
-    (replicated or TP-sharded), leaf by leaf."""
-    return jax.tree.map(
-        lambda t, n: jax.device_put(
-            np.asarray(n, dtype=getattr(t, "dtype", None)), t.sharding
-        ),
-        tmpl,
-        new,
+    """Place restored arrays with the live template's dtype + layout
+    (replicated, TP- or ZeRO-sharded), leaf by leaf.
+
+    Host (numpy) leaves go through a plain sharded device_put. Restored
+    ``jax.Array`` leaves that SPAN processes (multi-host ZeRO resume:
+    orbax hands back arrays in their saved sharding, of which this
+    process addresses only its slice) cannot be fetched to host at all —
+    those reshard on-device through a jitted identity with the template's
+    sharding as out_shardings (compiles to the minimal collective)."""
+
+    def _place(t, n):
+        dtype = getattr(t, "dtype", None)
+        if isinstance(n, jax.Array) and not n.is_fully_addressable:
+            return _reshard_fn(dtype, t.sharding)(n)
+        return jax.device_put(np.asarray(n, dtype=dtype), t.sharding)
+
+    return jax.tree.map(_place, tmpl, new)
+
+
+@functools.lru_cache(maxsize=None)
+def _reshard_fn(dtype, sharding):
+    """Jitted identity-cast keyed on (dtype, target sharding) — one
+    compiled reshard program per distinct layout instead of one per leaf."""
+    return jax.jit(
+        lambda a: a.astype(dtype) if dtype is not None else a,
+        out_shardings=sharding,
     )
 
 
@@ -926,6 +945,9 @@ def check_batch_geometry(mesh, eval_only: bool = False):
     data_size = dict(mesh.shape).get("data", 1)
     pipe_size = dict(mesh.shape).get("pipe", 1)
     pipe_mb = cfg.MESH.MICROBATCH or 2 * pipe_size
+    # global batch = per-host × DATA GROUPS (≡ process_count in pure DP;
+    # smaller when model/pipe axes span hosts — those hosts feed copies)
+    _, n_groups = mesh_lib.data_process_groups(mesh)
 
     if not eval_only:
         accum = max(1, cfg.TRAIN.GRAD_ACCUM_STEPS)
@@ -936,11 +958,11 @@ def check_batch_geometry(mesh, eval_only: bool = False):
                 f"{jax.local_device_count()} local chips = {per_host_batch} "
                 f"per host, not divisible by TRAIN.GRAD_ACCUM_STEPS={accum}"
             )
-        global_micro = per_host_batch * jax.process_count() // accum
+        global_micro = per_host_batch * n_groups // accum
         if accum > 1 and global_micro % data_size:
             raise ValueError(
                 f"micro-batch {global_micro} (global batch "
-                f"{per_host_batch * jax.process_count()} / "
+                f"{per_host_batch * n_groups} / "
                 f"TRAIN.GRAD_ACCUM_STEPS={accum}) does not shard over the "
                 f"data axis of size {data_size}; raise TRAIN.BATCH_SIZE or "
                 "lower GRAD_ACCUM_STEPS"
@@ -967,8 +989,7 @@ def check_batch_geometry(mesh, eval_only: bool = False):
 
     if pipe_size > 1:
         eval_global = (
-            cfg.TEST.BATCH_SIZE * jax.local_device_count()
-            * jax.process_count()
+            cfg.TEST.BATCH_SIZE * jax.local_device_count() * n_groups
         )
         eval_per_shard = eval_global // data_size
         # mirrors PipelinedViT's guard: below pipe_mb it falls back to the

@@ -128,3 +128,72 @@ def test_regnet_forward_still_correct():
     )
     m_params, _ = count_parameters(variables["params"])
     assert abs(m_params - 83.590) < 0.01
+
+
+class TestPallasGroupConv:
+    """ops/group_conv.py — the hand-tiled grouped 3×3 kernel (interpret
+    mode on the CPU mesh; the compiled path is exercised on hardware by
+    the PERF.md r5 A/B runs). Exactness vs the unrolled formulation for
+    fwd AND both grads, stride 1 and 2, odd group counts (the bf16
+    sublane-packing case that forced the static in-kernel group loop)."""
+
+    @pytest.mark.parametrize(
+        "shape",
+        [
+            (4, 14, 14, 33, 3, 1),   # odd G
+            (2, 8, 8, 16, 4, 1),
+            (2, 16, 16, 22, 11, 2),  # stride 2, G=11
+            (4, 8, 8, 16, 2, 2),
+        ],
+    )
+    def test_exactness_and_grads(self, shape):
+        from distribuuuu_tpu.ops.group_conv import (
+            _xla_unrolled, group_conv3x3,
+        )
+
+        B, H, W, C, G, s = shape
+        rng = np.random.default_rng(0)
+        x = jnp.asarray(rng.standard_normal((B, H, W, C)), jnp.float32)
+        k = jnp.asarray(
+            rng.standard_normal((3, 3, C // G, C)) * 0.1, jnp.float32
+        )
+        ref = _xla_unrolled(x, k, s, G)
+        got = group_conv3x3(x, k, s, G, True)
+        np.testing.assert_allclose(
+            np.asarray(got), np.asarray(ref), rtol=1e-4, atol=1e-4
+        )
+        g_ref = jax.grad(
+            lambda xx, kk: jnp.sum(_xla_unrolled(xx, kk, s, G) ** 2),
+            argnums=(0, 1),
+        )(x, k)
+        g_got = jax.grad(
+            lambda xx, kk: jnp.sum(group_conv3x3(xx, kk, s, G, True) ** 2),
+            argnums=(0, 1),
+        )(x, k)
+        for a, b in zip(g_got, g_ref):
+            np.testing.assert_allclose(
+                np.asarray(a), np.asarray(b), rtol=1e-4, atol=1e-4
+            )
+
+    def test_convbn_pallas_knob_routes_and_matches(self, monkeypatch):
+        """DISTRIBUUUU_GROUP_CONV=pallas actually takes the kernel path
+        (interpret mode off-TPU) with the SAME canonical param and the
+        same outputs as the default path — a routing-gate regression
+        (e.g. the strides/padding normalization) breaks this."""
+        mod = _conv_bn(groups=4)
+        x = jnp.asarray(
+            np.random.default_rng(0).standard_normal((2, 8, 8, 256)),
+            jnp.float32,
+        )
+        monkeypatch.delenv("DISTRIBUUUU_GROUP_CONV", raising=False)
+        variables = mod.init(jax.random.key(0), x)
+        ref = mod.apply(variables, x)
+        kernel = variables["params"]["Conv_0"]["kernel"]
+        kernel = getattr(kernel, "unbox", lambda: kernel)()
+        assert kernel.shape == (3, 3, 64, 256)
+
+        monkeypatch.setenv("DISTRIBUUUU_GROUP_CONV", "pallas")
+        got = mod.apply(variables, x)  # same variables → same param tree
+        np.testing.assert_allclose(
+            np.asarray(got), np.asarray(ref), rtol=1e-4, atol=1e-4
+        )

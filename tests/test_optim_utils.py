@@ -213,3 +213,60 @@ def test_unknown_optimizer_rejected():
     cfg.OPTIM.OPTIMIZER = "lamb"
     with _pytest.raises(ValueError, match="OPTIM.OPTIMIZER"):
         construct_optimizer()
+
+
+def test_bf16_momentum_dtype_knob(monkeypatch):
+    """OPTIM.MOMENTUM_DTYPE=bfloat16: fp32 master params with a bf16
+    momentum buffer. The accumulator must actually be bf16, params must
+    stay fp32, and the update must track the fp32-momentum update to bf16
+    rounding (measured throughput-flat on the chip — PERF.md r5; the knob
+    is a memory/traffic lever, not a numerics change)."""
+    import distribuuuu_tpu.config as config
+    from distribuuuu_tpu.utils.optim import construct_optimizer
+
+    monkeypatch.delenv("DISTRIBUUUU_MOMENTUM_DTYPE", raising=False)
+    config.reset_cfg()
+    params = {"w": jnp.ones((64, 64), jnp.float32)}
+    grads = {"w": jnp.full((64, 64), 0.01, jnp.float32)}
+
+    def run(dtype_name):
+        config.reset_cfg()
+        cfg.OPTIM.MOMENTUM_DTYPE = dtype_name
+        cfg.OPTIM.BASE_LR = 0.1
+        opt = construct_optimizer()
+        state = opt.init(params)
+        p = params
+        for _ in range(3):
+            updates, state = opt.update(grads, state, p)
+            import optax
+
+            p = optax.apply_updates(p, updates)
+        return p, state
+
+    p32, _ = run("float32")
+    p16, s16 = run("bfloat16")
+    mom_leaves = [
+        x for x in jax.tree.leaves(s16) if hasattr(x, "dtype") and x.ndim == 2
+    ]
+    assert any(x.dtype == jnp.bfloat16 for x in mom_leaves), (
+        [x.dtype for x in mom_leaves]
+    )
+    assert all(
+        leaf.dtype == jnp.float32 for leaf in jax.tree.leaves(p16)
+    )
+    np.testing.assert_allclose(
+        np.asarray(p16["w"]), np.asarray(p32["w"]), rtol=1e-2
+    )
+    config.reset_cfg()
+
+
+def test_momentum_dtype_rejects_unknown(monkeypatch):
+    import distribuuuu_tpu.config as config
+    from distribuuuu_tpu.utils.optim import construct_optimizer
+
+    monkeypatch.delenv("DISTRIBUUUU_MOMENTUM_DTYPE", raising=False)
+    config.reset_cfg()
+    cfg.OPTIM.MOMENTUM_DTYPE = "float16"
+    with pytest.raises(ValueError, match="MOMENTUM_DTYPE"):
+        construct_optimizer()
+    config.reset_cfg()

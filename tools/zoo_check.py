@@ -5,10 +5,14 @@ actually compiles and executes on real hardware (XLA:TPU has its own layout
 and fusion paths). One forward per arch at the configured batch; prints a
 table and exits nonzero if anything fails.
 
-    python tools/zoo_check.py [--batch 8] [--im-size 224] [--train-step]
+    python tools/zoo_check.py [--batch 8] [--im-size 224] [--train-step|--eval-step]
 
 ``--train-step`` runs a full fwd+bwd+update step per arch instead of
-inference forward (slower compile, stronger guarantee).
+inference forward (slower compile, stronger guarantee). ``--eval-step``
+names the default mode explicitly (the compiled masked eval step,
+trainer.make_eval_step — the path validate()/test_model() run, ref:
+trainer.py:176-209): certification output then records which path was
+certified (VERDICT r4 #9).
 """
 
 from __future__ import annotations
@@ -28,6 +32,10 @@ def main():
     ap.add_argument("--batch", type=int, default=8)
     ap.add_argument("--im-size", type=int, default=224)
     ap.add_argument("--train-step", action="store_true")
+    ap.add_argument(
+        "--eval-step", action="store_true",
+        help="explicitly certify the compiled eval step (the default path)",
+    )
     ap.add_argument("--arch", default="", help="comma-separated subset")
     args = ap.parse_args()
 
@@ -40,8 +48,10 @@ def main():
     archs = args.arch.split(",") if args.arch else models.available_models()
     rng = np.random.default_rng(0)
     failures = []
+    if args.train_step and args.eval_step:
+        ap.error("--train-step and --eval-step are mutually exclusive")
     print(f"# devices: {jax.devices()}  mode: "
-          f"{'train-step' if args.train_step else 'forward'}")
+          f"{'train-step' if args.train_step else 'eval-step'}")
     for arch in archs:
         config.reset_cfg()
         cfg.MODEL.ARCH = arch
