@@ -76,9 +76,18 @@ def build_workload(fold: int = 4, per_chip_batch: int = 128):
     # the whole step compilation.
     xla_opts = os.environ.get("DISTRIBUUUU_XLA_OPTS", "")
     if xla_opts:
-        copts = dict(
-            p.split("=", 1) for p in xla_opts.split(";") if "=" in p
-        )
+        copts = {}
+        for p in xla_opts.split(";"):
+            if not p:
+                continue
+            if "=" not in p:
+                # a silently-dropped flag would make a sweep report ~1.00×
+                # for an option that was never applied
+                raise ValueError(
+                    f"DISTRIBUUUU_XLA_OPTS entry {p!r} is not k=v"
+                )
+            k, v = p.split("=", 1)
+            copts[k] = v
         train_step = jax.jit(
             train_step, donate_argnums=0, compiler_options=copts
         )

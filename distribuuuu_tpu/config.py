@@ -303,8 +303,9 @@ _C.DEVICE.PLATFORM = "auto"
 _C.DEVICE.COMPUTE_DTYPE = "bfloat16"
 # Deterministic XLA ops (maps CUDNN.DETERMINISTIC intent onto TPU).
 _C.DEVICE.DETERMINISTIC = False
-# Attention implementation for attention archs. BoTNet: "auto" | "xla" |
-# "pallas" ("auto" resolves per measurement, ops/pallas_attention.use_pallas).
+# Attention implementation for attention archs. BoTNet: "auto" | "xla"
+# (the fused Pallas path for the 196-token grid was retired r5 at 0.854×
+# XLA e2e — PERF.md "BoTNet attention").
 # ViT: "auto" picks the Pallas flash kernel (ops/flash_attention.py) for
 # sequences ≥1024 tokens WHEN dropout is 0 (the kernel has no
 # probability-dropout; with dropout>0 auto stays on dense XLA — at long

@@ -28,7 +28,7 @@ from distribuuuu_tpu.models.layers import (
     max_pool_3x3_s2,
 )
 from distribuuuu_tpu.models.resnet import Bottleneck
-from distribuuuu_tpu.ops import attention as att_ops, pallas_attention
+from distribuuuu_tpu.ops import attention as att_ops
 
 
 class MHSA2D(nn.Module):
@@ -40,8 +40,12 @@ class MHSA2D(nn.Module):
     dim_qk: int = 128
     dim_v: int = 128
     rel_pos_emb: bool = True
-    # auto | pallas | xla — "auto" picks the measured winner per shape (XLA
-    # for this 196-token grid; see ops/pallas_attention.use_pallas).
+    # auto | xla. The r1-r4 fused Pallas kernel for this grid was RETIRED
+    # in r5 after a final paired e2e run measured it at 0.854× XLA
+    # (PERF.md "BoTNet attention"): at 196 tokens the logits tile is small
+    # enough that XLA's einsum+softmax fusion wins, and custom-call
+    # boundaries cost more than they save. The long-sequence flash kernel
+    # (ops/flash_attention.py, ViT ≥1024 tokens) is unaffected.
     attn_impl: str = "auto"
     dtype: Any = jnp.bfloat16
 
@@ -84,10 +88,13 @@ class MHSA2D(nn.Module):
             emb_w = self.param("emb_width", init, (w, dqk), jnp.float32)
             pos = att_ops.abs_pos_logits((q * scale).astype(jnp.float32), emb_h, emb_w)
 
-        if pallas_attention.use_pallas(self.attn_impl):
-            out = pallas_attention.mhsa_2d_fused(q, k, v, pos, scale)
-        else:
-            out = att_ops.mhsa_2d(q, k, v, pos, scale)
+        if self.attn_impl not in ("auto", "xla"):
+            raise ValueError(
+                f"attn_impl={self.attn_impl!r}: botnet accepts 'auto'/'xla' "
+                "— the fused Pallas path for the 196-token grid was retired "
+                "in r5 (measured 0.854× XLA e2e, PERF.md)"
+            )
+        out = att_ops.mhsa_2d(q, k, v, pos, scale)
         # [B, N, HW, dv] -> NHWC
         return out.transpose(0, 2, 1, 3).reshape(b, h, w, n * dv)
 

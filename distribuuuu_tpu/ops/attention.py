@@ -5,8 +5,9 @@ distribuuuu/models/botnet.py:25-98,163-215 — the Shaw/Ramachandran
 relative-position scheme of arXiv:1803.02155 / 1904.09925), re-derived in
 jit-friendly jax: static shapes, no device-specific allocations (the
 reference hardcodes ``.cuda()`` pads, botnet.py:33,36), and a layout that
-XLA fuses cleanly on TPU. A fused Pallas kernel can swap in under the same
-signature (see ops/pallas_attention.py).
+XLA fuses cleanly on TPU. (A fused Pallas kernel under this signature was
+tried r1-r4 and retired r5 at 0.854× XLA e2e on the 196-token grid —
+PERF.md "BoTNet attention".)
 """
 
 from __future__ import annotations

@@ -58,6 +58,7 @@ def test_remat_off_matches_remat_on():
     np.testing.assert_allclose(np.asarray(a), np.asarray(b), rtol=1e-6)
 
 
+@pytest.mark.slow
 def test_vit_blockwise_matches_xla_impl():
     """Same weights, attn_impl xla vs blockwise → same logits (and the
     DEVICE.ATTN_IMPL wiring reaches the model)."""

@@ -202,6 +202,7 @@ def test_auto_resolution_threshold():
     assert Attention.resolve_impl("blockwise", 64, 0.0) == "blockwise"
 
 
+@pytest.mark.slow
 def test_vit_auto_resolves_by_length():
     """Through the real model: a ≥1024-token input drives the auto→flash
     branch (CPU fallback executes the blockwise math), a 64-token input

@@ -214,6 +214,7 @@ def test_trainer_honors_syncbn_flag():
     assert model.bn_group == 0
 
 
+@pytest.mark.slow
 def test_resnet18_trains_with_ghost_bn():
     """End-to-end: one jitted train step with ghost groups ≠ one with
     global stats (same init, same batch) — the flag reaches the graph."""

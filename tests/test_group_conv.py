@@ -142,7 +142,10 @@ class TestPallasGroupConv:
         [
             (4, 14, 14, 33, 3, 1),   # odd G
             (2, 8, 8, 16, 4, 1),
-            (2, 16, 16, 22, 11, 2),  # stride 2, G=11
+            pytest.param(
+                (2, 16, 16, 22, 11, 2),  # stride 2, G=11
+                marks=pytest.mark.slow,  # 17s interpret run
+            ),
             (4, 8, 8, 16, 2, 2),
         ],
     )

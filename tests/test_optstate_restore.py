@@ -15,6 +15,8 @@ import numpy as np
 import jax
 import pytest
 
+pytestmark = pytest.mark.slow  # orbax save/restore cycles, ~45s each on this box
+
 import distribuuuu_tpu.config as config
 from distribuuuu_tpu.config import cfg
 from distribuuuu_tpu import trainer

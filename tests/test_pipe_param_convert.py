@@ -6,6 +6,7 @@ identical, logits identical.
 """
 
 import numpy as np
+import pytest
 import jax
 import jax.numpy as jnp
 
@@ -16,6 +17,7 @@ HP = dict(num_classes=10, dtype=jnp.float32, patch=8, dim=32, depth=4,
           num_heads=2)
 
 
+@pytest.mark.slow
 def test_pipe_params_load_into_flat_vit():
     pipe = models.build_model("vit_tiny", pipe_stages=2, **HP)
     flat = models.build_model("vit_tiny", **HP)

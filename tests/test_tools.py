@@ -30,6 +30,7 @@ def test_zoo_check_single_arch():
     assert "1/1 archs passed" in out.stdout
 
 
+@pytest.mark.slow
 def test_zoo_check_reports_failure():
     out = _run(
         ["tools/zoo_check.py", "--arch", "nosuch_arch", "--batch", "2",

@@ -160,6 +160,7 @@ def test_zero1_shards_optimizer_state_not_params():
         assert leaf.addressable_shards[0].data.size == leaf.size
 
 
+@pytest.mark.slow
 def test_zero3_shards_params_too():
     _, _, state, _ = _setup(stage=3)
     n_dev = jax.device_count()
@@ -173,6 +174,7 @@ def test_zero3_shards_params_too():
         assert leaf.addressable_shards[0].data.size == leaf.size
 
 
+@pytest.mark.slow
 def test_zero1_adamw_shards_both_moments():
     _, _, state, _ = _setup(stage=1, optimizer_kind="adamw")
     n_dev = jax.device_count()
@@ -187,6 +189,7 @@ def test_zero1_adamw_shards_both_moments():
         assert leaf.addressable_shards[0].data.size == leaf.size // n_dev
 
 
+@pytest.mark.slow
 def test_zero_composes_with_tp():
     mesh, _, state, _ = _setup(stage=1, model_axis=2)
     found_both = 0
@@ -202,6 +205,7 @@ def test_zero_composes_with_tp():
 # ---------------------------------------------------------- trajectory level
 
 
+@pytest.mark.slow
 def test_zero_trajectories_match_ddp_layout():
     """Stages 0/1/3 run the same math — layout only. Step-0 loss is
     pre-update (identical init), later steps bound by reduction-order
@@ -219,6 +223,7 @@ def test_zero_trajectories_match_ddp_layout():
         assert abs(traj[2] - base[2]) < 0.5, (stage, traj[2], base[2])
 
 
+@pytest.mark.slow
 def test_zero3_eval_step_works_on_sharded_params():
     mesh, model, state, _ = _setup(stage=3)
     eval_step = trainer.make_eval_step(model, topk=5)
@@ -228,6 +233,7 @@ def test_zero3_eval_step_works_on_sharded_params():
     assert np.isfinite(float(m["loss_sum"]))
 
 
+@pytest.mark.slow
 def test_zero_checkpoint_roundtrip(tmp_path):
     """Save at stage 1, restore through the template-driven placement
     (trainer._place_like): values equal, rest layout preserved."""

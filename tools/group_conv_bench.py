@@ -52,18 +52,10 @@ def conv_fused(x, k, stride, groups):
 
 
 def conv_unrolled(x, k, stride, groups):
-    cg = x.shape[-1] // groups
-    fg = k.shape[-1] // groups
-    outs = [
-        jax.lax.conv_general_dilated(
-            x[..., g * cg:(g + 1) * cg],
-            k[..., g * fg:(g + 1) * fg],
-            (stride, stride), [(1, 1), (1, 1)],
-            dimension_numbers=("NHWC", "HWIO", "NHWC"),
-        )
-        for g in range(groups)
-    ]
-    return jnp.concatenate(outs, axis=-1)
+    # the exactness oracle IS the library formulation — one source of truth
+    from distribuuuu_tpu.ops.group_conv import _xla_unrolled
+
+    return _xla_unrolled(x, k, stride, groups)
 
 
 def conv_shifted(x, k, stride, groups):
@@ -130,9 +122,12 @@ def main():
 
         # Timing MUST fence on a value fetch of a scalar derived from the
         # output: block_until_ready returns early on tunneled transports
-        # (bench.py "fence"); a naive loop here measures dispatch, not
-        # compute. Each window also FEEDS the previous output back into
-        # the input so no call can be elided or overlapped trivially.
+        # (bench.py "fence"). Iterations dispatch asynchronously against
+        # constant inputs and the final scalar fetch drains the in-order
+        # device queue — these are pipelined-throughput figures, and on
+        # this tunnel they additionally sit on a ~4-5 ms/call dispatch
+        # floor; the LOAD-BEARING comparisons use the marginal-cost
+        # harness instead (PERF.md r5 "Grouped convs").
         scalar = jax.jit(lambda o: jnp.sum(o.astype(jnp.float32)))
 
         fns = {}

@@ -47,7 +47,10 @@ def run_cli(tree, out, args, backend):
         "TRAIN.WORKERS", str(args.workers),
         "TRAIN.PRINT_FREQ", "4",
         "OPTIM.MAX_EPOCH", str(args.epochs),
-        "OPTIM.BASE_LR", str(args.lr), "OPTIM.WARMUP_EPOCHS", "0",
+        "OPTIM.BASE_LR", str(args.lr),
+        # linear warmup stabilizes the early high-LR epochs (VERDICT r4
+        # #6: the r4 curve collapsed 25 points mid-run with no warmup)
+        "OPTIM.WARMUP_EPOCHS", str(args.warmup_epochs),
         "DATA.BACKEND", backend,
         "DATA.DEVICE_NORMALIZE", str(bool(args.device_normalize)),
         "RNG_SEED", "1",
@@ -118,6 +121,9 @@ def main():
     # conservative default for a ~30-step from-scratch run with no warmup
     # (the linear-scaled 0.05 for batch 64 diverges in the first steps)
     ap.add_argument("--lr", type=float, default=0.0125)
+    ap.add_argument("--warmup-epochs", type=int, default=2,
+                    help="OPTIM.WARMUP_EPOCHS for the recipe (default 2; "
+                         "the framework's warmup ramp, utils/schedules.py)")
     ap.add_argument("--min-size", type=int, default=256,
                     help="source JPEG shorter bound")
     ap.add_argument("--max-size", type=int, default=320)
@@ -200,6 +206,7 @@ def main():
         "hue_jitter": args.hue_jitter,
         "arch": args.arch, "im_size": args.im_size,
         "epochs": args.epochs, "lr": args.lr,
+        "warmup_epochs": args.warmup_epochs,
         "note": "decode-bound on this 1-core host; see PERF.md",
     }))
 
