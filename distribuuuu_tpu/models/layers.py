@@ -401,7 +401,12 @@ class _BNCore(nn.Module):
             count = n * spatial
             mean_upd, var_upd = mean, var * count / max(count - 1, 1)
         if not self.is_initializing():
-            m = self.momentum
+            # DISTRIBUUUU_BN_MOMENTUM (trace-time, like _BN_VARIANCE):
+            # overrides EVERY BN layer's running-stats decay — a bench/
+            # experiment knob (the r5 eval-wobble investigation, PERF.md);
+            # unset ⇒ each module's own momentum (torch parity)
+            m = float(os.environ.get("DISTRIBUUUU_BN_MOMENTUM",
+                                     self.momentum))
             # cast back to the stored (fp32) dtype: under promoted-f64
             # stats the update expression is f64 and must not change the
             # batch_stats tree's dtype between steps

@@ -1054,13 +1054,15 @@ def train_model():
         state = _with_restored_weights(state, cfg.MODEL.WEIGHTS, model)
         logger.info("warm-started from pretrained weights %s", cfg.MODEL.WEIGHTS)
     elif cfg.MODEL.PRETRAINED:
-        # The reference downloads zoo weights on PRETRAINED=True; offline, a
-        # weights file is required — refuse rather than silently train from
-        # random init.
-        raise ValueError(
-            "MODEL.PRETRAINED True needs MODEL.WEIGHTS pointing at a weights "
-            "file (torch .pth or orbax dir); there is no URL zoo offline"
-        )
+        # The reference downloads zoo weights on PRETRAINED=True
+        # (ref: resnet.py:23-33). Connectivity-guarded equivalent: fetch
+        # from the URL zoo when reachable; otherwise raise the actionable
+        # offline error rather than silently train from random init.
+        from distribuuuu_tpu.utils import url_zoo
+
+        path = url_zoo.fetch(cfg.MODEL.ARCH)  # raises offline / unknown
+        state = _with_restored_weights(state, path, model)
+        logger.info("warm-started from pretrained URL zoo: %s", path)
     elif cfg.MODEL.WEIGHTS:
         logger.warning(
             "MODEL.WEIGHTS is ignored during training unless "

@@ -87,6 +87,10 @@ def main():
     ap.add_argument("--batch-size", type=int, default=32)
     ap.add_argument("--im-size", type=int, default=224)
     ap.add_argument("--workers", type=int, default=os.cpu_count() or 4)
+    ap.add_argument("--sweep-workers", default="",
+                    help="comma list (e.g. 1,2,4,8): decode-thread scaling "
+                         "curve per backend over one shared corpus "
+                         "(VERDICT r4 #7)")
     args = ap.parse_args()
 
     from distribuuuu_tpu import native
@@ -106,23 +110,39 @@ def main():
     backends = ["pil"] + (["native"] if native.available() else [])
     if "native" not in backends:
         print(f"# native backend unavailable: {native.build_error()}")
+    if args.sweep_workers:
+        try:
+            worker_counts = [
+                int(w) for w in args.sweep_workers.split(",") if w.strip()
+            ]
+        except ValueError:
+            ap.error(f"--sweep-workers {args.sweep_workers!r}: "
+                     "expected a comma list of ints (e.g. 1,2,4,8)")
+        if not worker_counts:
+            ap.error("--sweep-workers: no worker counts given")
+    else:
+        worker_counts = [args.workers]
     results = {}
     for b in backends:
-        results[b] = bench_backend(
-            root, b, args.epochs, args.im_size, args.workers, args.batch_size
-        )
-        print(
-            json.dumps(
-                {
-                    "metric": f"input_pipeline_{b}_images_per_sec",
-                    "value": round(results[b], 1),
-                    "unit": "images/sec",
-                    "workers": args.workers,
-                }
+        for w in worker_counts:
+            results[(b, w)] = bench_backend(
+                root, b, args.epochs, args.im_size, w, args.batch_size
             )
-        )
-    if len(results) == 2:
-        print(f"# native speedup over PIL: {results['native'] / results['pil']:.2f}x")
+            print(
+                json.dumps(
+                    {
+                        "metric": f"input_pipeline_{b}_images_per_sec",
+                        "value": round(results[(b, w)], 1),
+                        "unit": "images/sec",
+                        "workers": w,
+                    }
+                ),
+                flush=True,
+            )
+    if len(backends) == 2:
+        for w in worker_counts:
+            print(f"# workers={w}: native speedup over PIL "
+                  f"{results[('native', w)] / results[('pil', w)]:.2f}x")
 
 
 if __name__ == "__main__":

@@ -56,9 +56,13 @@ def run_cli(tree, out, args, backend):
         "RNG_SEED", "1",
         "OUT_DIR", out,
     ]
+    env = dict(os.environ)
+    if args.bn_momentum > 0:
+        env["DISTRIBUUUU_BN_MOMENTUM"] = str(args.bn_momentum)
     t0 = time.perf_counter()
     proc = subprocess.run(
-        cmd, capture_output=True, text=True, timeout=3600, cwd=REPO
+        cmd, capture_output=True, text=True, timeout=3600, cwd=REPO,
+        env=env,
     )
     wall = time.perf_counter() - t0
     if proc.returncode != 0:
@@ -121,9 +125,14 @@ def main():
     # conservative default for a ~30-step from-scratch run with no warmup
     # (the linear-scaled 0.05 for batch 64 diverges in the first steps)
     ap.add_argument("--lr", type=float, default=0.0125)
-    ap.add_argument("--warmup-epochs", type=int, default=2,
-                    help="OPTIM.WARMUP_EPOCHS for the recipe (default 2; "
-                         "the framework's warmup ramp, utils/schedules.py)")
+    ap.add_argument("--warmup-epochs", type=int, default=-1,
+                    help="OPTIM.WARMUP_EPOCHS for the recipe. Default -1 "
+                         "= min(2, epochs//2), so short smoke runs are "
+                         "not spent entirely inside the warmup ramp")
+    ap.add_argument("--bn-momentum", type=float, default=0.0,
+                    help="if >0, DISTRIBUUUU_BN_MOMENTUM for the run — "
+                         "faster-tracking running stats for eval stability "
+                         "at high LR (0 = torch-parity 0.9)")
     ap.add_argument("--min-size", type=int, default=256,
                     help="source JPEG shorter bound")
     ap.add_argument("--max-size", type=int, default=320)
@@ -140,6 +149,8 @@ def main():
     ap.add_argument("--out", default="/tmp/realdata_bench")
     ap.add_argument("--tree", default="/tmp/distribuuuu_synth_rd")
     args = ap.parse_args()
+    if args.warmup_epochs < 0:
+        args.warmup_epochs = min(2, args.epochs // 2)
 
     from tools.make_imagefolder import make_tree
 
@@ -207,6 +218,7 @@ def main():
         "arch": args.arch, "im_size": args.im_size,
         "epochs": args.epochs, "lr": args.lr,
         "warmup_epochs": args.warmup_epochs,
+        "bn_momentum": args.bn_momentum or 0.9,
         "note": "decode-bound on this 1-core host; see PERF.md",
     }))
 
