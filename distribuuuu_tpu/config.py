@@ -199,6 +199,10 @@ _C.MODEL.SYNCBN = False
 # Ghost-BN group size when SYNCBN is False. 0 ⇒ TRAIN.BATCH_SIZE (the
 # per-chip batch — exactly the reference's per-GPU BN batch). Must divide
 # the (micro-)batch each training forward sees.
+# (Running-stats decay is per-module — torch-parity 0.9; the trace-time
+# env knob DISTRIBUUUU_BN_MOMENTUM overrides it globally for eval-
+# stability experiments, PERF.md r5 "stabilizing the convergence
+# artifact".)
 _C.MODEL.BN_GROUP = 0
 _C.MODEL.WEIGHTS = None
 # Use randomly generated fake data (no dataset on disk needed).

@@ -79,7 +79,7 @@ def fetch(arch: str) -> str:
             f"only); point MODEL.WEIGHTS at a local weights file instead"
         )
     dest = os.path.join(cache_dir(), os.path.basename(url))
-    if os.path.exists(dest):
+    if os.path.exists(dest) and _digest_ok(dest, url):
         return dest
     if not _online():
         raise ValueError(
@@ -88,12 +88,52 @@ def fetch(arch: str) -> str:
             f"zoo at {url} is unreachable from this environment"
         )
     os.makedirs(cache_dir(), exist_ok=True)
-    tmp = dest + ".part"
-    with urllib.request.urlopen(url, timeout=60) as r, open(tmp, "wb") as f:
-        while True:
-            chunk = r.read(1 << 20)
-            if not chunk:
-                break
-            f.write(chunk)
-    os.replace(tmp, dest)  # atomic: no truncated cache on interrupt
+    # per-process temp name: every process of a multi-host run may fetch
+    # concurrently (trainer loads weights on all ranks); each writes its
+    # own complete file and the atomic replace makes last-writer-wins
+    # correct, never interleaved
+    tmp = f"{dest}.part.{os.getpid()}"
+    try:
+        with urllib.request.urlopen(url, timeout=60) as r, \
+                open(tmp, "wb") as f:
+            while True:
+                chunk = r.read(1 << 20)
+                if not chunk:
+                    break
+                f.write(chunk)
+        if not _digest_ok(tmp, url):
+            raise ValueError(
+                f"pretrained download {url} failed its checksum (the "
+                "torchvision filename embeds the expected sha256 prefix); "
+                "truncated or corrupted transfer"
+            )
+        os.replace(tmp, dest)  # atomic: no truncated cache on interrupt
+    except ValueError:
+        raise
+    except Exception as e:  # noqa: BLE001 — keep the documented contract
+        raise ValueError(
+            f"MODEL.PRETRAINED True: downloading {url} failed ({e}); "
+            "point MODEL.WEIGHTS at a local weights file instead"
+        ) from e
+    finally:
+        if os.path.exists(tmp):
+            os.unlink(tmp)
     return dest
+
+
+def _digest_ok(path: str, url: str) -> bool:
+    """torchvision filenames embed the first 8 hex chars of the file's
+    sha256 (``resnet50-19c8e357.pth``) — the same digest torch.hub
+    verifies (ref: models/utils.py:1-4). A cache entry that fails it
+    (truncated write, tampering) is re-downloaded rather than served."""
+    import hashlib
+    import re
+
+    m = re.search(r"-([0-9a-f]{8})\.pth$", os.path.basename(url))
+    if not m:
+        return True  # no embedded digest to check against
+    h = hashlib.sha256()
+    with open(path, "rb") as f:
+        for chunk in iter(lambda: f.read(1 << 20), b""):
+            h.update(chunk)
+    return h.hexdigest().startswith(m.group(1))

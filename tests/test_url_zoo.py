@@ -45,6 +45,7 @@ def test_download_and_cache(tmp_cache, monkeypatch):
         return FakeResponse(payload)
 
     monkeypatch.setattr(url_zoo, "_online", lambda: True)
+    monkeypatch.setattr(url_zoo, "_digest_ok", lambda *a: True)
     monkeypatch.setattr(
         url_zoo.urllib.request, "urlopen", fake_urlopen
     )
@@ -60,10 +61,16 @@ def test_download_and_cache(tmp_cache, monkeypatch):
     assert calls == []
 
 
-def test_real_probe_is_offline_here():
-    """This environment has zero egress: the real probe must say offline
-    (and complete within its timeout rather than hanging)."""
-    assert url_zoo._online() is False
+def test_real_probe_terminates():
+    """The real probe must return a bool within its timeout on ANY host —
+    offline (this zero-egress build environment) or online (a developer
+    laptop) — rather than hanging or raising."""
+    import time
+
+    t0 = time.monotonic()
+    result = url_zoo._online()
+    assert isinstance(result, bool)
+    assert time.monotonic() - t0 < url_zoo._PROBE_TIMEOUT_S + 5
 
 
 def test_every_zoo_arch_is_registered():
@@ -71,3 +78,44 @@ def test_every_zoo_arch_is_registered():
 
     for arch in url_zoo.MODEL_URLS:
         assert arch in models.available_models(), arch
+
+
+def test_digest_check(tmp_path):
+    """_digest_ok verifies the sha256 prefix torchvision embeds in the
+    filename; a truncated/corrupted file is rejected."""
+    import hashlib
+
+    p = tmp_path / "w.bin"
+    p.write_bytes(b"weights-payload")
+    good = hashlib.sha256(b"weights-payload").hexdigest()[:8]
+    assert url_zoo._digest_ok(str(p), f"https://x/model-{good}.pth")
+    assert not url_zoo._digest_ok(str(p), "https://x/model-00000000.pth")
+    # no embedded digest -> accepted
+    assert url_zoo._digest_ok(str(p), "https://x/model.pth")
+
+
+def test_download_failing_digest_raises(tmp_cache, monkeypatch):
+    import io
+
+    class FakeResponse(io.BytesIO):
+        def __enter__(self):
+            return self
+
+        def __exit__(self, *a):
+            return False
+
+    monkeypatch.setattr(url_zoo, "_online", lambda: True)
+    monkeypatch.setattr(
+        url_zoo.urllib.request, "urlopen",
+        lambda url, timeout=None: FakeResponse(b"truncated"),
+    )
+    with pytest.raises(ValueError, match="checksum"):
+        url_zoo.fetch("resnet18")
+    # no partial/corrupt file installed in the cache
+    import os as _os
+
+    assert not any(
+        f for f in (_os.listdir(url_zoo.cache_dir())
+                    if _os.path.isdir(url_zoo.cache_dir()) else [])
+        if not f.endswith(".part")
+    )

@@ -59,6 +59,10 @@ def run_cli(tree, out, args, backend):
     env = dict(os.environ)
     if args.bn_momentum > 0:
         env["DISTRIBUUUU_BN_MOMENTUM"] = str(args.bn_momentum)
+    else:
+        # an ambient knob from a previous experiment must not silently
+        # contradict the bn_momentum the result JSON records
+        env.pop("DISTRIBUUUU_BN_MOMENTUM", None)
     t0 = time.perf_counter()
     proc = subprocess.run(
         cmd, capture_output=True, text=True, timeout=3600, cwd=REPO,
