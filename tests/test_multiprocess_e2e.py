@@ -63,16 +63,12 @@ def _free_port() -> int:
         return s.getsockname()[1]
 
 
-def _spawn_workers(tmp_path, extra_args=(), nprocs=2, ndev=4, run_tag=""):
-    """Spawn ``nprocs`` workers (each a JAX process with ``ndev`` virtual
-    CPU devices) and return their collected outputs."""
-    out_dir = str(tmp_path / "run")
-    script = tmp_path / "worker.py"
-    script.write_text(WORKER)
+def _launch_group(tmp_path, script, script_args, nprocs, ndev, log_name):
+    """The one launcher env/Popen contract (torch-launcher-style env →
+    setup_distributed): every multi-process test goes through here.
+    Worker output goes to files, not pipes: a full 64KB pipe would block
+    a rank mid-collective and deadlock the group."""
     port = _free_port()  # avoid collisions with concurrent runs
-
-    # Worker output goes to files, not pipes: a full 64KB pipe would block a
-    # rank mid-collective and deadlock the group.
     procs, logs = [], []
     for rank in range(nprocs):
         env = dict(os.environ)
@@ -87,15 +83,28 @@ def _spawn_workers(tmp_path, extra_args=(), nprocs=2, ndev=4, run_tag=""):
             # on its sys.path (script dir ≠ cwd); put the package in reach
             PYTHONPATH=REPO + os.pathsep + os.environ.get("PYTHONPATH", ""),
         )
-        log = open(tmp_path / f"rank{rank}{run_tag}.log", "w+")
+        log = open(tmp_path / log_name(rank, port), "w+")
         logs.append(log)
         procs.append(
             subprocess.Popen(
-                [sys.executable, str(script), out_dir, *extra_args],
+                [sys.executable, str(script), *script_args],
                 env=env, stdout=log, stderr=subprocess.STDOUT,
                 text=True, cwd=REPO,
             )
         )
+    return procs, logs
+
+
+def _spawn_workers(tmp_path, extra_args=(), nprocs=2, ndev=4, run_tag=""):
+    """Spawn ``nprocs`` workers (each a JAX process with ``ndev`` virtual
+    CPU devices) and return their collected outputs."""
+    out_dir = str(tmp_path / "run")
+    script = tmp_path / "worker.py"
+    script.write_text(WORKER)
+    procs, logs = _launch_group(
+        tmp_path, script, (out_dir, *extra_args), nprocs, ndev,
+        lambda rank, port: f"rank{rank}{run_tag}.log",
+    )
     outs = []
     for p, log in zip(procs, logs):
         p.wait(timeout=900)
@@ -144,7 +153,9 @@ WORKER_PREEMPT = """
 import os, sys
 os.environ["JAX_PLATFORMS"] = "cpu"
 os.environ["XLA_FLAGS"] = (
-    os.environ.get("XLA_FLAGS", "") + " --xla_force_host_platform_device_count=4"
+    os.environ.get("XLA_FLAGS", "")
+    + " --xla_force_host_platform_device_count="
+    + os.environ.get("DTPU_TEST_NDEV", "4")
 ).strip()
 import jax
 jax.config.update("jax_platforms", "cpu")
@@ -162,7 +173,7 @@ cfg.TRAIN.DATASET = tree
 cfg.TEST.DATASET = tree
 cfg.TRAIN.IM_SIZE = 32
 cfg.TEST.IM_SIZE = 48
-cfg.TRAIN.BATCH_SIZE = 2   # ×4 devices = 8/host; 256 imgs / 2 procs → 16 b/ep
+cfg.TRAIN.BATCH_SIZE = 2   # per chip; 256 imgs / (2·ndev·nprocs) = 16 b/ep for both drill geometries
 cfg.TEST.BATCH_SIZE = 4
 cfg.TRAIN.WORKERS = 2
 cfg.TRAIN.PRINT_FREQ = 1   # log every batch: the parent triggers on these
@@ -179,15 +190,17 @@ print(f"WORKER_DONE rank={jax.process_index()} best={best}", flush=True)
 """
 
 
-@pytest.mark.slow
-def test_two_process_preemption_drill(tmp_path):
-    """SIGTERM exactly ONE of 2 processes mid-epoch: the cross-process flag
-    agreement (utils/preempt.requested_global's process_allgather branch)
-    must bring BOTH ranks to the collective preempt save — one
-    ``preempt_ep_*`` checkpoint, no hang — and a 2-process resume must
-    complete the run and prune the preempt checkpoint (VERDICT r2 #4).
-    This is the only test where the every-8th-window multi-host throttle
-    (trainer.train_epoch) executes with real processes."""
+def _preempt_drill(tmp_path, nprocs, ndev):
+    """SIGTERM exactly ONE of ``nprocs`` processes mid-epoch: the
+    cross-process flag agreement (utils/preempt.requested_global's
+    process_allgather branch) must bring EVERY rank to the collective
+    preempt save — one ``preempt_ep_*`` checkpoint, no hang — and an
+    ``nprocs``-process resume must complete the run and prune the preempt
+    checkpoint (VERDICT r2 #4). The only tests where the every-8th-window
+    multi-host throttle (trainer.train_epoch) executes with real
+    processes. Geometry: per-host batch 2×ndev; nprocs×ndev devices ⇒
+    256 imgs / (2·ndev·nprocs) batches per epoch — callers keep this at
+    16 so the kill window and the batch-8 agreement site line up."""
     import signal
     import time
 
@@ -205,28 +218,10 @@ def test_two_process_preemption_drill(tmp_path):
     ckpt_dir = os.path.join(out_dir, "checkpoints")
 
     def spawn():
-        port = _free_port()
-        procs, logs = [], []
-        for rank in range(2):
-            env = dict(os.environ)
-            env.pop("JAX_PLATFORMS", None)
-            env.update(
-                MASTER_ADDR="127.0.0.1",
-                COORDINATOR_PORT=str(port),
-                WORLD_SIZE="2",
-                RANK=str(rank),
-                PYTHONPATH=REPO + os.pathsep + os.environ.get("PYTHONPATH", ""),
-            )
-            log = open(tmp_path / f"p{rank}_{port}.log", "w+")
-            logs.append(log)
-            procs.append(
-                subprocess.Popen(
-                    [sys.executable, str(script), out_dir, tree],
-                    env=env, stdout=log, stderr=subprocess.STDOUT,
-                    text=True, cwd=REPO,
-                )
-            )
-        return procs, logs
+        return _launch_group(
+            tmp_path, script, (out_dir, tree), nprocs, ndev,
+            lambda rank, port: f"p{rank}_{port}.log",
+        )
 
     def finish(procs, logs):
         outs = []
@@ -272,6 +267,19 @@ def test_two_process_preemption_drill(tmp_path):
     assert re.search(r"resumed from .*preempt_ep_000", outs[0]), outs[0][-2000:]
     entries = sorted(os.listdir(ckpt_dir))
     assert entries == ["best", "ckpt_ep_000", "ckpt_ep_001"], entries
+
+
+@pytest.mark.slow
+def test_two_process_preemption_drill(tmp_path):
+    _preempt_drill(tmp_path, nprocs=2, ndev=4)
+
+
+@pytest.mark.slow
+def test_four_process_preemption_drill(tmp_path):
+    """4-way agreement: one SIGTERM among 4 ranks must still converge all
+    four to the same collective save (r5 — the 2-process drill cannot
+    distinguish pairwise agreement from group agreement)."""
+    _preempt_drill(tmp_path, nprocs=4, ndev=2)
 
 
 @pytest.mark.slow
