@@ -114,6 +114,7 @@ def batched_setup():
     return params, x, mesh
 
 
+@pytest.mark.slow  # heavy numeric sweep; dispatch exactness also pinned in slow tier
 def test_dispatch_batched_matches_partial_at_ample_capacity(batched_setup):
     params, x, mesh = batched_setup
     want = moe.moe_ffn_partial_batched(params, x, mesh=mesh, top_k=2)

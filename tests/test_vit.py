@@ -97,6 +97,7 @@ def test_vit_rejects_bad_attn_impl_and_seq_dropout():
         m.init(jax.random.key(0), jnp.ones((1, 32, 32, 3)), train=False)
 
 
+@pytest.mark.slow  # ~9s compile; the PP/EP slow tests retrace this path
 def test_vit_trains_through_framework_step():
     config.reset_cfg()
     cfg.MODEL.ARCH = "vit_tiny"
