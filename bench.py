@@ -3,7 +3,9 @@
 Runs the framework's actual jitted train step (fwd + CE + bwd + SGD-nesterov
 update + in-graph metrics, bf16 compute / fp32 params) on synthetic ImageNet
 shapes, steady-state, on however many chips are attached, and prints ONE JSON
-line.
+line. Also times the EVAL step (``build_eval_workload`` — the forward
+test_model and the serving engine run); its ``eval_images_per_sec_per_chip``
+is the per-replica serving throughput ceiling.
 
 ``vs_baseline``: the reference publishes no throughput numbers
 (SURVEY.md §6), so the denominator is the widely-reproduced ~400 img/s/GPU
@@ -137,6 +139,58 @@ def build_workload(fold: int = 4, per_chip_batch: int = 128):
     return window, meta
 
 
+def build_eval_workload(per_chip_batch: int = 128):
+    """Compiled+warmed EVAL step (trainer.make_eval_step — the exact
+    forward validate()/test_model() and the serving engine run).
+
+    The resulting img/s/chip is the serving engine's single-batch
+    ceiling: one replica cannot exceed it at full batch occupancy
+    (tools/serve_bench.py measures how close dynamic batching gets).
+    Same ``window(iters) -> seconds`` contract as ``build_workload``.
+    """
+    import jax
+    import numpy as np
+
+    import distribuuuu_tpu.config as config
+    from distribuuuu_tpu import trainer
+    from distribuuuu_tpu.config import cfg
+    from distribuuuu_tpu.parallel import mesh as mesh_lib, sharding as sharding_lib
+
+    config.reset_cfg()
+    cfg.MODEL.ARCH = os.environ.get("DISTRIBUUUU_BENCH_ARCH", "resnet50")
+    cfg.MODEL.NUM_CLASSES = 1000
+    n_chips = len(jax.devices())
+    batch = per_chip_batch * n_chips
+
+    mesh = mesh_lib.build_mesh()
+    model = trainer.build_model_from_cfg()
+    state = trainer.create_train_state(model, jax.random.key(0), mesh, 224)
+    eval_step = trainer.make_eval_step(model, topk=5)
+
+    rng = np.random.default_rng(0)
+    gbatch = sharding_lib.shard_batch(mesh, {
+        "image": rng.standard_normal((batch, 224, 224, 3)).astype(np.float32),
+        "label": rng.integers(0, 1000, size=(batch,)).astype(np.int32),
+        "mask": np.ones((batch,), np.float32),
+    })
+
+    def window(iters: int) -> float:
+        t0 = time.perf_counter()
+        m = None
+        for _ in range(iters):
+            m = eval_step(state, gbatch)
+        # value fetch of the last step's metrics = the dispatch fence
+        # (same reliable-sync rationale as the train window)
+        float(m["loss_sum"])
+        return time.perf_counter() - t0
+
+    window(1)
+    window(3)
+    meta = {"n_chips": n_chips, "batch": batch,
+            "per_chip_batch": per_chip_batch}
+    return window, meta
+
+
 def main():
     import jax
 
@@ -172,6 +226,20 @@ def main():
         out["mfu"] = round(
             img_per_sec_per_chip * RESNET50_TRAIN_FLOPS_PER_IMG / peak, 4
         )
+
+    # eval path (VERDICT r5 item 5): the inference forward test_model and
+    # the serving engine run — its img/s/chip is serving's per-replica
+    # throughput ceiling (PERF.md zoo table, eval column).
+    eval_window, eval_meta = build_eval_workload(per_chip_batch=128)
+    eval_iters = 10
+    eval_dt = min(eval_window(eval_iters) for _ in range(3))
+    eval_img_per_sec = eval_meta["batch"] * eval_iters / eval_dt
+    out["eval_images_per_sec_per_chip"] = round(
+        eval_img_per_sec / eval_meta["n_chips"], 2
+    )
+    out["eval_batch_ms"] = round(
+        eval_dt / eval_iters * 1e3, 2
+    )
     print(json.dumps(out))
 
 

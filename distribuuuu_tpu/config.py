@@ -361,6 +361,31 @@ _C.DATA.BACKEND = "auto"
 # host-normalized float pipeline byte-for-byte.
 _C.DATA.DEVICE_NORMALIZE = True
 
+# ------------------------------- serving ------------------------------------
+# Online inference (serve/, serve_net.py) — the request-level engine that
+# turns the eval step into a service. No reference analogue (the reference
+# stops at offline test_net.py).
+_C.SERVE = CfgNode()
+# Dynamic micro-batch assembly: flush when MAX_BATCH requests are waiting
+# or MAX_WAIT_MS after the oldest request arrived, whichever comes first.
+_C.SERVE.MAX_BATCH = 8
+_C.SERVE.MAX_WAIT_MS = 5.0
+# Batch-shape buckets compiled ONCE at startup (jax.jit AOT lowering);
+# a batch of n pads to the smallest bucket ≥ n, so steady-state serving
+# never recompiles. [] ⇒ powers of two up to MAX_BATCH.
+_C.SERVE.BUCKET_SIZES = []
+# Bounded-queue backpressure: submissions beyond this depth are rejected
+# with a retry-after hint instead of growing latency without bound.
+_C.SERVE.MAX_QUEUE = 64
+# Local device index the serving replica pins to (latency-optimal
+# small-batch serving is one single-chip replica per chip; run one
+# serve_net process per chip for throughput).
+_C.SERVE.DEVICE = 0
+# Socket frontend (length-prefixed frames; serve_net.py). PORT 0 picks an
+# ephemeral port (logged at startup).
+_C.SERVE.HOST = "127.0.0.1"
+_C.SERVE.PORT = 8765
+
 # ------------------------------- profiler ------------------------------------
 # jax.profiler trace capture (TensorBoard/XProf format). When enabled, the
 # primary process traces NUM_STEPS train steps starting at START_STEP of

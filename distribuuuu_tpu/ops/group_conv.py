@@ -23,10 +23,16 @@ Design (TPU-first):
     a compile-time constant). Each input block is fetched once and every
     group's output lane-concatenated into one 4D store, so HBM traffic
     stays at one read of x + one write of out.
-  * stride 1 flattens padded rows so every tap is a CONTIGUOUS sublane
-    slice: out rows ``m`` take ``x_flat[m + dy·Wp + dx]`` — the 9 taps
-    are 9 aligned [M, cg] @ [cg, fg] MXU contractions accumulated in
-    fp32. stride 2 uses 2D strided tap slices (3 convs per net).
+  * taps are 2D slices of the padded block: for tap ``(dy, dx)`` the
+    group's [BB, Hp, Wp, cg] view is sliced ``[:, dy:dy+Ho, dx:dx+Wo, :]``
+    and contracted against ``w[dy, dx, g]`` — 9 aligned [·, cg] @ [cg, fg]
+    MXU contractions accumulated in fp32. (An earlier design flattened
+    padded rows to make each tap one contiguous sublane slice
+    ``x_flat[m + dy·Wp + dx]``; it was abandoned — the 2D slices lower
+    directly in Mosaic with no flatten reshape and identical traffic.)
+    stride 2 uses 2D *strided* tap slices, which Mosaic only accepts in
+    interpret mode (VMEM slice strides are confined to [1, 2)); compiled
+    stride-2 convs (3 per net) fall back to the unrolled XLA path.
   * backward: dx is the SAME kernel run on the padded cotangent with the
     spatially-flipped, transposed kernel (a grouped conv identity);
     dW falls back to XLA's per-group correlation (measured cheap —
@@ -71,7 +77,7 @@ def _pick_bb(batch: int, hp: int, wp: int, c_all: int, ho: int, wo: int,
 
 
 def _kernel_s1(x_ref, w_ref, o_ref, *, ho, wo, wp, cg, fg, groups):
-    """stride-1 3×3 tap-accumulation over flattened padded rows.
+    """stride-1 3×3 tap-accumulation via 2D slices of the padded block.
 
     x_ref: [BB, Hp, Wp, G, cg]  w_ref: [3, 3, G, cg, fg]
     o_ref: [BB, Ho, Wo, G, fg]   (program: one batch tile, ALL groups —

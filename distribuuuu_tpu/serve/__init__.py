@@ -1,0 +1,29 @@
+"""Online inference serving (no reference analogue — the reference stops
+at offline ``test_net.py``).
+
+The request-level layer the ROADMAP's "heavy traffic from millions of
+users" goal needs: ``engine.py`` (dynamic micro-batching over AOT-compiled
+bucket shapes, double-buffered dispatch, per-request futures),
+``admission.py`` (bounded-queue backpressure + SIGTERM graceful drain),
+``metrics.py`` (latency histograms / occupancy / throughput into the
+jsonlog sink), ``protocol.py`` (length-prefixed socket frontend + batch
+mode). Entry points: ``serve_net.py`` (the CLI sibling of
+``train_net.py``/``test_net.py``) and ``tools/serve_bench.py`` (the
+closed/open-loop load generator).
+"""
+
+from distribuuuu_tpu.serve.admission import (  # noqa: F401
+    AdmissionController,
+    EngineClosedError,
+    QueueFullError,
+    drain_requested,
+    install_drain,
+    reset_drain,
+)
+from distribuuuu_tpu.serve.engine import (  # noqa: F401
+    COMPILE_EVENTS,
+    Engine,
+    default_buckets,
+    engine_from_cfg,
+)
+from distribuuuu_tpu.serve.metrics import ServeMetrics  # noqa: F401

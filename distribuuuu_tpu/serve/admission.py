@@ -1,0 +1,99 @@
+"""Admission control and graceful drain for the serving engine.
+
+Two serving-specific failure modes the training stack never sees:
+
+* **Overload.** An open-loop client population does not slow down when the
+  server does; an unbounded queue turns overload into unbounded latency
+  for *every* request. The ``AdmissionController`` bounds the queue at
+  ``SERVE.MAX_QUEUE`` and rejects beyond it with a ``retry_after_ms``
+  hint (the HTTP-429/Retry-After shape) so clients back off while
+  in-queue requests keep their latency budget.
+
+* **Preemption.** TPU serving replicas are preempted exactly like
+  training slices — SIGTERM plus a grace window. This reuses the
+  ``utils/preempt.py`` signal pattern (handler sets a flag; the serving
+  loop polls it at a safe boundary): on signal the frontend stops
+  accepting, the engine finishes every queued/in-flight request, and the
+  process exits inside the grace window. Training's analogue writes a
+  mid-epoch checkpoint; serving's "state" is the in-flight requests, so
+  draining them IS the checkpoint.
+"""
+
+from __future__ import annotations
+
+import signal
+
+
+class QueueFullError(RuntimeError):
+    """Request rejected: the admission queue is at ``SERVE.MAX_QUEUE``.
+
+    ``retry_after_ms`` estimates when capacity frees up (queue depth ×
+    recent per-batch service time / batch size) — the client-visible
+    backpressure signal.
+    """
+
+    def __init__(self, depth: int, max_queue: int, retry_after_ms: float):
+        super().__init__(
+            f"serve queue full ({depth}/{max_queue}); "
+            f"retry after ~{retry_after_ms:.0f} ms"
+        )
+        self.depth = depth
+        self.max_queue = max_queue
+        self.retry_after_ms = retry_after_ms
+
+
+class EngineClosedError(RuntimeError):
+    """Submitted after drain began — the engine no longer accepts work."""
+
+
+class AdmissionController:
+    """Bounded-queue admission: ``admit`` raises rather than letting the
+    pending queue grow past ``max_queue``; ``close`` flips to
+    reject-everything (drain mode)."""
+
+    def __init__(self, max_queue: int):
+        if max_queue < 1:
+            raise ValueError(f"SERVE.MAX_QUEUE must be ≥ 1, got {max_queue}")
+        self.max_queue = int(max_queue)
+        self._open = True
+
+    @property
+    def is_open(self) -> bool:
+        return self._open
+
+    def admit(self, depth: int, retry_after_ms: float) -> None:
+        """Raise unless a request may join a queue currently ``depth`` deep."""
+        if not self._open:
+            raise EngineClosedError("engine is draining; not accepting requests")
+        if depth >= self.max_queue:
+            raise QueueFullError(depth, self.max_queue, retry_after_ms)
+
+    def close(self) -> None:
+        self._open = False
+
+
+# -- SIGTERM → graceful drain (the utils/preempt.py pattern) -----------------
+
+_drain = {"requested": False}
+
+
+def install_drain(signals=(signal.SIGTERM,)) -> None:
+    """Install the drain handler (idempotent; main thread only — the same
+    contract as ``preempt.install``). The handler only sets a flag; the
+    serving accept loop polls ``drain_requested()`` and performs the
+    actual drain at its next safe boundary."""
+
+    def handler(signum, frame):
+        _drain["requested"] = True
+
+    for s in signals:
+        signal.signal(s, handler)
+
+
+def drain_requested() -> bool:
+    return _drain["requested"]
+
+
+def reset_drain() -> None:
+    """Clear the flag (tests)."""
+    _drain["requested"] = False

@@ -1,0 +1,214 @@
+"""Length-prefixed socket frontend + one-shot batch mode for serve_net.
+
+Wire format: every frame is a 4-byte big-endian payload length followed by
+the payload. Request payloads, auto-detected:
+
+* ``.npy`` bytes (numpy magic ``\\x93NUMPY``) holding an (H, W, 3) uint8
+  image — decoded without a PIL round-trip;
+* a ``(TRAIN.IM_SIZE, TRAIN.IM_SIZE, 3)`` float32 ``.npy`` — treated as
+  ALREADY val-transformed (the engine's float input path) and submitted
+  as-is;
+* anything else — an encoded image file (JPEG/PNG/…, PIL-decodable).
+
+Raw images get the SAME val transform pipeline evaluation uses (shorter
+side to ``TEST.IM_SIZE``, center-crop ``TRAIN.IM_SIZE``, normalization
+placement per ``DATA.DEVICE_NORMALIZE`` — data/transforms.py), so a
+served prediction is bit-for-bit the offline ``test_net.py`` prediction
+for the same file.
+
+Response payload: JSON — ``{"pred", "topk", "logits"}`` on success;
+``{"error": ..., "retry_after_ms"?}`` on rejection/failure (backpressure
+maps to ``"queue_full"`` + retry hint, drain to ``"draining"``).
+
+Batch mode (``run_batch``) bypasses the socket: a ``.npy`` of N
+val-transformed images in (file or stdin), an ``(N, num_classes)`` float32
+logits ``.npy`` out (file or stdout) — the CI-testable path.
+"""
+
+from __future__ import annotations
+
+import io
+import json
+import socket
+import struct
+import sys
+import threading
+import time
+
+import numpy as np
+
+from distribuuuu_tpu.config import cfg
+from distribuuuu_tpu.serve.admission import EngineClosedError, QueueFullError
+
+_NPY_MAGIC = b"\x93NUMPY"
+MAX_FRAME = 64 << 20  # refuse absurd frames before allocating for them
+
+
+# -- framing ----------------------------------------------------------------
+
+def send_frame(sock: socket.socket, payload: bytes) -> None:
+    sock.sendall(struct.pack(">I", len(payload)) + payload)
+
+
+def _recv_exact(sock: socket.socket, n: int) -> bytes | None:
+    buf = b""
+    while len(buf) < n:
+        chunk = sock.recv(n - len(buf))
+        if not chunk:
+            return None  # peer closed
+        buf += chunk
+    return buf
+
+
+def recv_frame(sock: socket.socket) -> bytes | None:
+    """One frame's payload, or None on clean EOF."""
+    hdr = _recv_exact(sock, 4)
+    if hdr is None:
+        return None
+    (n,) = struct.unpack(">I", hdr)
+    if n > MAX_FRAME:
+        raise ValueError(f"frame of {n} bytes exceeds MAX_FRAME={MAX_FRAME}")
+    return _recv_exact(sock, n)
+
+
+# -- request decoding -------------------------------------------------------
+
+def make_transform():
+    """The val pipeline as a payload→engine-input function, captured from
+    the global cfg (same geometry/normalization the val loader uses)."""
+    from PIL import Image
+
+    from distribuuuu_tpu.data.transforms import val_transform
+
+    resize, crop = cfg.TEST.IM_SIZE, cfg.TRAIN.IM_SIZE
+    normalize = not cfg.DATA.DEVICE_NORMALIZE
+
+    def transform(payload: bytes) -> np.ndarray:
+        if payload[: len(_NPY_MAGIC)] == _NPY_MAGIC:
+            arr = np.load(io.BytesIO(payload), allow_pickle=False)
+            if (
+                arr.dtype == np.float32
+                and arr.shape == (crop, crop, 3)
+            ):
+                return arr  # pre-transformed: the engine's float input path
+            if arr.dtype != np.uint8 or arr.ndim != 3 or arr.shape[-1] != 3:
+                raise ValueError(
+                    f"npy request must be (H, W, 3) uint8 raw or "
+                    f"({crop}, {crop}, 3) float32 pre-transformed, got "
+                    f"{arr.shape} {arr.dtype}"
+                )
+            img = Image.fromarray(arr)
+        else:
+            img = Image.open(io.BytesIO(payload)).convert("RGB")
+        return val_transform(img, resize, crop, normalize=normalize)
+
+    return transform
+
+
+# -- socket server ----------------------------------------------------------
+
+def open_listener(host: str, port: int) -> socket.socket:
+    """Bound+listening socket (port 0 ⇒ ephemeral; read
+    ``sock.getsockname()[1]`` for the real port)."""
+    sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+    sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+    sock.bind((host, port))
+    sock.listen(128)
+    return sock
+
+
+def _handle_conn(engine, conn: socket.socket, transform, topk: int) -> None:
+    with conn:
+        while True:
+            try:
+                payload = recv_frame(conn)
+            except (OSError, ValueError):
+                return
+            if payload is None:
+                return
+            try:
+                fut = engine.submit(transform(payload))
+                logits = fut.result()
+                order = np.argsort(logits)[::-1][: max(1, topk)]
+                resp = {
+                    "pred": int(order[0]),
+                    "topk": [int(i) for i in order],
+                    "logits": [float(v) for v in logits],
+                }
+            except QueueFullError as e:
+                resp = {
+                    "error": "queue_full",
+                    "retry_after_ms": round(e.retry_after_ms, 1),
+                }
+            except EngineClosedError:
+                resp = {"error": "draining"}
+            except Exception as e:  # noqa: BLE001 — per-request fault isolation
+                resp = {"error": f"{type(e).__name__}: {e}"}
+            try:
+                send_frame(conn, json.dumps(resp).encode())
+            except OSError:
+                return
+
+
+def serve_forever(
+    engine,
+    listener: socket.socket,
+    should_stop,
+    topk: int = 5,
+    poll_s: float = 0.25,
+) -> None:
+    """Accept loop: one handler thread per connection, requests multiplexed
+    through the shared engine. Polls ``should_stop()`` (the SIGTERM drain
+    flag, admission.drain_requested) between accepts; on stop it closes the
+    listener, drains the engine (every accepted request completes), and
+    joins the handlers — the graceful-exit half of preemption handling."""
+    transform = make_transform()
+    listener.settimeout(poll_s)
+    handlers: list[threading.Thread] = []
+    try:
+        while not should_stop():
+            try:
+                conn, _addr = listener.accept()
+            except socket.timeout:
+                continue
+            t = threading.Thread(
+                target=_handle_conn,
+                args=(engine, conn, transform, topk),
+                daemon=True,
+            )
+            t.start()
+            handlers.append(t)
+    finally:
+        listener.close()
+        engine.drain()
+        for t in handlers:
+            t.join(timeout=5.0)
+
+
+# -- batch mode -------------------------------------------------------------
+
+def run_batch(engine, in_path: str, out_path: str) -> int:
+    """One-shot batch mode: ``.npy`` images in, ``.npy`` logits out
+    ('-' = stdin/stdout). Input must be (N, IM, IM, 3) in the engine's
+    input dtype (val-transformed). Submits through the normal admission/
+    batching path — backpressure is honored by waiting out the retry
+    hint, so N may exceed SERVE.MAX_QUEUE. Returns N."""
+    src = sys.stdin.buffer if in_path == "-" else in_path
+    images = np.load(src, allow_pickle=False)
+    if images.ndim != 4:
+        raise ValueError(f"batch input must be (N, H, W, 3), got {images.shape}")
+    futs = []
+    for row in images:
+        while True:
+            try:
+                futs.append(engine.submit(row))
+                break
+            except QueueFullError as e:  # back off as a client would
+                time.sleep(e.retry_after_ms / 1e3)
+    logits = np.stack([f.result() for f in futs]).astype(np.float32)
+    if out_path == "-":
+        np.save(sys.stdout.buffer, logits)
+        sys.stdout.buffer.flush()
+    else:
+        np.save(out_path, logits)
+    return len(images)

@@ -1,11 +1,13 @@
 """Seeding and environment setup (ref: /root/reference/distribuuuu/utils.py:54-68).
 
 The reference seeds numpy/torch/random with ``RNG_SEED + rank`` so each rank
-draws distinct augmentations, and toggles cuDNN determinism. Here: numpy and
-Python ``random`` get the rank-offset seed (they drive host-side data
-augmentation), and the returned ``jax.random`` key is folded from the *base*
-seed only — in-graph randomness under global-array jit must be identical on
-every process, XLA derives per-shard streams itself.
+draws distinct augmentations, and toggles cuDNN determinism. Here the
+rank-offset seeding of the *global* numpy/``random`` streams is kept for
+reference parity and incidental host randomness only — augmentation
+deliberately does NOT draw from them (see ``setup_seed``), and the returned
+``jax.random`` key is folded from the *base* seed only — in-graph randomness
+under global-array jit must be identical on every process, XLA derives
+per-shard streams itself.
 """
 
 from __future__ import annotations
@@ -25,6 +27,21 @@ def setup_seed() -> jax.Array:
     Mirrors setup_seed's semantics (utils.py:54-68): if ``cfg.RNG_SEED`` is
     None a random seed is drawn (and broadcast so all processes agree on the
     in-graph key).
+
+    DATA-GROUP IDENTICAL-BATCH INVARIANT (ADVICE r5 — do not reintroduce
+    rank-offset global-RNG augmentation): processes that share a data row
+    of the mesh (model/pipe axes spanning hosts) load the SAME sampler
+    shard and must assemble byte-identical batches — their devices hold
+    the same shard of the global batch
+    (parallel/mesh.data_process_groups; PARITY.md "DistributedSampler
+    semantics"). Augmentation therefore draws from per-sample generators
+    seeded by ``(RNG_SEED, epoch, sample_index)``
+    (data/imagefolder.ImageFolderDataset._rng) — rank-independent by
+    construction — and NEVER from the rank-offset ``np.random`` /
+    ``random`` streams seeded here. Routing augmentation through these
+    global streams would give same-data-row processes different pixels
+    for the same sample: a silent cross-host batch divergence that TP/PP
+    meshes turn into wrong math, not an error message.
     """
     seed = cfg.RNG_SEED
     if seed is None:

@@ -1,14 +1,18 @@
-"""Pretrained-weight URL zoo with a connectivity-guarded auto-download.
+"""Pretrained-weight URL zoo with an auto-download.
 
 The reference resolves ``MODEL.PRETRAINED True`` to a torchvision URL per
 arch and downloads through torch.hub (ref: /root/reference/distribuuuu/
 models/resnet.py:23-33,309-311; models/utils.py:1-4; densenet key-remap
 densenet.py:266-282). This module closes that parity gap for connected
-environments while staying honest offline: ``fetch()`` probes
-connectivity first and raises the same actionable error the trainer
-always gave when the network is unreachable (the build environment has
-zero egress, so the refusal path is the one exercised there; the download
-path is covered by tests with a mocked ``urlopen``).
+environments while staying honest offline: ``fetch()`` attempts the
+download directly and maps network-unreachable errors (DNS failure,
+refused connection, timeout) to the actionable offline message the
+trainer always gave — no up-front connectivity probe (ADVICE r5: the
+old 3 s ``_online`` pre-flight added fixed latency to every cache miss
+and could pass while the actual download still failed; the download
+attempt itself is the probe). The build environment has zero egress, so
+the refusal path is the one exercised there; the download path is
+covered by tests with a mocked ``urlopen``.
 
 Downloaded files are torch pickles; ingestion (DDP-prefix stripping,
 densenet legacy-key remap, rel-pos/pos-embed params) is
@@ -39,8 +43,7 @@ MODEL_URLS = {
     "densenet201": "https://download.pytorch.org/models/densenet201-c1103571.pth",
 }
 
-_PROBE_URL = "https://download.pytorch.org"
-_PROBE_TIMEOUT_S = 3.0
+_DOWNLOAD_TIMEOUT_S = 60
 
 
 def cache_dir() -> str:
@@ -48,19 +51,6 @@ def cache_dir() -> str:
         "DISTRIBUUUU_CACHE",
         os.path.join(os.path.expanduser("~"), ".cache", "distribuuuu_tpu"),
     )
-
-
-def _online() -> bool:
-    """Cheap connectivity probe — False in zero-egress environments."""
-    try:
-        urllib.request.urlopen(_PROBE_URL, timeout=_PROBE_TIMEOUT_S).close()
-        return True
-    except urllib.error.HTTPError:
-        # an HTTP error (e.g. 403 from the bucket root) IS a server
-        # response — the network is reachable
-        return True
-    except Exception:  # noqa: BLE001 — DNS/timeout/refused ⇒ offline
-        return False
 
 
 def fetch(arch: str) -> str:
@@ -81,12 +71,6 @@ def fetch(arch: str) -> str:
     dest = os.path.join(cache_dir(), os.path.basename(url))
     if os.path.exists(dest) and _digest_ok(dest, url):
         return dest
-    if not _online():
-        raise ValueError(
-            "MODEL.PRETRAINED True needs MODEL.WEIGHTS pointing at a "
-            "weights file (torch .pth or orbax dir): the pretrained-URL "
-            f"zoo at {url} is unreachable from this environment"
-        )
     os.makedirs(cache_dir(), exist_ok=True)
     # per-process temp name: every process of a multi-host run may fetch
     # concurrently (trainer loads weights on all ranks); each writes its
@@ -94,7 +78,7 @@ def fetch(arch: str) -> str:
     # correct, never interleaved
     tmp = f"{dest}.part.{os.getpid()}"
     try:
-        with urllib.request.urlopen(url, timeout=60) as r, \
+        with urllib.request.urlopen(url, timeout=_DOWNLOAD_TIMEOUT_S) as r, \
                 open(tmp, "wb") as f:
             while True:
                 chunk = r.read(1 << 20)
@@ -110,10 +94,19 @@ def fetch(arch: str) -> str:
         os.replace(tmp, dest)  # atomic: no truncated cache on interrupt
     except ValueError:
         raise
-    except Exception as e:  # noqa: BLE001 — keep the documented contract
+    except urllib.error.HTTPError as e:
+        # the server RESPONDED — network is fine, the download itself failed
         raise ValueError(
             f"MODEL.PRETRAINED True: downloading {url} failed ({e}); "
             "point MODEL.WEIGHTS at a local weights file instead"
+        ) from e
+    except (urllib.error.URLError, OSError, TimeoutError) as e:
+        # DNS failure / refused / timeout ⇒ unreachable: the actionable
+        # offline message (the download attempt IS the connectivity probe)
+        raise ValueError(
+            "MODEL.PRETRAINED True needs MODEL.WEIGHTS pointing at a "
+            "weights file (torch .pth or orbax dir): the pretrained-URL "
+            f"zoo at {url} is unreachable from this environment ({e})"
         ) from e
     finally:
         if os.path.exists(tmp):

@@ -1,0 +1,92 @@
+"""Serve a classification model online (the sibling of train_net.py /
+test_net.py; no reference analogue — the reference stops at offline eval).
+
+Loads any zoo arch from an orbax checkpoint or torch pickle
+(``MODEL.WEIGHTS``) or the pretrained URL zoo (``MODEL.PRETRAINED``),
+applies the val transform pipeline to incoming images, and serves
+predictions through the dynamic micro-batching engine
+(distribuuuu_tpu/serve/) over a length-prefixed socket. SIGTERM drains
+gracefully: stop accepting, finish every in-flight request, exit.
+
+Usage:
+    # socket service (SERVE.* config node controls batching/port):
+    python serve_net.py --cfg config/resnet50.yaml MODEL.WEIGHTS path/to/ckpt
+
+    # one-shot batch mode (tests/CI): val-transformed .npy in, logits out
+    python serve_net.py --cfg config/resnet50.yaml \\
+        --batch-input imgs.npy --batch-output logits.npy
+"""
+
+import argparse
+import sys
+
+import distribuuuu_tpu.config as config
+from distribuuuu_tpu.config import cfg
+
+
+def main(argv=None):
+    parser = argparse.ArgumentParser(
+        description="Serve a classification model."
+    )
+    parser.add_argument(
+        "--cfg", dest="cfg_file", required=True, type=str,
+        help="Config file location",
+    )
+    parser.add_argument(
+        "--batch-input", default=None,
+        help="one-shot batch mode: .npy of val-transformed images "
+             "('-' = stdin) instead of the socket server",
+    )
+    parser.add_argument(
+        "--batch-output", default="-",
+        help="batch-mode logits .npy destination ('-' = stdout)",
+    )
+    parser.add_argument(
+        "opts", help="See distribuuuu_tpu/config.py for all options",
+        default=None, nargs=argparse.REMAINDER,
+    )
+    args = parser.parse_args(sys.argv[1:] if argv is None else argv)
+    config.merge_from_file(args.cfg_file)
+    cfg.merge_from_list(args.opts)
+    cfg.freeze()
+
+    from distribuuuu_tpu import trainer
+    from distribuuuu_tpu.serve import admission, engine_from_cfg, protocol
+    from distribuuuu_tpu.utils.jsonlog import setup_metrics_log
+    from distribuuuu_tpu.utils.logger import get_logger, setup_logger
+
+    setup_logger()
+    logger = get_logger()
+    engine = engine_from_cfg()
+    logger.info(
+        "serving %s: buckets %s compiled (%d shapes), max_wait %.1f ms, "
+        "queue bound %d",
+        cfg.MODEL.ARCH, engine.buckets, engine.n_compiles,
+        cfg.SERVE.MAX_WAIT_MS, cfg.SERVE.MAX_QUEUE,
+    )
+    engine.start()
+
+    if args.batch_input is not None:
+        n = protocol.run_batch(engine, args.batch_input, args.batch_output)
+        engine.drain()
+        logger.info("batch mode: served %d requests", n)
+        return
+
+    setup_metrics_log(cfg.OUT_DIR)  # serve metrics land in metrics.jsonl
+    admission.install_drain()  # SIGTERM → graceful drain (preempt pattern)
+    listener = protocol.open_listener(cfg.SERVE.HOST, cfg.SERVE.PORT)
+    host, port = listener.getsockname()[:2]
+    logger.info("listening on %s:%d (SIGTERM drains gracefully)", host, port)
+    try:
+        protocol.serve_forever(
+            engine, listener, should_stop=admission.drain_requested,
+            topk=trainer.effective_topk(),
+        )
+    except KeyboardInterrupt:
+        listener.close()
+        engine.drain()
+    logger.info("drained; exiting")
+
+
+if __name__ == "__main__":
+    main()

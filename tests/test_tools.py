@@ -23,7 +23,7 @@ def _run(args, timeout=400):
 @pytest.mark.slow  # dominates the fast tier; full tier covers it
 def test_zoo_check_single_arch():
     out = _run(
-        ["tools/zoo_check.py", "--arch", "resnet18", "--batch", "2",
+        ["tools/zoo_check.py", "--arch", "resnet18", "--batch", "8",
          "--im-size", "32"]
     )
     assert out.returncode == 0, out.stdout[-2000:] + out.stderr[-1000:]
@@ -33,7 +33,7 @@ def test_zoo_check_single_arch():
 @pytest.mark.slow
 def test_zoo_check_reports_failure():
     out = _run(
-        ["tools/zoo_check.py", "--arch", "nosuch_arch", "--batch", "2",
+        ["tools/zoo_check.py", "--arch", "nosuch_arch", "--batch", "8",
          "--im-size", "32"]
     )
     assert out.returncode == 1
@@ -54,3 +54,60 @@ def test_data_bench_rejects_empty_measurement():
     out = _run(["tools/data_bench.py", "--n-images", "4"])
     assert out.returncode != 0
     assert "drop_last" in out.stderr + out.stdout
+
+
+@pytest.mark.slow
+def test_zoo_check_yaml_mode():
+    """--yamls certifies shipped configs through the exact train_net merge
+    path (VERDICT r5 item 8)."""
+    out = _run(
+        ["tools/zoo_check.py", "--yamls", "--arch", "resnet18,vit_small",
+         "--batch", "8", "--im-size", "32"]
+    )
+    assert out.returncode == 0, out.stdout[-2000:] + out.stderr[-1000:]
+    assert "resnet18 [resnet18.yaml]" in out.stdout
+    assert "vit_small [vit_small.yaml]" in out.stdout
+    assert "2/2 archs passed" in out.stdout
+
+
+@pytest.mark.slow
+def test_serve_bench_smoke(tmp_path):
+    """serve_bench produces the frontier report: ≥2 offered loads, p50/p99,
+    and both engine modes at each load."""
+    import json
+
+    report = tmp_path / "BENCH_serve.json"
+    out = _run(
+        ["tools/serve_bench.py", "--im-size", "16", "--num-classes", "10",
+         "--duration", "1", "--clients", "1", "--out", str(report)],
+        timeout=500,
+    )
+    assert out.returncode == 0, out.stdout[-2000:] + out.stderr[-1000:]
+    rep = json.loads(report.read_text())
+    loads = {r["offered_rps"] for r in rep["open_loop"]}
+    assert len(loads) >= 2
+    for r in rep["open_loop"]:
+        assert r["p50_ms"] > 0 and r["p99_ms"] >= r["p50_ms"]
+    modes = {r["mode"] for r in rep["open_loop"]}
+    assert modes == {"dynamic", "batch1"}
+
+
+@pytest.mark.slow
+def test_serve_net_batch_mode(tmp_path):
+    """serve_net.py one-shot batch mode: uint8 npy in, logits npy out."""
+    import numpy as np
+
+    src, dst = tmp_path / "in.npy", tmp_path / "out.npy"
+    rng = np.random.default_rng(0)
+    np.save(src, rng.integers(0, 256, (4, 16, 16, 3), dtype=np.uint8))
+    out = _run(
+        ["serve_net.py", "--cfg", "config/resnet18.yaml",
+         "--batch-input", str(src), "--batch-output", str(dst),
+         "MODEL.NUM_CLASSES", "10", "MODEL.BN_GROUP", "8",
+         "TRAIN.IM_SIZE", "16", "TEST.IM_SIZE", "16",
+         "DEVICE.COMPUTE_DTYPE", "float32", "SERVE.MAX_BATCH", "2"],
+    )
+    assert out.returncode == 0, out.stdout[-2000:] + out.stderr[-1000:]
+    logits = np.load(dst)
+    assert logits.shape == (4, 10)
+    assert np.isfinite(logits).all()

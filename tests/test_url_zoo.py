@@ -1,8 +1,9 @@
-"""Connectivity-guarded pretrained URL zoo (utils/url_zoo.py — VERDICT r4
-"What's missing" #2: the reference auto-downloads torchvision weights on
-MODEL.PRETRAINED True, ref: resnet.py:23-33). The build environment has
-zero egress, so the download path is exercised with a mocked urlopen and
-the refusal path both mocked and for real."""
+"""Pretrained URL zoo (utils/url_zoo.py — VERDICT r4 "What's missing" #2:
+the reference auto-downloads torchvision weights on MODEL.PRETRAINED True,
+ref: resnet.py:23-33). There is no up-front connectivity probe (ADVICE r5):
+fetch() attempts the download and maps network-unreachable errors to the
+actionable offline message. The build environment has zero egress, so both
+paths are exercised with a mocked urlopen."""
 
 import io
 import os
@@ -23,9 +24,32 @@ def test_unknown_arch_raises(tmp_cache):
         url_zoo.fetch("vit_tiny")  # extension arch: no torchvision zoo URL
 
 
-def test_offline_raises_actionable_error(tmp_cache, monkeypatch):
-    monkeypatch.setattr(url_zoo, "_online", lambda: False)
+def test_unreachable_network_raises_actionable_error(tmp_cache, monkeypatch):
+    """DNS failure / refused connection / timeout during the download map
+    to the offline message — the attempt itself is the probe."""
+    import urllib.error
+
+    def raise_unreachable(url, timeout=None):
+        raise urllib.error.URLError(OSError("Name or service not known"))
+
+    monkeypatch.setattr(url_zoo.urllib.request, "urlopen", raise_unreachable)
     with pytest.raises(ValueError, match="MODEL.WEIGHTS pointing at"):
+        url_zoo.fetch("resnet18")
+    # no partial file left behind
+    d = url_zoo.cache_dir()
+    assert not (os.path.isdir(d) and os.listdir(d))
+
+
+def test_http_error_is_download_failure_not_offline(tmp_cache, monkeypatch):
+    """An HTTP error is a server RESPONSE (network reachable): report a
+    failed download, not the offline message."""
+    import urllib.error
+
+    def raise_404(url, timeout=None):
+        raise urllib.error.HTTPError(url, 404, "not found", {}, None)
+
+    monkeypatch.setattr(url_zoo.urllib.request, "urlopen", raise_404)
+    with pytest.raises(ValueError, match="downloading .* failed"):
         url_zoo.fetch("resnet18")
 
 
@@ -44,7 +68,6 @@ def test_download_and_cache(tmp_cache, monkeypatch):
         calls.append(url)
         return FakeResponse(payload)
 
-    monkeypatch.setattr(url_zoo, "_online", lambda: True)
     monkeypatch.setattr(url_zoo, "_digest_ok", lambda *a: True)
     monkeypatch.setattr(
         url_zoo.urllib.request, "urlopen", fake_urlopen
@@ -59,18 +82,6 @@ def test_download_and_cache(tmp_cache, monkeypatch):
     calls.clear()
     assert url_zoo.fetch("resnet18") == path
     assert calls == []
-
-
-def test_real_probe_terminates():
-    """The real probe must return a bool within its timeout on ANY host —
-    offline (this zero-egress build environment) or online (a developer
-    laptop) — rather than hanging or raising."""
-    import time
-
-    t0 = time.monotonic()
-    result = url_zoo._online()
-    assert isinstance(result, bool)
-    assert time.monotonic() - t0 < url_zoo._PROBE_TIMEOUT_S + 5
 
 
 def test_every_zoo_arch_is_registered():
@@ -104,7 +115,6 @@ def test_download_failing_digest_raises(tmp_cache, monkeypatch):
         def __exit__(self, *a):
             return False
 
-    monkeypatch.setattr(url_zoo, "_online", lambda: True)
     monkeypatch.setattr(
         url_zoo.urllib.request, "urlopen",
         lambda url, timeout=None: FakeResponse(b"truncated"),

@@ -1,0 +1,283 @@
+"""Load generator for the serving engine: the latency/throughput frontier.
+
+Drives the in-process Engine (no socket — this measures the serving hot
+path: batching + dispatch + device) two ways:
+
+* **closed-loop** — C clients submit back-to-back; reports the
+  throughput/latency point each concurrency sustains (the "how fast can
+  one replica go" curve).
+* **open-loop** — Poisson arrivals at an offered rate, the
+  traffic-shaped view (2011.03641's point: open-loop latency is what
+  users see; closed-loop hides queueing). Requests beyond SERVE.MAX_QUEUE
+  are rejected and counted, not retried — offered load means offered.
+
+Both run twice: ``dynamic`` (the configured MAX_BATCH with bucketed
+micro-batching) and ``batch1`` (MAX_BATCH=1 — the no-batching strawman a
+naive port of test_net would serve). The dynamic/batch1 throughput gap at
+equal offered load is the engine's reason to exist.
+
+Offered rates default to calibration: measure batch-1 single-stream
+latency L1, then offer ~0.7× and ~2.5× of that capacity (the second point
+saturates batch1 while dynamic still has headroom). Writes one JSON
+report (default ``BENCH_serve.json``).
+
+Workload-regime note: batching harvests device parallelism a batch-1
+forward leaves idle. On CPU a 224² conv net is compute-bound at batch 1
+(XLA:CPU parallelizes one conv across all cores), so the default here is
+the dispatch-bound tiny shape (resnet18 @16², where the CPU run shows
+~2× dynamic/batch1 at saturation — BENCH_serve.json) — the same overhead
+regime 2011.03641 measures on TPU at small batch. On a chip, bench the
+real serving shape: ``--im-size 224 --num-classes 1000 --dtype bfloat16``.
+
+    JAX_PLATFORMS=cpu python tools/serve_bench.py --duration 5
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import threading
+import time
+
+import _path  # noqa: F401 — repo root onto sys.path for the package import
+import numpy as np
+
+
+def build_engine(args, max_batch: int):
+    """Fresh engine for one mode (random init — latency does not care
+    about weight values)."""
+    import jax
+
+    import distribuuuu_tpu.config as config
+    from distribuuuu_tpu import trainer
+    from distribuuuu_tpu.config import cfg
+    from distribuuuu_tpu.parallel import mesh as mesh_lib
+    from distribuuuu_tpu.serve import Engine
+
+    config.reset_cfg()
+    cfg.MODEL.ARCH = args.arch
+    cfg.MODEL.NUM_CLASSES = args.num_classes
+    if args.arch.startswith("resnet"):
+        cfg.MODEL.BN_GROUP = 8  # tiny-batch ghost BN: any divisor works
+    cfg.TRAIN.IM_SIZE = args.im_size
+    cfg.DEVICE.COMPUTE_DTYPE = args.dtype
+    mesh = mesh_lib.build_mesh(data=1, model=1, seq=1, pipe=1,
+                               devices=[jax.devices()[0]])
+    model = trainer.build_model_from_cfg()
+    state = trainer.create_train_state(
+        model, jax.random.key(0), mesh, args.im_size
+    )
+    engine = Engine(
+        model,
+        {"params": state.params, "batch_stats": state.batch_stats},
+        args.im_size,
+        max_batch=max_batch,
+        max_wait_ms=args.max_wait_ms,
+        max_queue=args.max_queue,
+        input_dtype=np.uint8,
+    )
+    return engine.start()
+
+
+def make_requests(n: int, im_size: int, seed: int = 0) -> list[np.ndarray]:
+    rng = np.random.default_rng(seed)
+    return [
+        rng.integers(0, 256, (im_size, im_size, 3), dtype=np.uint8)
+        for _ in range(n)
+    ]
+
+
+def _await_all(futs) -> int:
+    done = 0
+    for f in futs:
+        f.result()
+        done += 1
+    return done
+
+
+def closed_loop(engine, images, clients: int, duration_s: float) -> dict:
+    """C threads, each submit→wait→repeat for the window."""
+    from distribuuuu_tpu.serve import ServeMetrics
+
+    engine.metrics = ServeMetrics()
+    stop = time.perf_counter() + duration_s
+    counts = [0] * clients
+
+    def client(ci: int):
+        i = ci
+        while time.perf_counter() < stop:
+            engine.submit(images[i % len(images)]).result()
+            counts[ci] += 1
+            i += clients
+
+    threads = [
+        threading.Thread(target=client, args=(ci,)) for ci in range(clients)
+    ]
+    t0 = time.perf_counter()
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    elapsed = time.perf_counter() - t0
+    snap = engine.metrics.snapshot()
+    return {
+        "clients": clients,
+        "completed": sum(counts),
+        "throughput_rps": round(sum(counts) / elapsed, 2),
+        "p50_ms": snap["p50_ms"],
+        "p99_ms": snap["p99_ms"],
+        "batch_occupancy": snap["batch_occupancy"],
+    }
+
+
+def open_loop(engine, images, offered_rps: float, duration_s: float,
+              seed: int = 0) -> dict:
+    """Poisson arrivals at ``offered_rps``; rejections counted, not
+    retried (offered load is offered load)."""
+    from distribuuuu_tpu.serve import QueueFullError, ServeMetrics
+
+    engine.metrics = ServeMetrics()
+    rng = np.random.default_rng(seed)
+    futs = []
+    rejected = 0
+    t0 = time.perf_counter()
+    next_t = t0
+    i = 0
+    while True:
+        next_t += rng.exponential(1.0 / offered_rps)
+        if next_t - t0 > duration_s:
+            break
+        delay = next_t - time.perf_counter()
+        if delay > 0:
+            time.sleep(delay)
+        try:
+            futs.append(engine.submit(images[i % len(images)]))
+        except QueueFullError:
+            rejected += 1
+        i += 1
+    completed = _await_all(futs)
+    elapsed = time.perf_counter() - t0
+    snap = engine.metrics.snapshot()
+    return {
+        "offered_rps": round(offered_rps, 1),
+        "offered": i,
+        "completed": completed,
+        "rejected": rejected,
+        "achieved_rps": round(completed / elapsed, 2),
+        "p50_ms": snap["p50_ms"],
+        "p99_ms": snap["p99_ms"],
+        "batch_occupancy": snap["batch_occupancy"],
+    }
+
+
+def calibrate_batch1_latency(engine, images, n: int = 30) -> float:
+    """Median single-stream request latency (seconds), warmed."""
+    for img in images[:5]:
+        engine.submit(img).result()
+    lats = []
+    for k in range(n):
+        t0 = time.perf_counter()
+        engine.submit(images[k % len(images)]).result()
+        lats.append(time.perf_counter() - t0)
+    return float(np.median(lats))
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--arch", default="resnet18")
+    ap.add_argument("--im-size", type=int, default=16)
+    ap.add_argument("--num-classes", type=int, default=10)
+    ap.add_argument("--dtype", default="float32",
+                    help="DEVICE.COMPUTE_DTYPE for the served model")
+    ap.add_argument("--max-batch", type=int, default=8)
+    ap.add_argument("--max-wait-ms", type=float, default=5.0)
+    ap.add_argument("--max-queue", type=int, default=64)
+    ap.add_argument("--duration", type=float, default=5.0,
+                    help="seconds per load point")
+    ap.add_argument("--loads", default="",
+                    help="comma-separated offered req/s (default: "
+                         "calibrated 0.7× and 2.5× batch-1 capacity)")
+    ap.add_argument("--clients", default="1,8",
+                    help="closed-loop concurrency levels")
+    ap.add_argument("--out", default="BENCH_serve.json")
+    args = ap.parse_args()
+
+    import jax
+
+    images = make_requests(64, args.im_size)
+    results = {
+        "metric": "serve_latency_throughput_frontier",
+        "arch": args.arch,
+        "im_size": args.im_size,
+        "num_classes": args.num_classes,
+        "compute_dtype": args.dtype,
+        "device_kind": jax.devices()[0].device_kind,
+        "max_batch": args.max_batch,
+        "max_wait_ms": args.max_wait_ms,
+        "max_queue": args.max_queue,
+        "duration_s": args.duration,
+        "open_loop": [],
+        "closed_loop": [],
+    }
+
+    engines = {}
+    for mode, mb in (("dynamic", args.max_batch), ("batch1", 1)):
+        t0 = time.perf_counter()
+        engines[mode] = build_engine(args, mb)
+        print(f"# {mode}: buckets {engines[mode].buckets} compiled in "
+              f"{time.perf_counter() - t0:.1f}s", flush=True)
+    results["buckets"] = engines["dynamic"].buckets
+
+    l1 = calibrate_batch1_latency(engines["batch1"], images)
+    cap1 = 1.0 / l1
+    results["batch1_single_stream_ms"] = round(l1 * 1e3, 3)
+    print(f"# batch-1 single-stream latency {l1 * 1e3:.2f} ms "
+          f"(~{cap1:.0f} req/s capacity)", flush=True)
+    loads = (
+        [float(x) for x in args.loads.split(",") if x]
+        if args.loads
+        else [round(0.7 * cap1, 1), round(2.5 * cap1, 1)]
+    )
+
+    for load in loads:
+        for mode in ("dynamic", "batch1"):
+            r = open_loop(engines[mode], images, load, args.duration)
+            r["mode"] = mode
+            results["open_loop"].append(r)
+            print(f"  open  {mode:<8} offered {load:8.1f} rps -> "
+                  f"{r['achieved_rps']:8.1f} rps  p50 {r['p50_ms']:7.1f} ms  "
+                  f"p99 {r['p99_ms']:7.1f} ms  rejected {r['rejected']}",
+                  flush=True)
+    for clients in [int(c) for c in args.clients.split(",") if c]:
+        for mode in ("dynamic", "batch1"):
+            r = closed_loop(engines[mode], images, clients, args.duration)
+            r["mode"] = mode
+            results["closed_loop"].append(r)
+            print(f"  closed {mode:<8} {clients:3d} clients -> "
+                  f"{r['throughput_rps']:8.1f} rps  p50 {r['p50_ms']:7.1f} ms  "
+                  f"p99 {r['p99_ms']:7.1f} ms", flush=True)
+
+    for engine in engines.values():
+        engine.drain()
+
+    # the headline: dynamic vs batch1 at the highest offered load
+    top = max(loads)
+    by = {
+        (r["mode"], r["offered_rps"]): r["achieved_rps"]
+        for r in results["open_loop"]
+    }
+    if ("dynamic", round(top, 1)) in by and ("batch1", round(top, 1)) in by:
+        d, b = by[("dynamic", round(top, 1))], by[("batch1", round(top, 1))]
+        results["dynamic_vs_batch1_at_top_load"] = round(d / b, 3) if b else None
+        print(f"# dynamic/batch1 throughput at {top:.0f} rps offered: "
+              f"{d:.1f}/{b:.1f} = {d / max(b, 1e-9):.2f}x", flush=True)
+
+    with open(args.out, "w") as f:
+        json.dump(results, f, indent=1)
+    print(json.dumps({k: v for k, v in results.items()
+                      if k not in ("open_loop", "closed_loop")}))
+    print(f"# full report -> {args.out}", flush=True)
+
+
+if __name__ == "__main__":
+    main()

@@ -6,6 +6,7 @@ and fusion paths). One forward per arch at the configured batch; prints a
 table and exits nonzero if anything fails.
 
     python tools/zoo_check.py [--batch 8] [--im-size 224] [--train-step|--eval-step]
+    python tools/zoo_check.py --yamls [config]   # drive the SHIPPED YAMLs
 
 ``--train-step`` runs a full fwd+bwd+update step per arch instead of
 inference forward (slower compile, stronger guarantee). ``--eval-step``
@@ -13,11 +14,19 @@ names the default mode explicitly (the compiled masked eval step,
 trainer.make_eval_step — the path validate()/test_model() run, ref:
 trainer.py:176-209): certification output then records which path was
 certified (VERDICT r4 #9).
+
+``--yamls [DIR]`` (VERDICT r5 item 8) certifies each shipped
+``DIR/*.yaml`` instead of bare registry defaults: the config is merged
+exactly as train_net/test_net would (MODEL.*, MOE knobs, …), with only
+the benchmark geometry (``--im-size``, ``--batch``) overridden — so a
+YAML that drifts from the registry (bad arch name, stale key) fails
+HERE, not on a pod. Combines with ``--arch`` to filter.
 """
 
 from __future__ import annotations
 
 import argparse
+import os
 import time
 import traceback
 
@@ -25,6 +34,7 @@ import _path  # noqa: F401  — repo root onto sys.path for the package import
 import jax
 import jax.numpy as jnp
 import numpy as np
+import yaml
 
 
 def main():
@@ -37,6 +47,11 @@ def main():
         help="explicitly certify the compiled eval step (the default path)",
     )
     ap.add_argument("--arch", default="", help="comma-separated subset")
+    ap.add_argument(
+        "--yamls", nargs="?", const="config", default=None, metavar="DIR",
+        help="certify the shipped YAML configs in DIR (default: config/) "
+             "instead of bare registry defaults",
+    )
     args = ap.parse_args()
 
     import distribuuuu_tpu.config as config
@@ -45,17 +60,36 @@ def main():
     from distribuuuu_tpu.parallel import mesh as mesh_lib, sharding as sharding_lib
     from distribuuuu_tpu.utils.optim import construct_optimizer
 
-    archs = args.arch.split(",") if args.arch else models.available_models()
+    subset = set(args.arch.split(",")) if args.arch else None
+    if args.yamls:
+        import glob
+
+        entries = []  # (label, yaml_path)
+        for path in sorted(glob.glob(os.path.join(args.yamls, "*.yaml"))):
+            with open(path) as f:
+                arch = (yaml.safe_load(f).get("MODEL") or {}).get("ARCH", "?")
+            if subset is None or arch in subset:
+                entries.append((f"{arch} [{os.path.basename(path)}]", path))
+        if not entries:
+            ap.error(f"no YAMLs matched in {args.yamls!r}")
+    else:
+        archs = sorted(subset) if subset else models.available_models()
+        entries = [(a, None) for a in archs]
     rng = np.random.default_rng(0)
     failures = []
     if args.train_step and args.eval_step:
         ap.error("--train-step and --eval-step are mutually exclusive")
     print(f"# devices: {jax.devices()}  mode: "
           f"{'train-step' if args.train_step else 'eval-step'}")
-    for arch in archs:
+    for label, yaml_path in entries:
         config.reset_cfg()
-        cfg.MODEL.ARCH = arch
-        cfg.MODEL.NUM_CLASSES = 1000
+        if yaml_path is not None:
+            # the exact merge train_net/test_net perform — a stale key or
+            # bad arch name in the YAML fails right here
+            cfg.merge_from_file(yaml_path)
+        else:
+            cfg.MODEL.ARCH = label
+            cfg.MODEL.NUM_CLASSES = 1000
         cfg.TRAIN.IM_SIZE = args.im_size
         t0 = time.perf_counter()
         try:
@@ -68,7 +102,9 @@ def main():
                 "image": rng.standard_normal(
                     (args.batch, args.im_size, args.im_size, 3)
                 ).astype(np.float32),
-                "label": rng.integers(0, 1000, (args.batch,)).astype(np.int32),
+                "label": rng.integers(
+                    0, cfg.MODEL.NUM_CLASSES, (args.batch,)
+                ).astype(np.int32),
                 "mask": np.ones((args.batch,), np.float32),
             })
             if args.train_step:
@@ -88,14 +124,14 @@ def main():
             dt = time.perf_counter() - t0
             status = "ok " if ok else "NAN"
             if not ok:
-                failures.append(arch)
-            print(f"  {status} {arch:<22} {dt:6.1f}s  {detail}", flush=True)
+                failures.append(label)
+            print(f"  {status} {label:<30} {dt:6.1f}s  {detail}", flush=True)
         except Exception as e:  # noqa: BLE001 — report and continue
-            failures.append(arch)
-            print(f"  FAIL {arch:<22} {time.perf_counter() - t0:6.1f}s  "
+            failures.append(label)
+            print(f"  FAIL {label:<30} {time.perf_counter() - t0:6.1f}s  "
                   f"{type(e).__name__}: {e}", flush=True)
             traceback.print_exc()
-    print(f"# {len(archs) - len(failures)}/{len(archs)} archs passed")
+    print(f"# {len(entries) - len(failures)}/{len(entries)} archs passed")
     raise SystemExit(1 if failures else 0)
 
 
