@@ -62,6 +62,10 @@ def build_workload(fold: int = 4, per_chip_batch: int = 128):
     # (ab_bench env plumbing reaches this at build time) — e.g. the
     # regnety_160 grouped-conv A/Bs (PERF.md r5).
     cfg.MODEL.ARCH = os.environ.get("DISTRIBUUUU_BENCH_ARCH", "resnet50")
+    # DISTRIBUUUU_REMAT=1: TRAIN.REMAT (stage 1-2 rematerialization) for
+    # the remat-for-traffic A/B — `tools/ab_bench.py --preset remat`.
+    if os.environ.get("DISTRIBUUUU_REMAT", "") not in ("", "0"):
+        cfg.TRAIN.REMAT = True
     cfg.MODEL.NUM_CLASSES = 1000
     n_chips = len(jax.devices())
     batch = per_chip_batch * n_chips

@@ -12,4 +12,5 @@ from distribuuuu_tpu.data.loader import (  # noqa: F401
     Loader,
     construct_train_loader,
     construct_val_loader,
+    device_prefetch,
 )

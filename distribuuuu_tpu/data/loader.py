@@ -15,6 +15,7 @@ silently double-counts DistributedSampler's padded duplicates).
 
 from __future__ import annotations
 
+import time
 from collections import deque
 from concurrent.futures import ThreadPoolExecutor
 
@@ -43,6 +44,7 @@ class Loader:
         self.batch_size = batch_size
         self.drop_last = drop_last
         self.workers = max(1, workers)
+        self._last_timing = None
         # Prefetch depth (batches assembled ahead of the consumer). When the
         # native backend is active each _assemble call already fans out over
         # `workers` C++ threads, so deep Python-side prefetch would multiply
@@ -78,7 +80,11 @@ class Loader:
         n = self.sampler.num_samples
         return n // self.batch_size if self.drop_last else -(-n // self.batch_size)
 
-    def _assemble(self, idxs: np.ndarray) -> dict:
+    def _assemble(self, idxs: np.ndarray, submit: float = 0.0) -> tuple:
+        """Returns ``(batch, timing)``: the batch dict plus the stage
+        timestamps of its assembly (utils/jsonlog.TIMELINE_STAGES subset:
+        submit/dec0/dec1/asm1 — all ``time.perf_counter`` values)."""
+        dec0 = time.perf_counter()
         if hasattr(self.dataset, "load_batch"):
             # ImageFolder path: batch-level decode (C++ kernel when built —
             # one GIL-free call with an internal thread pool; PIL otherwise).
@@ -87,6 +93,7 @@ class Loader:
             pairs = [self.dataset[int(i)] for i in idxs]
             images = np.stack([p[0] for p in pairs])
             labels = np.asarray([p[1] for p in pairs], np.int32)
+        dec1 = time.perf_counter()
         n = len(images)
         images = np.asarray(images)
         # DATA.DEVICE_NORMALIZE ships uint8 (4× fewer H2D bytes; the
@@ -105,9 +112,17 @@ class Loader:
             )
             batch["label"] = np.concatenate([batch["label"], np.zeros(pad, np.int32)])
             batch["mask"] = np.concatenate([batch["mask"], np.zeros(pad, np.float32)])
-        return batch
+        return batch, {"submit": submit, "dec0": dec0, "dec1": dec1,
+                       "asm1": time.perf_counter()}
+
+    def last_timing(self) -> dict | None:
+        """Stage timestamps (submit/dec0/dec1/asm1) of the most recently
+        yielded batch — the loader half of the per-batch timeline
+        (single-consumer iteration, so "last yielded" is unambiguous)."""
+        return self._last_timing
 
     def __iter__(self):
+        self._last_timing = None
         idxs = self.sampler.indices()
         n_batches = len(self)
         chunks = [
@@ -122,14 +137,71 @@ class Loader:
             in_flight: deque = deque()
             chunk_iter = iter(chunks)
             for chunk in chunks[: self.prefetch_depth]:
-                in_flight.append(pool.submit(self._assemble, chunk))
+                in_flight.append(
+                    pool.submit(self._assemble, chunk, time.perf_counter())
+                )
                 next(chunk_iter)
             while in_flight:
-                batch = in_flight.popleft().result()
+                batch, timing = in_flight.popleft().result()
                 nxt = next(chunk_iter, None)
                 if nxt is not None:
-                    in_flight.append(pool.submit(self._assemble, nxt))
+                    in_flight.append(
+                        pool.submit(self._assemble, nxt, time.perf_counter())
+                    )
+                self._last_timing = timing
                 yield batch
+
+
+def device_prefetch(loader, put_fn, depth: int):
+    """Device-side prefetch ring over a host-batch iterable.
+
+    Yields ``(it, device_batch, timing)`` in loader order. With
+    ``depth > 0`` the ring keeps the NEXT ``depth`` batches already put
+    (``put_fn`` = the sharded ``jax.device_put``, an async dispatch), so
+    the H2D transfers of batches k+1..k+depth overlap the consumer's step
+    on batch k instead of serializing behind it. ``depth 0`` reproduces
+    the unoverlapped put-then-step order exactly. Any depth is
+    value-bit-identical: the put order, step order, and batch contents
+    never change — only when each transfer is dispatched.
+
+    ``timing`` carries the loader's assembly stamps (when the iterable is
+    a ``Loader``) plus ``get0/get1`` (consumer blocked on the host batch)
+    and ``put0/put1`` (H2D dispatch) — the consumer-side half of the
+    utils/jsonlog timeline schema; the caller adds ``step0/step1``.
+    """
+    get_timing = getattr(loader, "last_timing", lambda: None)
+    src = iter(loader)
+
+    def pull():
+        get0 = time.perf_counter()
+        try:
+            hb = next(src)
+        except StopIteration:
+            return None
+        get1 = time.perf_counter()
+        tl = dict(get_timing() or {})
+        tl["get0"], tl["get1"] = get0, get1
+        tl["n"] = int(np.shape(hb["image"])[0]) if "image" in hb else 0
+        tl["put0"] = time.perf_counter()
+        db = put_fn(hb)
+        tl["put1"] = time.perf_counter()
+        return db, tl
+
+    ring: deque = deque()
+    exhausted = False
+    it = 0
+    while True:
+        while not exhausted and len(ring) < max(0, depth) + 1:
+            item = pull()
+            if item is None:
+                exhausted = True
+            else:
+                ring.append(item)
+        if not ring:
+            return
+        db, tl = ring.popleft()
+        yield it, db, tl
+        it += 1
 
 
 def _build_dataset(split: str, train: bool):

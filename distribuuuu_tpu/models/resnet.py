@@ -120,6 +120,13 @@ class ResNet(nn.Module):
     dtype: Any = jnp.bfloat16
     bn_group: int = 0
     s2d_stem: bool = False
+    # Rematerialize stages 1-2 (``TRAIN.REMAT``): their blocks hold the
+    # largest activations (56²/28² maps), so on an HBM-bus-bound step
+    # recomputing them in the backward trades spare MXU flops for the
+    # stored-activation traffic. ``nn.remat`` is a lifted transform — the
+    # param tree, init, and math are identical with the knob on or off
+    # (step equivalence: tests/test_remat.py); checkpoints interchange.
+    remat: bool = False
     stage_features = (64, 128, 256, 512)
 
     @nn.compact
@@ -133,14 +140,20 @@ class ResNet(nn.Module):
         )(x, train=train)
         x = max_pool_3x3_s2(x)
         in_features = 64
+        block_idx = 0
         for stage, (feats, n_blocks) in enumerate(
             zip(self.stage_features, self.layers)
         ):
+            block_cls = self.block
+            if self.remat and stage < 2:
+                # train is arg 2 of __call__ (after self, x): static — it
+                # selects the traced graph, it is not a tracer
+                block_cls = nn.remat(self.block, static_argnums=(2,))
             strides = 1 if stage == 0 else 2
             for i in range(n_blocks):
                 s = strides if i == 0 else 1
                 needs_down = s != 1 or in_features != feats * self.block.expansion
-                x = self.block(
+                x = block_cls(
                     features=feats,
                     strides=s,
                     downsample=needs_down and i == 0,
@@ -149,7 +162,14 @@ class ResNet(nn.Module):
                     zero_init_residual=self.zero_init_residual,
                     dtype=self.dtype,
                     bn_group=self.bn_group,
-                )(x, train=train)
+                    # the name auto-naming would give the UNwrapped class:
+                    # nn.remat prefixes the class name ("CheckpointBasic
+                    # Block_0"), which would fork the param tree between
+                    # the two modes — pinning the name keeps checkpoints
+                    # mode-independent
+                    name=f"{self.block.__name__}_{block_idx}",
+                )(x, train)  # positional: static_argnums above indexes it
+                block_idx += 1
                 in_features = feats * self.block.expansion
         x = global_avg_pool(x)
         x = Dense(self.num_classes, dtype=head_dtype(x.dtype))(

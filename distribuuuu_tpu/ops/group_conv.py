@@ -63,15 +63,24 @@ _VMEM_BUDGET = 9 * 2 ** 20
 
 def _pick_bb(batch: int, hp: int, wp: int, c_all: int, ho: int, wo: int,
              cg: int, fg: int, groups: int, itemsize: int) -> int:
-    """Largest batch tile whose blocks fit the VMEM budget."""
+    """Largest batch tile whose blocks fit the VMEM budget.
+
+    Accumulator accounting (ADVICE r5): ``_kernel_s1`` keeps ALL G group
+    accumulators live until the final lane-concatenate — the per-group
+    results are collected in ``outs`` and merged in one 4D store — so the
+    live fp32 accumulator footprint is bb·ho·wo·G·fg, not one group's,
+    plus the concatenated output temp that exists before the store. The
+    earlier one-group model could admit a batch tile whose real peak
+    overflowed VMEM on compiled TPU runs (loud Mosaic failure)."""
     for bb in (32, 16, 8, 4, 2, 1):
         if batch % bb:
             continue
         x_block = bb * hp * wp * c_all * itemsize     # input tile
         o_block = bb * ho * wo * groups * fg * itemsize
-        acc = bb * ho * wp * fg * 4                   # fp32 accumulator
+        acc = bb * ho * wo * groups * fg * 4          # ALL G fp32 accums live
+        concat = bb * ho * wo * groups * fg * itemsize  # lane-merged temp
         scratch = bb * hp * wp * cg * itemsize * 2    # group gather + taps
-        if x_block + o_block + acc + scratch <= _VMEM_BUDGET:
+        if x_block + o_block + acc + concat + scratch <= _VMEM_BUDGET:
             return bb
     return 1
 

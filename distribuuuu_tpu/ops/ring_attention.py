@@ -32,7 +32,7 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh, PartitionSpec as P
 
-from distribuuuu_tpu.parallel.compat import shard_map
+from distribuuuu_tpu.parallel.compat import axis_size, shard_map
 
 _NEG_BIG = -0.7 * float(jnp.finfo(jnp.float32).max)  # safe additive -inf
 
@@ -119,7 +119,7 @@ def ring_self_attention(
     and masks them), and the local block runs the kernel's causal
     block-skip — ring + causal flash composition (VERDICT r3 #4).
     """
-    n = jax.lax.axis_size(axis_name)
+    n = axis_size(axis_name)
     my_idx = jax.lax.axis_index(axis_name)
     b, h, sq, d = q.shape
     sk = k.shape[2]
@@ -210,7 +210,7 @@ def ulysses_self_attention(
     runs full (flash-style fp32-softmax) attention on the local head subset,
     and re-shards back. heads must divide by the axis size.
     """
-    n = jax.lax.axis_size(axis_name)
+    n = axis_size(axis_name)
     assert q.shape[1] % n == 0, (
         f"heads {q.shape[1]} not divisible by seq axis {n}"
     )

@@ -1,9 +1,9 @@
-"""Version-compat wrapper for ``jax.shard_map`` with manual-collective
-semantics (no varying-axes checking).
+"""Version-compat wrappers for the shard_map surface: ``shard_map`` itself
+(manual-collective semantics, no varying-axes checking) and ``axis_size``.
 
 One shim for every shard_map user in the framework (ring/Ulysses attention,
-pipeline parallelism, benches): jax >= 0.8 spells the API ``jax.shard_map``
-with ``check_vma``; older releases spell it
+pipeline parallelism, MoE dispatch, benches): jax >= 0.8 spells the API
+``jax.shard_map`` with ``check_vma``; older releases spell it
 ``jax.experimental.shard_map.shard_map`` with ``check_rep``. All call sites
 here want the classic per-device semantics where collectives are written
 explicitly, so the check is always disabled.
@@ -11,10 +11,28 @@ explicitly, so the check is always disabled.
 
 from __future__ import annotations
 
+import jax
+
 try:  # jax >= 0.8
     from jax import shard_map as _shard_map
 except ImportError:  # pragma: no cover - older jax
     from jax.experimental.shard_map import shard_map as _shard_map
+
+
+def axis_size(name: str) -> int:
+    """Size of the bound mesh axis ``name`` inside a shard_map/pmap body.
+
+    ``jax.lax.axis_size`` only exists in newer JAX; on releases without it
+    (0.4.x — this container) ``psum`` of the literal int 1 over the axis
+    constant-folds to the axis size at trace time, with identical
+    semantics including the NameError on an unbound axis name. Every
+    in-graph axis-size read (pp/ring/moe/vit) routes through here: a bare
+    ``jax.lax.axis_size`` call breaks every shard_map path on 0.4.x with
+    an AttributeError (r6 finding — the whole PP/ring/dispatch-MoE tier
+    was dead in this environment until this shim)."""
+    if hasattr(jax.lax, "axis_size"):
+        return jax.lax.axis_size(name)
+    return jax.lax.psum(1, name)
 
 
 def shard_map(fn, *, mesh, in_specs, out_specs):

@@ -93,6 +93,26 @@ def setup_distributed(port: int | None = None) -> None:
     # must run before anything initializes the XLA backend, so no jax API
     # (even jax.process_count()) may be touched on the way in.
     coord_port = port or int(os.environ.get("COORDINATOR_PORT", _DEFAULT_COORD_PORT))
+    multi = (
+        "COORDINATOR_ADDRESS" in os.environ
+        or ("SLURM_PROCID" in os.environ
+            and int(os.environ.get("SLURM_NTASKS", "1")) > 1)
+        or ("MASTER_ADDR" in os.environ
+            and int(os.environ.get("WORLD_SIZE", "1")) > 1)
+    )
+    if multi:
+        # The CPU client ships its cross-process collectives behind a flag
+        # that defaults to "none", and a none-collectives client REFUSES
+        # every computation spanning processes ("Multiprocess computations
+        # aren't implemented on the CPU backend") — which silently breaks
+        # the whole multi-process drill suite on CPU hosts. Select gloo
+        # before the backend initializes; harmless on TPU (the option only
+        # shapes CPU client creation) and absent option names are ignored
+        # for jax versions without the knob.
+        try:
+            jax.config.update("jax_cpu_collectives_implementation", "gloo")
+        except (AttributeError, ValueError):
+            pass
     if "COORDINATOR_ADDRESS" in os.environ:
         jax.distributed.initialize()  # JAX reads its own env contract
     elif "SLURM_PROCID" in os.environ and int(os.environ.get("SLURM_NTASKS", "1")) > 1:

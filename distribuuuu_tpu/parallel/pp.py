@@ -31,7 +31,7 @@ import jax.numpy as jnp
 import numpy as np
 from jax.sharding import NamedSharding, PartitionSpec as P
 
-from distribuuuu_tpu.parallel.compat import shard_map
+from distribuuuu_tpu.parallel.compat import axis_size, shard_map
 
 
 def stack_stage_params(param_list):
@@ -79,7 +79,7 @@ def pipeline_apply(
       channel; gradients flow through the scan carry, so an aux-derived
       loss term trains correctly through the pipeline).
     """
-    S = jax.lax.axis_size(axis)
+    S = axis_size(axis)
     s = jax.lax.axis_index(axis)
     M = microbatches.shape[0]
     T = M + S - 1

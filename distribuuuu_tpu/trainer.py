@@ -34,7 +34,11 @@ import optax
 
 from distribuuuu_tpu import models
 from distribuuuu_tpu.config import cfg
-from distribuuuu_tpu.data import construct_train_loader, construct_val_loader
+from distribuuuu_tpu.data import (
+    construct_train_loader,
+    construct_val_loader,
+    device_prefetch,
+)
 from distribuuuu_tpu.models.layers import head_dtype, resolve_dtype
 from distribuuuu_tpu.parallel import (
     mesh as mesh_lib,
@@ -44,7 +48,11 @@ from distribuuuu_tpu.parallel import (
 )
 from distribuuuu_tpu.utils import checkpoint as ckpt
 from distribuuuu_tpu.utils import preempt
-from distribuuuu_tpu.utils.jsonlog import metrics_log, setup_metrics_log
+from distribuuuu_tpu.utils.jsonlog import (
+    metrics_log,
+    setup_metrics_log,
+    timeline_log,
+)
 from distribuuuu_tpu.utils.logger import get_logger, setup_logger
 from distribuuuu_tpu.utils.meters import AverageMeter, construct_meters
 from distribuuuu_tpu.utils.metrics import accuracy, count_parameters, cross_entropy
@@ -132,6 +140,18 @@ def build_model_from_cfg():
         ("resnet", "resnext", "wide_resnet", "botnet", "densenet")
     ):
         kwargs["s2d_stem"] = cfg.DEVICE.S2D_STEM
+    if cfg.MODEL.ARCH.startswith(("resnet", "resnext", "wide_resnet")):
+        # remat-for-traffic on the bus-bound step (PERF.md roofline):
+        # recompute stage 1-2 block activations in the backward instead of
+        # storing them (models/resnet.py). Exact same math.
+        kwargs["remat"] = bool(cfg.TRAIN.REMAT)
+    elif cfg.TRAIN.REMAT:
+        raise ValueError(
+            f"TRAIN.REMAT targets the resnet/resnext/wide_resnet family "
+            f"(stages 1-2 rematerialization); {cfg.MODEL.ARCH!r} does not "
+            "take the knob (densenet always remats its dense layers) — "
+            "refusing rather than silently measuring an unchanged step"
+        )
     if cfg.MODEL.ARCH == "botnet50":
         # the attention grid follows the input size; each stride-2 op maps
         # n → ceil(n/2), so the stride-16 backbone gives ceil(IM_SIZE/16).
@@ -632,21 +652,49 @@ def train_epoch(loader, mesh, state, train_step, epoch: int, logger,
                     **extra,
                 )
 
-    # Two preallocated (fold, batch, ...) host buffers, ping-ponged per
-    # dispatch: device_put may still be reading buffer A asynchronously
-    # while the next fold fills buffer B. Before REFILLING a buffer, fence
-    # on the device batch previously created from it — readiness implies the
-    # H2D transfer has consumed the host memory (near-zero cost in steady
-    # state; without it a deep dispatch backlog could overwrite a buffer a
-    # pending transfer is still reading, silently corrupting a batch).
-    stack_bufs, buf_idx = None, 0
-    inflight = [None, None]  # device batch last created from each buffer
-    end = time.perf_counter()
-    win_start = end  # start of the current fold window (covers buffering too)
-    for it, host_batch in enumerate(loader):
-        data_time.update(time.perf_counter() - end)
-        is_last = it + 1 == num_batches
-        if fold > 1:
+    def preempt_break(batches_done: int) -> bool:
+        """Preemption check at window granularity: requested_global() makes
+        every process agree on the exit boundary (the save is collective).
+        A COMPLETED epoch never reports interrupted — it falls through to
+        the normal validate/save path (re-running a fully-trained epoch
+        from its own end state would double-train it)."""
+        nonlocal windows_seen, interrupted
+        windows_seen += 1
+        if (
+            watch_preemption
+            and batches_done < num_batches
+            and windows_seen % preempt_check_every == 0
+            and preempt.requested_global()
+        ):
+            flush_pending()
+            if mesh_lib.is_primary():
+                logger.warning(
+                    "preemption signaled — leaving epoch %d at batch %d/%d",
+                    epoch + 1, batches_done, num_batches,
+                )
+            interrupted = True
+            return True
+        return False
+
+    emit_timeline = cfg.TRAIN.TIMELINE and mesh_lib.is_primary()
+    if fold > 1:
+        # Two preallocated (fold, batch, ...) host buffers, ping-ponged per
+        # dispatch: device_put may still be reading buffer A asynchronously
+        # while the next fold fills buffer B. Before REFILLING a buffer,
+        # fence on the device batch previously created from it — readiness
+        # implies the H2D transfer has consumed the host memory (near-zero
+        # cost in steady state; without it a deep dispatch backlog could
+        # overwrite a buffer a pending transfer is still reading, silently
+        # corrupting a batch). No per-batch timeline records in this mode
+        # (stage boundaries are fold-granular); STEPS_PER_CALL 1 is the
+        # attribution mode.
+        stack_bufs, buf_idx = None, 0
+        inflight = [None, None]  # device batch last created from each buffer
+        end = time.perf_counter()
+        win_start = end  # start of the current fold window (incl. buffering)
+        for it, host_batch in enumerate(loader):
+            data_time.update(time.perf_counter() - end)
+            is_last = it + 1 == num_batches
             # copy into the preallocated fold slot NOW (spreads the host
             # memcpy across the fold window, overlapped with the device
             # executing the previous call) instead of np.stack-ing the
@@ -697,37 +745,38 @@ def train_epoch(loader, mesh, state, train_step, epoch: int, logger,
             now = time.perf_counter()
             batch_time.update((now - win_start) / n, n=n)
             win_start = now
-        else:
-            batch = put_batch(host_batch)
+            end = time.perf_counter()
+            maybe_print()
+            if preempt_break(done):
+                break
+    else:
+        # Per-step dispatch through the device-side prefetch ring
+        # (data/loader.device_prefetch): the H2D transfer of batches
+        # it+1..it+depth is dispatched while the step for batch `it` runs,
+        # so transfer never serializes behind the step; depth 0 restores
+        # the serial put-then-step order. Results are value-bit-identical
+        # at every depth (same put/step order — tests/test_overlap.py).
+        # Each dispatched batch leaves one kind="timeline" record with its
+        # stage-boundary timestamps (tools/overlap_report.py attributes
+        # the epoch wall from them).
+        depth = max(0, cfg.TRAIN.PREFETCH_DEVICE)
+        end = time.perf_counter()
+        for it, batch, tl in device_prefetch(loader, put_batch, depth):
+            data_time.update(tl["get1"] - tl["get0"])
             prof.begin(it)
+            tl["step0"] = time.perf_counter()
             state, metrics = train_step(state, batch)
+            tl["step1"] = time.perf_counter()
             prof.end(it, state)
             pending.append((1, metrics))
             done += 1
             batch_time.update(time.perf_counter() - end)
-        end = time.perf_counter()
-        maybe_print()
-        # preemption check at window granularity: requested_global() makes
-        # every process agree on the exit boundary (the save is collective).
-        # A COMPLETED epoch never reports interrupted — it falls through to
-        # the normal validate/save path (re-running a fully-trained epoch
-        # from its own end state would double-train it).
-        batches_done = done if fold > 1 else it + 1
-        windows_seen += 1
-        if (
-            watch_preemption
-            and batches_done < num_batches
-            and windows_seen % preempt_check_every == 0
-            and preempt.requested_global()
-        ):
-            flush_pending()
-            if mesh_lib.is_primary():
-                logger.warning(
-                    "preemption signaled — leaving epoch %d at batch %d/%d",
-                    epoch + 1, batches_done, num_batches,
-                )
-            interrupted = True
-            break
+            end = time.perf_counter()
+            if emit_timeline:
+                timeline_log("train", epoch + 1, it, tl.pop("n", 0), **tl)
+            maybe_print()
+            if preempt_break(it + 1):
+                break
     prof.finish(state)
     return state, interrupted
 
@@ -748,15 +797,28 @@ def validate(loader, mesh, state, eval_step, epoch: int, logger):
     totals = None
     pending_print = None  # previous window's (batch_idx, totals) — async copy
     num_batches = len(loader)
+    # same overlap machinery as train_epoch's per-step path (VERDICT r5
+    # item 5 leftover: eval had none): the device prefetch ring dispatches
+    # the H2D transfer of batches it+1..it+depth while eval_step(it) runs,
+    # and each batch leaves a phase="eval" timeline record. Metric totals
+    # are a pure sum — overlap order cannot change them (equivalence:
+    # tests/test_overlap.py).
+    emit_timeline = cfg.TRAIN.TIMELINE and mesh_lib.is_primary()
+    depth = max(0, cfg.TRAIN.PREFETCH_DEVICE)
     end = time.perf_counter()
-    for it, host_batch in enumerate(loader):
-        batch = sharding_lib.shard_batch(mesh, host_batch)
+    for it, batch, tl in device_prefetch(
+        loader, functools.partial(sharding_lib.shard_batch, mesh), depth
+    ):
+        tl["step0"] = time.perf_counter()
         m = eval_step(state, batch)
         totals = (
             m
             if totals is None
             else jax.tree.map(jnp.add, totals, m)
         )
+        tl["step1"] = time.perf_counter()
+        if emit_timeline:
+            timeline_log("eval", epoch + 1, it, tl.pop("n", 0), **tl)
         at_check_site = (
             watch_preemption
             and (it + 1) % cfg.TEST.PRINT_FREQ == 0

@@ -32,6 +32,41 @@ def setup_metrics_log(out_dir: str, primary: bool = True) -> None:
     )
 
 
+# Stage-boundary timestamp fields of one kind="timeline" record, in
+# pipeline order (all values are time.perf_counter() seconds — one
+# monotonic clock per process, so records are differenced, never read as
+# wall-clock dates):
+#   submit        batch assembly submitted to the loader worker pool
+#   dec0 / dec1   decode+augment interval (worker thread; dataset access)
+#   asm1          host batch assembled (stack/pad/dict done)
+#   get0 / get1   consumer blocked waiting on the host batch
+#   put0 / put1   H2D dispatch (shard_batch/device_put) interval
+#   step0 / step1 compiled step dispatch interval
+# Worker-side intervals (submit..asm1) overlap each other and the
+# consumer; consumer-side intervals (get/put/step) are disjoint, so their
+# sums — plus the residual — partition the epoch wall time exactly
+# (tools/overlap_report.py does that attribution).
+TIMELINE_STAGES = (
+    "submit", "dec0", "dec1", "asm1",
+    "get0", "get1", "put0", "put1", "step0", "step1",
+)
+TIMELINE_SCHEMA = 1
+
+
+def timeline_log(phase: str, epoch: int, batch: int, n: int, **stamps) -> None:
+    """One per-batch timeline record: ``phase`` ("train"/"eval"), 1-based
+    ``epoch``, 0-based ``batch`` index, ``n`` images in the batch, and the
+    TIMELINE_STAGES timestamps present in ``stamps`` (µs-rounded). No-op
+    when the sink is not set up — non-primary processes and library use."""
+    if _sink["f"] is None:
+        return
+    rec = {k: round(float(stamps[k]), 6) for k in TIMELINE_STAGES if k in stamps}
+    metrics_log(
+        "timeline", v=TIMELINE_SCHEMA, phase=phase, epoch=epoch, batch=batch,
+        n=n, **rec,
+    )
+
+
 def metrics_log(kind: str, **fields) -> None:
     """Append one record: {"t": unix_time, "kind": kind, **fields}.
     No-op when the sink is not set up (non-primary, tests, library use)."""

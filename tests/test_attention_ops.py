@@ -44,8 +44,11 @@ def test_relative_logits_1d_shapes_and_broadcast():
     for y in range(W):
         for j in range(W):
             expected = q[:, :, :, y, :] @ rel_k[(j - y) + (W - 1)]
+            # atol floor: with no atol, a near-zero dot product turns fp32
+            # rounding (~1e-8 abs) into an rtol violation — XLA CPU's
+            # einsum reassociation drifts exactly one such element
             np.testing.assert_allclose(
-                out[:, :, :, 0, y, j], expected, rtol=1e-5
+                out[:, :, :, 0, y, j], expected, rtol=1e-5, atol=1e-6
             )
 
 

@@ -200,3 +200,51 @@ class TestPallasGroupConv:
         np.testing.assert_allclose(
             np.asarray(got), np.asarray(ref), rtol=1e-4, atol=1e-4
         )
+
+    def test_pick_bb_counts_all_group_accumulators(self):
+        """ADVICE r5: the VMEM sizing model must count all G live group
+        accumulators (bb·ho·wo·G·fg fp32 — _kernel_s1 holds every group's
+        result until the final concatenate) plus the concatenated output
+        temp, not one group's. Checked two ways: every chosen bb respects
+        the corrected budget, and the regnety stage-3 shape where the old
+        one-group model over-picked now tiles smaller."""
+        from distribuuuu_tpu.ops import group_conv as gc
+
+        def corrected_need(bb, hp, wp, c_all, ho, wo, cg, fg, G, isz):
+            return (bb * hp * wp * c_all * isz
+                    + bb * ho * wo * G * fg * isz      # output block
+                    + bb * ho * wo * G * fg * 4        # all G fp32 accums
+                    + bb * ho * wo * G * fg * isz      # concat temp
+                    + bb * hp * wp * cg * isz * 2)     # gather + taps
+
+        def old_need(bb, hp, wp, c_all, ho, wo, cg, fg, G, isz):
+            # the pre-fix model: ONE group's accumulator (and ho·wp at that)
+            return (bb * hp * wp * c_all * isz
+                    + bb * ho * wo * G * fg * isz
+                    + bb * ho * wp * fg * 4
+                    + bb * hp * wp * cg * isz * 2)
+
+        cases = [
+            # (batch, hp, wp, c_all, ho, wo, cg, fg, G, itemsize)
+            (64, 16, 16, 1232, 14, 14, 112, 112, 11, 2),  # regnety_160 s3
+            (64, 16, 16, 1232, 14, 14, 112, 112, 11, 4),
+            (32, 30, 30, 512, 28, 28, 64, 64, 8, 2),
+            (8, 9, 9, 33, 7, 7, 11, 11, 3, 4),
+        ]
+        for shape in cases:
+            batch = shape[0]
+            bb = gc._pick_bb(*shape)
+            assert batch % bb == 0
+            assert bb == 1 or corrected_need(bb, *shape[1:]) <= gc._VMEM_BUDGET
+            # maximality: the next larger divisor tile must NOT fit
+            larger = [b for b in (32, 16, 8, 4, 2) if b > bb and batch % b == 0]
+            if larger:
+                assert corrected_need(min(larger), *shape[1:]) > gc._VMEM_BUDGET
+
+        # regression: the stage-3 shape the advice targeted — the old model
+        # accepted bb=4 (its peak under the corrected accounting exceeds
+        # the budget); the fixed model must shrink the tile
+        s3 = (64, 16, 16, 1232, 14, 14, 112, 112, 11, 2)
+        assert old_need(4, *s3[1:]) <= gc._VMEM_BUDGET
+        assert corrected_need(4, *s3[1:]) > gc._VMEM_BUDGET
+        assert gc._pick_bb(*s3) < 4

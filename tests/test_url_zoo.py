@@ -105,6 +105,79 @@ def test_digest_check(tmp_path):
     assert url_zoo._digest_ok(str(p), "https://x/model.pth")
 
 
+def test_full_digest_pin_is_authoritative(tmp_path):
+    """ADVICE r5: when a full 64-hex pin exists (MODEL_SHA256 or an
+    explicit sidecar pin) the COMPLETE hash is compared — the 32-bit
+    filename prefix is neither sufficient (wrong tail ⇒ reject) nor
+    necessary (pin match ⇒ accept even when the prefix disagrees)."""
+    import hashlib
+
+    payload = b"weights-payload"
+    p = tmp_path / "w.bin"
+    p.write_bytes(payload)
+    full = hashlib.sha256(payload).hexdigest()
+    prefix_url = f"https://x/model-{full[:8]}.pth"
+
+    # prefix matches but the full pin has a different tail: rejected
+    forged = full[:8] + "0" * 56
+    assert not url_zoo._digest_ok(str(p), prefix_url, pin=forged)
+    # full pin matches while the filename prefix does NOT: accepted
+    assert url_zoo._digest_ok(str(p), "https://x/model-00000000.pth", pin=full)
+    # MODEL_SHA256 table drives the same comparison per arch
+    try:
+        url_zoo.MODEL_SHA256["resnet18"] = full
+        assert url_zoo._digest_ok(str(p), "https://x/model-00000000.pth",
+                                  arch="resnet18")
+        url_zoo.MODEL_SHA256["resnet18"] = forged
+        assert not url_zoo._digest_ok(str(p), prefix_url, arch="resnet18")
+    finally:
+        url_zoo.MODEL_SHA256.pop("resnet18", None)
+
+
+def test_sidecar_pin_verifies_cache_with_complete_hash(tmp_cache, monkeypatch):
+    """A verified download records its full sha256 in a ``.sha256``
+    sidecar; later cache hits verify the COMPLETE hash against it, so
+    cache tampering is caught (and triggers a re-download) even for a URL
+    with no filename-embedded digest, where the old prefix-only check had
+    nothing to verify."""
+    import io
+
+    payload = b"real-zoo-weights"
+    calls = []
+
+    class FakeResponse(io.BytesIO):
+        def __enter__(self):
+            return self
+
+        def __exit__(self, *a):
+            return False
+
+    def fake_urlopen(url, timeout=None):
+        calls.append(url)
+        return FakeResponse(payload)
+
+    monkeypatch.setitem(
+        url_zoo.MODEL_URLS, "resnet18", "https://x/model.pth"
+    )  # no embedded digest → only the full-hash sidecar protects the cache
+    monkeypatch.setattr(url_zoo.urllib.request, "urlopen", fake_urlopen)
+
+    path = url_zoo.fetch("resnet18")
+    assert len(calls) == 1
+    assert url_zoo._read_pin(path) == url_zoo._sha256(path)
+
+    # clean cache hit: full-hash pin verifies, no network call
+    assert url_zoo.fetch("resnet18") == path
+    assert len(calls) == 1
+
+    # tamper the cached pickle (prefix-less URL: undetectable pre-sidecar)
+    with open(path, "ab") as f:
+        f.write(b"tampered")
+    assert url_zoo.fetch("resnet18") == path
+    assert len(calls) == 2  # mismatch detected → re-downloaded
+    with open(path, "rb") as f:
+        assert f.read() == payload
+
+
 def test_download_failing_digest_raises(tmp_cache, monkeypatch):
     import io
 

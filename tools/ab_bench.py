@@ -52,6 +52,16 @@ def _env(overrides: dict[str, str]):
                 os.environ[k] = v
 
 
+# Named A/B presets for the standing experiments (expanded into --a/--b
+# env pairs before parsing): each is a knob bench.build_workload reads at
+# trace time.
+PRESETS = {
+    # remat-for-traffic (VERDICT r5 #3): TRAIN.REMAT on ResNet stages 1-2
+    # vs HEAD — the one untried roofline lever on the 93%-HBM-bus step.
+    "remat": {"b": ["DISTRIBUUUU_REMAT=1"]},
+}
+
+
 def _parse_kv(pairs: list[str]) -> dict[str, str]:
     out = {}
     for p in pairs:
@@ -68,6 +78,9 @@ def main():
                     help="env for variant A (default: inherited env = HEAD)")
     ap.add_argument("--b", action="append", default=[], metavar="K=V",
                     help="env for variant B (repeatable)")
+    ap.add_argument("--preset", choices=sorted(PRESETS),
+                    help="named A/B (e.g. 'remat' = HEAD vs "
+                         "DISTRIBUUUU_REMAT=1); composes with --a/--b")
     ap.add_argument("--rounds", type=int, default=5,
                     help="interleaved timing rounds (paired ratios)")
     ap.add_argument("--iters", type=int, default=10,
@@ -76,9 +89,14 @@ def main():
     ap.add_argument("--per-chip-batch", type=int, default=128)
     args = ap.parse_args()
 
+    if args.preset:
+        args.a = PRESETS[args.preset].get("a", []) + args.a
+        args.b = PRESETS[args.preset].get("b", []) + args.b
     a_env, b_env = _parse_kv(args.a), _parse_kv(args.b)
     if not b_env and not a_env:
-        raise SystemExit("nothing to compare: pass at least --b KEY=VALUE")
+        raise SystemExit(
+            "nothing to compare: pass at least --b KEY=VALUE or --preset"
+        )
 
     import bench  # repo-root bench.py via _path
 

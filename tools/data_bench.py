@@ -70,13 +70,24 @@ def bench_backend(root: str, backend: str, epochs: int, im_size: int,
     for _ in loader:
         pass
     n = 0
+    dec_s = asm_s = 0.0
     t0 = time.perf_counter()
     for epoch in range(1, 1 + epochs):
         loader.set_epoch(epoch)
         for batch in loader:
             n += batch["image"].shape[0]
+            # per-batch stage stamps the loader records anyway (the
+            # timeline schema, utils/jsonlog): split decode+augment from
+            # host batch assembly (stack/pad) per image
+            tl = loader.last_timing()
+            dec_s += tl["dec1"] - tl["dec0"]
+            asm_s += tl["asm1"] - tl["dec1"]
     dt = time.perf_counter() - t0
-    return n / dt
+    return {
+        "img_per_sec": n / dt,
+        "decode_ms_per_img": dec_s / n * 1e3,
+        "assemble_ms_per_img": asm_s / n * 1e3,
+    }
 
 
 def main():
@@ -125,16 +136,25 @@ def main():
     results = {}
     for b in backends:
         for w in worker_counts:
-            results[(b, w)] = bench_backend(
+            results[(b, w)] = r = bench_backend(
                 root, b, args.epochs, args.im_size, w, args.batch_size
             )
             print(
                 json.dumps(
                     {
                         "metric": f"input_pipeline_{b}_images_per_sec",
-                        "value": round(results[(b, w)], 1),
+                        "value": round(r["img_per_sec"], 1),
                         "unit": "images/sec",
                         "workers": w,
+                        # stage split from the loader's per-batch stamps:
+                        # worker-thread busy ms per image, decode+augment
+                        # vs batch assembly (stack/pad)
+                        "decode_ms_per_img": round(
+                            r["decode_ms_per_img"], 3
+                        ),
+                        "assemble_ms_per_img": round(
+                            r["assemble_ms_per_img"], 3
+                        ),
                     }
                 ),
                 flush=True,
@@ -142,7 +162,7 @@ def main():
     if len(backends) == 2:
         for w in worker_counts:
             print(f"# workers={w}: native speedup over PIL "
-                  f"{results[('native', w)] / results[('pil', w)]:.2f}x")
+                  f"{results[('native', w)]['img_per_sec'] / results[('pil', w)]['img_per_sec']:.2f}x")
 
 
 if __name__ == "__main__":
