@@ -287,6 +287,21 @@ _C.TRAIN.REMAT = False
 # BN batch stats are per-micro-batch — the same semantics torch DDP +
 # gradient accumulation has (stats over what the device sees per forward).
 _C.TRAIN.GRAD_ACCUM_STEPS = 1
+# Non-finite loss policy (resilience/supervisor.py). "raise" fails fast at
+# the next metric flush (honest failure beats silently training garbage);
+# "skip" discards the poisoned update IN-GRAPH (pre-step state selected,
+# step cursor still advances) and logs the skipped step — for rare bad
+# batches; "rollback" reloads the last intact checkpoint and re-runs
+# (TRAIN.MAX_ROLLBACKS attempts) — for transient corruption.
+_C.TRAIN.NONFINITE = "raise"
+_C.TRAIN.MAX_ROLLBACKS = 2
+# Heartbeat watchdog (resilience/supervisor.Heartbeat): warn + emit a
+# kind="stall" metrics record when no train-loop progress lands within
+# this many seconds — a wedged collective, dead peer host, or hung
+# storage would otherwise hang silently forever. 0 disables (default:
+# first-step compiles legitimately take minutes on some backends; set
+# ~2-5× your steady-state fold wall in production).
+_C.TRAIN.STALL_TIMEOUT = 0.0
 
 # ------------------------------- testing -----------------------------------
 _C.TEST = CfgNode()
@@ -387,6 +402,45 @@ _C.DATA.BACKEND = "auto"
 # (tests/test_device_normalize.py); False restores the reference's
 # host-normalized float pipeline byte-for-byte.
 _C.DATA.DEVICE_NORMALIZE = True
+# Loader-level resilience (data/loader.py): a failed sample/batch decode
+# is retried RETRIES times with exponential backoff starting at
+# RETRY_BACKOFF_S (transient filesystem/network hiccups), then — with
+# SKIP_CORRUPT — the corrupt sample is replaced by a good sample from the
+# same batch and logged (logger warning + kind="data_error" metrics
+# record) instead of aborting the whole epoch. False restores fail-stop.
+_C.DATA.RETRIES = 2
+_C.DATA.RETRY_BACKOFF_S = 0.05
+_C.DATA.SKIP_CORRUPT = True
+
+# ------------------------------- fault injection -----------------------------
+# Deterministic failure injection (utils/faults.py) — every resilience
+# recovery path is exercised by tests and tools/resilience_drill.py
+# through these knobs. All hooks are no-ops unless ENABLED.
+_C.FAULTS = CfgNode()
+_C.FAULTS.ENABLED = False
+# Compile `loss × where(step==NAN_STEP, NaN, 1)` into the train step:
+# loss AND grads go non-finite at exactly that global step. -1 = off.
+_C.FAULTS.NAN_STEP = -1
+# Decode of this dataset sample index raises. "once": the first retry
+# succeeds (transient I/O); "always": the loader's skip-and-log path
+# engages (corrupt file). -1 = off.
+_C.FAULTS.DECODE_ERROR_IDX = -1
+_C.FAULTS.DECODE_ERROR_MODE = "once"
+# SIGKILL process KILL_RANK at (KILL_EPOCH, KILL_AT_BATCH) — the
+# uncatchable hard crash. -1 = off.
+_C.FAULTS.KILL_RANK = -1
+_C.FAULTS.KILL_EPOCH = 0
+_C.FAULTS.KILL_AT_BATCH = -1
+# Sleep STALL_S seconds at (STALL_EPOCH, STALL_AT_BATCH) so the heartbeat
+# watchdog must flag. -1 = off.
+_C.FAULTS.STALL_EPOCH = 0
+_C.FAULTS.STALL_AT_BATCH = -1
+_C.FAULTS.STALL_S = 0.0
+# After ckpt_ep_{CORRUPT_EPOCH} commits: "truncate" halves its largest
+# payload file (digest-mismatch path); "partial" deletes its manifest
+# (crash-before-commit path). -1 = off.
+_C.FAULTS.CORRUPT_EPOCH = -1
+_C.FAULTS.CORRUPT_MODE = "truncate"
 
 # ------------------------------- serving ------------------------------------
 # Online inference (serve/, serve_net.py) — the request-level engine that

@@ -26,6 +26,9 @@ from distribuuuu_tpu.config import cfg
 from distribuuuu_tpu.data.dummy import DummyDataset
 from distribuuuu_tpu.data.sampler import DistributedSampler
 from distribuuuu_tpu.parallel import mesh as mesh_lib
+from distribuuuu_tpu.utils import faults
+from distribuuuu_tpu.utils.jsonlog import metrics_log
+from distribuuuu_tpu.utils.logger import get_logger
 
 
 class Loader:
@@ -58,6 +61,14 @@ class Loader:
             except RuntimeError:
                 pass  # surfaces with a clear error at iteration time
         self.prefetch_depth = 2 if native_batch else self.workers
+        # Decode resilience (DATA.RETRIES / RETRY_BACKOFF_S / SKIP_CORRUPT):
+        # a failed decode retries with exponential backoff (transient
+        # filesystem/network hiccups), then the corrupt sample is replaced
+        # by a good one from the same batch and logged — one bad JPEG must
+        # not abort a million-image epoch. SKIP_CORRUPT False = fail-stop.
+        self.retries = max(0, int(cfg.DATA.RETRIES))
+        self.retry_backoff = float(cfg.DATA.RETRY_BACKOFF_S)
+        self.skip_corrupt = bool(cfg.DATA.SKIP_CORRUPT)
         # shard by DATA GROUP, not by process: processes sharing a data
         # row (model/pipe axes spanning hosts) must load identical data
         # (parallel/mesh.data_process_groups; ≡ (rank, world) in pure DP)
@@ -85,14 +96,7 @@ class Loader:
         timestamps of its assembly (utils/jsonlog.TIMELINE_STAGES subset:
         submit/dec0/dec1/asm1 — all ``time.perf_counter`` values)."""
         dec0 = time.perf_counter()
-        if hasattr(self.dataset, "load_batch"):
-            # ImageFolder path: batch-level decode (C++ kernel when built —
-            # one GIL-free call with an internal thread pool; PIL otherwise).
-            images, labels = self.dataset.load_batch(idxs, n_threads=self.workers)
-        else:
-            pairs = [self.dataset[int(i)] for i in idxs]
-            images = np.stack([p[0] for p in pairs])
-            labels = np.asarray([p[1] for p in pairs], np.int32)
+        images, labels = self._decode(idxs)
         dec1 = time.perf_counter()
         n = len(images)
         images = np.asarray(images)
@@ -114,6 +118,81 @@ class Loader:
             batch["mask"] = np.concatenate([batch["mask"], np.zeros(pad, np.float32)])
         return batch, {"submit": submit, "dec0": dec0, "dec1": dec1,
                        "asm1": time.perf_counter()}
+
+    def _fetch_sample(self, i: int):
+        """One sample with retry-with-backoff; ``None`` marks a
+        persistently corrupt sample (logged, skipped — DATA.SKIP_CORRUPT)
+        for the caller to substitute."""
+        delay = self.retry_backoff
+        err = None
+        for attempt in range(self.retries + 1):
+            try:
+                faults.maybe_decode_error(int(i))  # injection hook (tests)
+                return self.dataset[int(i)]
+            except Exception as e:
+                err = e
+                if attempt < self.retries:
+                    time.sleep(delay)
+                    delay *= 2
+        if not self.skip_corrupt:
+            raise RuntimeError(
+                f"sample {int(i)} failed decode after {self.retries + 1} "
+                "attempts (DATA.SKIP_CORRUPT False — fail-stop)"
+            ) from err
+        get_logger().warning(
+            "corrupt sample %d skipped after %d attempts (%s: %s) — "
+            "substituting a good sample from the same batch",
+            int(i), self.retries + 1, type(err).__name__, err,
+        )
+        metrics_log(
+            "data_error", index=int(i), attempts=self.retries + 1,
+            error=f"{type(err).__name__}: {err}",
+        )
+        return None
+
+    def _decode(self, idxs) -> tuple:
+        """(images, labels) via the batch kernel when available, else
+        per-sample — both behind retry-with-backoff. A batch-level decode
+        that keeps failing falls back to the per-sample path, which
+        isolates and substitutes the corrupt sample(s) instead of
+        aborting the epoch."""
+        if hasattr(self.dataset, "load_batch"):
+            delay = self.retry_backoff
+            err = None
+            for attempt in range(self.retries + 1):
+                try:
+                    for i in idxs:
+                        faults.maybe_decode_error(int(i))
+                    return self.dataset.load_batch(
+                        idxs, n_threads=self.workers
+                    )
+                except Exception as e:
+                    err = e
+                    if attempt < self.retries:
+                        time.sleep(delay)
+                        delay *= 2
+            if not self.skip_corrupt:
+                raise RuntimeError(
+                    f"batch decode failed after {self.retries + 1} attempts "
+                    "(DATA.SKIP_CORRUPT False — fail-stop)"
+                ) from err
+            get_logger().warning(
+                "batch decode failed after %d attempts (%s: %s) — "
+                "isolating per-sample", self.retries + 1,
+                type(err).__name__, err,
+            )
+        samples = [self._fetch_sample(i) for i in idxs]
+        good = [s for s in samples if s is not None]
+        if not good:
+            raise RuntimeError(
+                f"all {len(list(idxs))} samples in the batch failed decode — "
+                "not a stray corrupt file; check the dataset/storage "
+                "(first indices: " + ", ".join(str(int(i)) for i in list(idxs)[:4]) + ")"
+            )
+        samples = [s if s is not None else good[0] for s in samples]
+        images = np.stack([p[0] for p in samples])
+        labels = np.asarray([p[1] for p in samples], np.int32)
+        return images, labels
 
     def last_timing(self) -> dict | None:
         """Stage timestamps (submit/dec0/dec1/asm1) of the most recently

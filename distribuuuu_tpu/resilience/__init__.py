@@ -1,0 +1,28 @@
+"""Resilience layer: verified checkpoints, elastic resume, failure supervision.
+
+TPU fleets preempt slices, kill hosts mid-save, and resize pods; any run
+longer than the fleet MTBF must treat recovery as a first-class path, not
+an operator heroic. Three cooperating pieces (ISSUE 3):
+
+  manifest.py    crash-consistent checkpoint verification: every save
+                 commits a MANIFEST.json (tree spec + file digests + world
+                 topology) atomically AFTER the orbax payload, so a
+                 half-written checkpoint is detectable and auto-resume
+                 walks back to the newest intact save
+                 (utils/checkpoint.find_last_valid_checkpoint) instead of
+                 crashing on a truncated payload. The recorded topology
+                 also powers elastic cross-topology resume — a dp=N save
+                 restored onto a dp=M mesh — by distinguishing
+                 "re-shardable" from "incompatible".
+
+  supervisor.py  in-run failure supervision: the in-graph non-finite loss
+                 guard behind ``TRAIN.NONFINITE`` (raise / skip-step /
+                 rollback-to-last-checkpoint) and the heartbeat watchdog
+                 that flags stalled steps (``TRAIN.STALL_TIMEOUT``).
+
+Fault injection lives in ``utils/faults.py`` (the ``FAULTS.*`` config
+node); every recovery path here is exercised deterministically by
+``tests/test_resilience*.py`` and ``tools/resilience_drill.py``.
+"""
+
+from distribuuuu_tpu.resilience import manifest, supervisor  # noqa: F401

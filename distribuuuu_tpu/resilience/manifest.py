@@ -1,0 +1,250 @@
+"""Checkpoint manifests: crash consistency + topology for elastic resume.
+
+Orbax's directory write is not atomic from the trainer's point of view: a
+host dying mid-save leaves a ``ckpt_ep_*`` directory that LOOKS newest to
+a lexicographic scan but cannot be restored — before this layer such a
+dir was selected on the next start and killed the run inside tensorstore.
+The fix is the classic commit-marker protocol: after the collective orbax
+save returns on every process, the primary writes ``MANIFEST.json``
+(tmp-file + ``os.replace``, atomic on POSIX) recording
+
+  * the per-leaf tree spec (key path → shape/dtype) of the payload,
+  * a size + sha256 digest of every file in the checkpoint directory,
+  * the saving run's world topology (process/device counts, resolved
+    mesh axis sizes, ZeRO stage) and an arch-identity fingerprint.
+
+No manifest ⇒ the save never completed ⇒ the checkpoint is invalid.
+Manifest present but any file missing/resized/redigested ⇒ corrupt.
+``utils/checkpoint.find_last_valid_checkpoint`` uses ``verify_checkpoint``
+to walk back to the newest intact save, quarantining broken dirs to
+``*.corrupt``.
+
+The topology record is what makes resume ELASTIC rather than exact-mesh:
+``classify_topology`` compares the saved world against the live one and
+answers "exact" (same mesh), "reshardable" (same model identity, different
+mesh/process layout — restore proceeds, arrays are re-placed onto the live
+layout by ``trainer._place_like`` / ``pack_opt_state`` reassembly), or
+"incompatible" (different param tree — refuse with the reason, instead of
+a cryptic shape error deep in device_put).
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import os
+
+import jax
+import numpy as np
+
+from distribuuuu_tpu.config import cfg
+
+MANIFEST_NAME = "MANIFEST.json"
+MANIFEST_SCHEMA = 1
+
+
+def _sha256_file(path: str, chunk: int = 1 << 20) -> str:
+    h = hashlib.sha256()
+    with open(path, "rb") as f:
+        while True:
+            b = f.read(chunk)
+            if not b:
+                break
+            h.update(b)
+    return h.hexdigest()
+
+
+def config_fingerprint() -> str:
+    """Arch-identity digest: the config keys that determine the PARAM tree.
+
+    Deliberately narrow — optimizer choice is excluded (an optimizer
+    mismatch already degrades gracefully to weights-only restore), as are
+    run knobs like WEIGHTS/PRETRAINED/OUT_DIR that don't shape the state."""
+    ident = {
+        "arch": cfg.MODEL.ARCH,
+        "num_classes": cfg.MODEL.NUM_CLASSES,
+        "moe": cfg.MODEL.MOE.to_dict(),
+    }
+    return hashlib.sha256(
+        json.dumps(ident, sort_keys=True, default=str).encode()
+    ).hexdigest()
+
+
+def tree_spec(tree) -> dict:
+    """Flattened leaf spec: jax key path → {"shape", "dtype"}. Works on
+    host numpy and device arrays alike (only metadata is read — safe for
+    multi-host arrays this process only partially addresses)."""
+    leaves = jax.tree_util.tree_flatten_with_path(tree)[0]
+    return {
+        jax.tree_util.keystr(path): {
+            "shape": list(np.shape(leaf)),
+            "dtype": str(getattr(leaf, "dtype", np.asarray(leaf).dtype)),
+        }
+        for path, leaf in leaves
+    }
+
+
+def _mesh_axes_of(tree) -> dict:
+    """Resolved mesh axis sizes from the first device-array leaf (the
+    topology the save actually ran on — cfg.MESH may hold -1 wildcards)."""
+    for leaf in jax.tree.leaves(tree):
+        sh = getattr(leaf, "sharding", None)
+        mesh = getattr(sh, "mesh", None)
+        if mesh is not None and hasattr(mesh, "shape"):
+            return {k: int(v) for k, v in dict(mesh.shape).items()}
+    return {}
+
+
+def world_topology(payload=None) -> dict:
+    return {
+        "processes": jax.process_count(),
+        "devices": jax.device_count(),
+        "mesh": _mesh_axes_of(payload) if payload is not None else {},
+        "zero": int(cfg.MESH.ZERO),
+    }
+
+
+def manifest_path(ckpt_dir: str) -> str:
+    return os.path.join(ckpt_dir, MANIFEST_NAME)
+
+
+def write_manifest(ckpt_dir: str, payload, kind: str = "full",
+                   epoch: int | None = None) -> str:
+    """Commit marker for a completed save. Call AFTER the orbax write has
+    returned on every process, from the primary only (a plain filesystem
+    op, like ``prune_preempts``). Atomic: tmp file + ``os.replace``."""
+    files = {}
+    for dirpath, _, names in os.walk(ckpt_dir):
+        for name in sorted(names):
+            if name in (MANIFEST_NAME, MANIFEST_NAME + ".tmp"):
+                continue
+            full = os.path.join(dirpath, name)
+            rel = os.path.relpath(full, ckpt_dir)
+            files[rel] = {
+                "size": os.path.getsize(full),
+                "sha256": _sha256_file(full),
+            }
+    man = {
+        "schema": MANIFEST_SCHEMA,
+        "kind": kind,
+        "epoch": None if epoch is None else int(epoch),
+        "fingerprint": config_fingerprint(),
+        "topology": world_topology(payload),
+        "tree": tree_spec(payload),
+        "files": files,
+    }
+    dest = manifest_path(ckpt_dir)
+    tmp = dest + ".tmp"
+    with open(tmp, "w") as f:
+        json.dump(man, f, indent=1, sort_keys=True)
+        f.flush()
+        os.fsync(f.fileno())
+    os.replace(tmp, dest)
+    return dest
+
+
+def read_manifest(ckpt_dir: str) -> dict | None:
+    """The committed manifest, or None (pre-manifest / partial save)."""
+    try:
+        with open(manifest_path(ckpt_dir)) as f:
+            return json.load(f)
+    except (OSError, json.JSONDecodeError):
+        return None
+
+
+def verify_checkpoint(ckpt_dir: str) -> tuple[bool, str]:
+    """Crash-consistency check: ``(ok, reason)``.
+
+    A directory without a readable manifest is INVALID by definition under
+    the commit protocol — the manifest is written last, so its absence
+    means the save never completed (or predates the protocol; re-save or
+    resume from an older intact checkpoint)."""
+    if not os.path.isdir(ckpt_dir):
+        return False, "not a directory"
+    man = read_manifest(ckpt_dir)
+    if man is None:
+        return False, (
+            "no committed manifest (save interrupted before commit, or a "
+            "pre-manifest checkpoint)"
+        )
+    for rel, meta in man.get("files", {}).items():
+        full = os.path.join(ckpt_dir, rel)
+        if not os.path.isfile(full):
+            return False, f"payload file missing: {rel}"
+        size = os.path.getsize(full)
+        if size != meta["size"]:
+            return False, (
+                f"payload file truncated/resized: {rel} "
+                f"({size} bytes, manifest says {meta['size']})"
+            )
+        if _sha256_file(full) != meta["sha256"]:
+            return False, f"payload file digest mismatch: {rel}"
+    return True, "ok"
+
+
+def classify_topology(man: dict, live_spec: dict | None = None) -> tuple[str, str]:
+    """Elastic-resume compatibility of a manifest against the LIVE world.
+
+    Returns ``(kind, detail)`` with kind one of:
+      "exact"        same mesh/process topology — plain resume;
+      "reshardable"  same model identity, different world — restore
+                     proceeds, every array is re-placed onto the live
+                     layout (dp=N → dp=M, ZeRO shards reassembled);
+      "incompatible" the saved param tree cannot feed this model —
+                     refuse loudly with the first mismatch.
+
+    ``live_spec`` (a ``tree_spec`` of the live params/batch_stats) enables
+    the per-leaf shape check; without it only the fingerprint is compared.
+    Optimizer-state leaves are deliberately NOT compared — an optimizer
+    mismatch falls back to weights-only restore (utils/checkpoint.py).
+    """
+    if man.get("fingerprint") != config_fingerprint():
+        return "incompatible", (
+            "arch identity changed since the save (MODEL.ARCH / NUM_CLASSES "
+            "/ MOE differ from the checkpoint's fingerprint)"
+        )
+    if live_spec is not None:
+        saved = man.get("tree", {})
+        for key, spec in live_spec.items():
+            got = saved.get(key)
+            if got is None:
+                return "incompatible", f"checkpoint lacks leaf {key}"
+            if list(got["shape"]) != list(spec["shape"]):
+                return "incompatible", (
+                    f"leaf {key} shape {got['shape']} != live {spec['shape']}"
+                )
+    saved_topo = man.get("topology", {})
+    live_topo = world_topology()
+    diffs = [
+        f"{k} {saved_topo.get(k)}→{live_topo.get(k)}"
+        for k in ("processes", "devices", "zero")
+        if saved_topo.get(k) != live_topo.get(k)
+    ]
+    return ("reshardable", "; ".join(diffs)) if diffs else ("exact", "")
+
+
+def classify_against_live(man: dict, live_state_tree, live_mesh=None) -> tuple[str, str]:
+    """``classify_topology`` with the live side fully resolved: per-leaf
+    shapes from ``live_state_tree`` (params + batch_stats only) and the
+    live mesh axis sizes for the reshard detail message."""
+    live_spec = tree_spec(
+        {k: live_state_tree[k] for k in ("params", "batch_stats")
+         if k in live_state_tree}
+    )
+    kind, detail = classify_topology(man, live_spec)
+    if kind != "incompatible":
+        saved_mesh = (man.get("topology") or {}).get("mesh") or {}
+        live_axes = (
+            {k: int(v) for k, v in dict(live_mesh.shape).items()}
+            if live_mesh is not None
+            else {}
+        )
+        if saved_mesh and live_axes and saved_mesh != live_axes:
+            mesh_diff = ", ".join(
+                f"{ax} {saved_mesh.get(ax)}→{live_axes.get(ax)}"
+                for ax in sorted(set(saved_mesh) | set(live_axes))
+                if saved_mesh.get(ax) != live_axes.get(ax)
+            )
+            detail = "; ".join(x for x in (detail, f"mesh {mesh_diff}") if x)
+            kind = "reshardable"
+    return kind, detail

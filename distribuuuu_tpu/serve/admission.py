@@ -81,13 +81,28 @@ def install_drain(signals=(signal.SIGTERM,)) -> None:
     """Install the drain handler (idempotent; main thread only — the same
     contract as ``preempt.install``). The handler only sets a flag; the
     serving accept loop polls ``drain_requested()`` and performs the
-    actual drain at its next safe boundary."""
+    actual drain at its next safe boundary.
 
-    def handler(signum, frame):
-        _drain["requested"] = True
+    Chains to any previously installed handler (same fix as
+    ``preempt.install``): co-resident SIGTERM watchers — e.g. training's
+    preemption save in the same process — keep working."""
+
+    def _make(prev):
+        def handler(signum, frame):
+            _drain["requested"] = True
+            if callable(prev):
+                prev(signum, frame)
+
+        handler._dtpu_drain = True
+        return handler
 
     for s in signals:
-        signal.signal(s, handler)
+        prev = signal.getsignal(s)
+        if getattr(prev, "_dtpu_drain", False):
+            continue  # already ours (with its chain) — idempotent
+        if prev in (signal.SIG_DFL, signal.SIG_IGN, None):
+            prev = None
+        signal.signal(s, _make(prev))
 
 
 def drain_requested() -> bool:

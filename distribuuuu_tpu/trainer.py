@@ -46,7 +46,9 @@ from distribuuuu_tpu.parallel import (
     tp,
     zero,
 )
+from distribuuuu_tpu.resilience import manifest as manifest_lib, supervisor
 from distribuuuu_tpu.utils import checkpoint as ckpt
+from distribuuuu_tpu.utils import faults
 from distribuuuu_tpu.utils import preempt
 from distribuuuu_tpu.utils.jsonlog import (
     metrics_log,
@@ -74,6 +76,7 @@ def check_trainer_mesh():
     """Refuse mesh axes the configured arch cannot use — GSPMD would
     silently replicate the whole computation over an unused axis (N×
     redundant work) rather than erroring."""
+    supervisor.validate_policy(cfg.TRAIN.NONFINITE)
     if cfg.MESH.ZERO not in (0, 1, 3):
         raise ValueError(
             f"MESH.ZERO={cfg.MESH.ZERO}: stages are 0 (off), 1 (optimizer "
@@ -311,6 +314,11 @@ def _train_step_body(model, optimizer, topk: int, accum_steps: int = 1,
             "neither-DDP-nor-ZeRO configuration."
         )
 
+    # Non-finite loss guard (resilience/supervisor.py), compiled into the
+    # step: metrics always carry a ``nonfinite`` flag; under "skip" the
+    # poisoned update is discarded in-graph (pre-step state selected).
+    nonfinite_policy = supervisor.validate_policy(str(cfg.TRAIN.NONFINITE))
+
     def apply_grads(state, grads, new_stats, metrics):
         if layout is not None:
             # ZeRO: reduce-scatter the grad into the sharded update
@@ -326,21 +334,28 @@ def _train_step_body(model, optimizer, topk: int, accum_steps: int = 1,
             new_opt_state = tp.constrain_like(
                 new_opt_state, grads, layout["opt"]
             )
-        return TrainState(
+        new_state = TrainState(
             params=new_params,
             batch_stats=new_stats,
             opt_state=new_opt_state,
             step=state.step + 1,
             key=state.key,
-        ), metrics
+        )
+        return supervisor.guard_nonfinite(
+            state, new_state, metrics, nonfinite_policy
+        )
 
     # λ for the MoE load-balancing aux (models/vit.MoeMlp sows per-block
     # values into ``intermediates``); captured at step-build time. Zero
     # overhead for dense archs: the collection stays empty.
     moe_aux_weight = float(cfg.MODEL.MOE.AUX_WEIGHT)
     prep_images = _make_image_prep()
+    # FAULTS.NAN_STEP (utils/faults.py): trace-time gate — None (the
+    # common case) compiles nothing in; an int multiplies the loss by
+    # where(step==k, NaN, 1), poisoning loss AND grads at exactly step k.
+    nan_step = faults.nan_injection_step()
 
-    def loss_fn(params, stats, images, labels, key):
+    def loss_fn(params, stats, images, labels, key, step):
         images = prep_images(images)
         logits, mutated = model.apply(
             {"params": params, "batch_stats": stats},
@@ -353,6 +368,10 @@ def _train_step_body(model, optimizer, topk: int, accum_steps: int = 1,
         aux = jax.tree.leaves(mutated.get("intermediates", {}))
         if aux and moe_aux_weight:
             loss = loss + moe_aux_weight * sum(aux) / len(aux)
+        if nan_step is not None:
+            loss = loss * jnp.where(
+                step == nan_step, jnp.float32(jnp.nan), jnp.float32(1.0)
+            )
         # dispatch-MoE observability: per-block dropped-assignment
         # fractions (models/vit.MoeMlp sows the sum; empty for dense and
         # partial-MoE models — zero overhead there)
@@ -373,7 +392,7 @@ def _train_step_body(model, optimizer, topk: int, accum_steps: int = 1,
         step_key = jax.random.fold_in(state.key, state.step)
         (loss, (logits, new_stats, dropped)), grads = grad_fn(
             state.params, state.batch_stats, batch["image"], batch["label"],
-            step_key,
+            step_key, state.step,
         )
         return apply_grads(
             state, grads, new_stats,
@@ -393,7 +412,8 @@ def _train_step_body(model, optimizer, topk: int, accum_steps: int = 1,
             stats, gsum, i = carry
             mkey = jax.random.fold_in(step_key, i)
             (loss, (logits, new_stats, dropped)), grads = grad_fn(
-                state.params, stats, mb["image"], mb["label"], mkey
+                state.params, stats, mb["image"], mb["label"], mkey,
+                state.step,
             )
             gsum = jax.tree.map(jnp.add, gsum, grads)
             return (new_stats, gsum, i + 1), step_metrics(
@@ -611,19 +631,38 @@ def train_epoch(loader, mesh, state, train_step, epoch: int, logger,
     # dispatch-MoE only: fraction of routed assignments lost to capacity
     moe_dropped = AverageMeter("MoEDrop", ":.4f")
 
+    # non-finite policy enforcement at flush granularity (the guard inside
+    # the step already annotated/skipped in-graph; this is the host half —
+    # count+log for "skip", raise for "raise"/"rollback")
+    nf_mon = supervisor.NonFiniteMonitor(
+        str(cfg.TRAIN.NONFINITE), epoch, logger
+    )
+    # stall watchdog: a wedged collective or hung storage flags instead of
+    # hanging silently (TRAIN.STALL_TIMEOUT seconds; 0 = no thread)
+    heartbeat = supervisor.Heartbeat(cfg.TRAIN.STALL_TIMEOUT, logger)
+
     def flush_pending():
         for n, m in pending:
             if n == 1:
+                if nf_mon.observe(
+                    float(m["loss"]), float(m.get("nonfinite", 0.0)), done
+                ):
+                    continue  # skipped in-graph — keep it out of the meters
                 losses.update(float(m["loss"]))
                 top1.update(float(m["top1"]))
                 topk_m.update(float(m["topk"]))
                 if "moe_dropped" in m:
                     moe_dropped.update(float(m["moe_dropped"]))
             else:  # stacked (fold,) metrics from a scan call
-                for ls, t1, tk in zip(
+                nfs = np.asarray(
+                    m.get("nonfinite", np.zeros(n))
+                ).reshape(-1)
+                for j, (ls, t1, tk) in enumerate(zip(
                     np.asarray(m["loss"]), np.asarray(m["top1"]),
                     np.asarray(m["topk"]),
-                ):
+                )):
+                    if nf_mon.observe(float(ls), float(nfs[j]), done):
+                        continue
                     losses.update(float(ls))
                     top1.update(float(t1))
                     topk_m.update(float(tk))
@@ -677,107 +716,116 @@ def train_epoch(loader, mesh, state, train_step, epoch: int, logger,
         return False
 
     emit_timeline = cfg.TRAIN.TIMELINE and mesh_lib.is_primary()
-    if fold > 1:
-        # Two preallocated (fold, batch, ...) host buffers, ping-ponged per
-        # dispatch: device_put may still be reading buffer A asynchronously
-        # while the next fold fills buffer B. Before REFILLING a buffer,
-        # fence on the device batch previously created from it — readiness
-        # implies the H2D transfer has consumed the host memory (near-zero
-        # cost in steady state; without it a deep dispatch backlog could
-        # overwrite a buffer a pending transfer is still reading, silently
-        # corrupting a batch). No per-batch timeline records in this mode
-        # (stage boundaries are fold-granular); STEPS_PER_CALL 1 is the
-        # attribution mode.
-        stack_bufs, buf_idx = None, 0
-        inflight = [None, None]  # device batch last created from each buffer
-        end = time.perf_counter()
-        win_start = end  # start of the current fold window (incl. buffering)
-        for it, host_batch in enumerate(loader):
-            data_time.update(time.perf_counter() - end)
-            is_last = it + 1 == num_batches
-            # copy into the preallocated fold slot NOW (spreads the host
-            # memcpy across the fold window, overlapped with the device
-            # executing the previous call) instead of np.stack-ing the
-            # whole fold on the dispatch iteration
-            if stack_bufs is None:
-                stack_bufs = [
-                    jax.tree.map(
-                        lambda x: np.empty(
-                            (fold,) + np.shape(x), np.asarray(x).dtype
-                        ),
-                        host_batch,
-                    )
-                    for _ in range(2)
-                ]
-            stack_buf = stack_bufs[buf_idx]
-            if n_buffered == 0 and inflight[buf_idx] is not None:
-                jax.block_until_ready(inflight[buf_idx])
-                inflight[buf_idx] = None
-            jax.tree.map(
-                lambda buf, x: buf.__setitem__(n_buffered, x),
-                stack_buf, host_batch,
-            )
-            n_buffered += 1
-            if n_buffered < fold and not is_last:
+    try:
+        if fold > 1:
+            # Two preallocated (fold, batch, ...) host buffers, ping-ponged per
+            # dispatch: device_put may still be reading buffer A asynchronously
+            # while the next fold fills buffer B. Before REFILLING a buffer,
+            # fence on the device batch previously created from it — readiness
+            # implies the H2D transfer has consumed the host memory (near-zero
+            # cost in steady state; without it a deep dispatch backlog could
+            # overwrite a buffer a pending transfer is still reading, silently
+            # corrupting a batch). No per-batch timeline records in this mode
+            # (stage boundaries are fold-granular); STEPS_PER_CALL 1 is the
+            # attribution mode.
+            stack_bufs, buf_idx = None, 0
+            inflight = [None, None]  # device batch last created from each buffer
+            end = time.perf_counter()
+            win_start = end  # start of the current fold window (incl. buffering)
+            for it, host_batch in enumerate(loader):
+                heartbeat.beat(f"epoch {epoch + 1} batch {it}")
+                faults.maybe_stall(epoch, it)  # injection no-ops (FAULTS.*)
+                faults.maybe_kill(epoch, it)
+                data_time.update(time.perf_counter() - end)
+                is_last = it + 1 == num_batches
+                # copy into the preallocated fold slot NOW (spreads the host
+                # memcpy across the fold window, overlapped with the device
+                # executing the previous call) instead of np.stack-ing the
+                # whole fold on the dispatch iteration
+                if stack_bufs is None:
+                    stack_bufs = [
+                        jax.tree.map(
+                            lambda x: np.empty(
+                                (fold,) + np.shape(x), np.asarray(x).dtype
+                            ),
+                            host_batch,
+                        )
+                        for _ in range(2)
+                    ]
+                stack_buf = stack_bufs[buf_idx]
+                if n_buffered == 0 and inflight[buf_idx] is not None:
+                    jax.block_until_ready(inflight[buf_idx])
+                    inflight[buf_idx] = None
+                jax.tree.map(
+                    lambda buf, x: buf.__setitem__(n_buffered, x),
+                    stack_buf, host_batch,
+                )
+                n_buffered += 1
+                if n_buffered < fold and not is_last:
+                    end = time.perf_counter()
+                    continue
+                n = n_buffered
+                if n == fold:
+                    batch = put_stacked(stack_buf)
+                    inflight[buf_idx] = batch
+                    prof.begin(done)
+                    state, metrics = scan_step(state, batch)
+                    prof.end(done + fold - 1, state)
+                    pending.append((fold, metrics))
+                else:  # ragged tail: per-step dispatch
+                    for i in range(n):
+                        hb = jax.tree.map(lambda buf: buf[i], stack_buf)
+                        b = put_batch(hb)
+                        prof.begin(done + i)
+                        state, metrics = train_step(state, b)
+                        prof.end(done + i, state)
+                        pending.append((1, metrics))
+                done += n
+                n_buffered = 0
+                buf_idx ^= 1
+                # per-BATCH time over the whole window (incl. the buffering
+                # iterations) so display/ETA keep their per-batch meaning
+                now = time.perf_counter()
+                batch_time.update((now - win_start) / n, n=n)
+                win_start = now
                 end = time.perf_counter()
-                continue
-            n = n_buffered
-            if n == fold:
-                batch = put_stacked(stack_buf)
-                inflight[buf_idx] = batch
-                prof.begin(done)
-                state, metrics = scan_step(state, batch)
-                prof.end(done + fold - 1, state)
-                pending.append((fold, metrics))
-            else:  # ragged tail: per-step dispatch
-                for i in range(n):
-                    hb = jax.tree.map(lambda buf: buf[i], stack_buf)
-                    b = put_batch(hb)
-                    prof.begin(done + i)
-                    state, metrics = train_step(state, b)
-                    prof.end(done + i, state)
-                    pending.append((1, metrics))
-            done += n
-            n_buffered = 0
-            buf_idx ^= 1
-            # per-BATCH time over the whole window (incl. the buffering
-            # iterations) so display/ETA keep their per-batch meaning
-            now = time.perf_counter()
-            batch_time.update((now - win_start) / n, n=n)
-            win_start = now
+                maybe_print()
+                if preempt_break(done):
+                    break
+        else:
+            # Per-step dispatch through the device-side prefetch ring
+            # (data/loader.device_prefetch): the H2D transfer of batches
+            # it+1..it+depth is dispatched while the step for batch `it` runs,
+            # so transfer never serializes behind the step; depth 0 restores
+            # the serial put-then-step order. Results are value-bit-identical
+            # at every depth (same put/step order — tests/test_overlap.py).
+            # Each dispatched batch leaves one kind="timeline" record with its
+            # stage-boundary timestamps (tools/overlap_report.py attributes
+            # the epoch wall from them).
+            depth = max(0, cfg.TRAIN.PREFETCH_DEVICE)
             end = time.perf_counter()
-            maybe_print()
-            if preempt_break(done):
-                break
-    else:
-        # Per-step dispatch through the device-side prefetch ring
-        # (data/loader.device_prefetch): the H2D transfer of batches
-        # it+1..it+depth is dispatched while the step for batch `it` runs,
-        # so transfer never serializes behind the step; depth 0 restores
-        # the serial put-then-step order. Results are value-bit-identical
-        # at every depth (same put/step order — tests/test_overlap.py).
-        # Each dispatched batch leaves one kind="timeline" record with its
-        # stage-boundary timestamps (tools/overlap_report.py attributes
-        # the epoch wall from them).
-        depth = max(0, cfg.TRAIN.PREFETCH_DEVICE)
-        end = time.perf_counter()
-        for it, batch, tl in device_prefetch(loader, put_batch, depth):
-            data_time.update(tl["get1"] - tl["get0"])
-            prof.begin(it)
-            tl["step0"] = time.perf_counter()
-            state, metrics = train_step(state, batch)
-            tl["step1"] = time.perf_counter()
-            prof.end(it, state)
-            pending.append((1, metrics))
-            done += 1
-            batch_time.update(time.perf_counter() - end)
-            end = time.perf_counter()
-            if emit_timeline:
-                timeline_log("train", epoch + 1, it, tl.pop("n", 0), **tl)
-            maybe_print()
-            if preempt_break(it + 1):
-                break
-    prof.finish(state)
+            for it, batch, tl in device_prefetch(loader, put_batch, depth):
+                heartbeat.beat(f"epoch {epoch + 1} batch {it}")
+                faults.maybe_stall(epoch, it)  # injection no-ops (FAULTS.*)
+                faults.maybe_kill(epoch, it)
+                data_time.update(tl["get1"] - tl["get0"])
+                prof.begin(it)
+                tl["step0"] = time.perf_counter()
+                state, metrics = train_step(state, batch)
+                tl["step1"] = time.perf_counter()
+                prof.end(it, state)
+                pending.append((1, metrics))
+                done += 1
+                batch_time.update(time.perf_counter() - end)
+                end = time.perf_counter()
+                if emit_timeline:
+                    timeline_log("train", epoch + 1, it, tl.pop("n", 0), **tl)
+                maybe_print()
+                if preempt_break(it + 1):
+                    break
+        prof.finish(state)
+    finally:
+        heartbeat.stop()
     return state, interrupted
 
 
@@ -893,7 +941,13 @@ def _place_like(tmpl, new):
         dtype = getattr(t, "dtype", None)
         if isinstance(n, jax.Array) and not n.is_fully_addressable:
             return _reshard_fn(dtype, t.sharding)(n)
-        return jax.device_put(np.asarray(n, dtype=dtype), t.sharding)
+        sharding = getattr(t, "sharding", None)
+        if sharding is None:
+            # non-array template leaf — e.g. the python-float LR that
+            # set_lr injects in place (a mid-run rollback resumes against
+            # a live, already-mutated state): keep it host-side
+            return np.asarray(n, dtype=dtype) if dtype is not None else n
+        return jax.device_put(np.asarray(n, dtype=dtype), sharding)
 
     return jax.tree.map(_place, tmpl, new)
 
@@ -948,9 +1002,34 @@ def _with_restored_weights(state: TrainState, path: str, model) -> TrainState:
 def _resume(
     state: TrainState, mesh
 ) -> tuple[TrainState, int, float, int | None]:
-    """Auto-resume from the last epoch checkpoint (ref: trainer.py:143-149)."""
+    """Auto-resume from the last INTACT checkpoint (ref: trainer.py:143-149,
+    hardened): candidates are manifest-verified newest-first, corrupt or
+    partial saves are quarantined to ``*.corrupt`` and walked past
+    (utils/checkpoint.find_last_valid_checkpoint), and the recorded world
+    topology is compared against the live mesh — a dp=N save restores onto
+    a dp=M mesh ("elastic resume": every array is re-placed onto the live
+    layout by ``_place_like``; ZeRO opt-state shards reassemble through
+    ``pack_opt_state``'s canonical leaf order), while a save whose param
+    tree cannot feed this model is refused with the first mismatch."""
     logger = get_logger()
-    path = ckpt.get_last_checkpoint()
+    path = ckpt.find_last_valid_checkpoint()
+    man = manifest_lib.read_manifest(path)
+    if man is not None:
+        kind, detail = manifest_lib.classify_against_live(
+            man, _state_tree(state), mesh
+        )
+        if kind == "incompatible":
+            raise ckpt.CheckpointError(
+                f"checkpoint {path} cannot feed the configured model: "
+                f"{detail}. Match the config to the save (MODEL.ARCH / "
+                "NUM_CLASSES / MOE), or start a fresh OUT_DIR."
+            )
+        if kind == "reshardable":
+            logger.info(
+                "elastic resume: saved world differs from the live one "
+                "(%s) — re-placing restored arrays onto the live layout",
+                detail,
+            )
     restored = ckpt.load_checkpoint(path)
 
     params = _place_like(state.params, restored["params"])
@@ -1107,8 +1186,17 @@ def train_model():
     eval_step = make_eval_step(model, effective_topk())
 
     start_epoch, best_acc1, pending_eval = 0, 0.0, None
+    resumed = False
     if cfg.TRAIN.AUTO_RESUME and ckpt.has_checkpoint():
-        state, start_epoch, best_acc1, pending_eval = _resume(state, mesh)
+        try:
+            state, start_epoch, best_acc1, pending_eval = _resume(state, mesh)
+            resumed = True
+        except ckpt.NoValidCheckpointError as e:
+            # every checkpoint on disk failed verification (all quarantined
+            # to *.corrupt) — recover by starting over rather than crashing
+            logger.warning("%s — falling through to a fresh start", e)
+    if resumed:
+        pass
     elif cfg.MODEL.PRETRAINED and cfg.MODEL.WEIGHTS:
         # warm start from pretrained weights (≙ the reference's URL-zoo
         # `pretrained=True` path, ref: resnet.py:309-311 — here the file may
@@ -1185,11 +1273,54 @@ def train_model():
         # every restart and the run could never cleanly terminate
         ckpt.prune_preempts(pending_eval + 1)
 
-    for epoch in range(start_epoch, cfg.OPTIM.MAX_EPOCH):
-        state, interrupted = train_epoch(
-            loader=train_loader, mesh=mesh, state=state,
-            train_step=train_step, epoch=epoch, logger=logger,
-            first_epoch=start_epoch, scan_step=scan_step)
+    epoch = start_epoch
+    rollbacks_left = max(0, int(cfg.TRAIN.MAX_ROLLBACKS))
+    while epoch < cfg.OPTIM.MAX_EPOCH:
+        try:
+            state, interrupted = train_epoch(
+                loader=train_loader, mesh=mesh, state=state,
+                train_step=train_step, epoch=epoch, logger=logger,
+                first_epoch=start_epoch, scan_step=scan_step)
+        except supervisor.NonFiniteLossError as e:
+            # TRAIN.NONFINITE=rollback: reload the last intact checkpoint
+            # and re-run from there — the transient-corruption recovery.
+            # A deterministic NaN re-trips and surfaces once the budget
+            # (TRAIN.MAX_ROLLBACKS) is spent; "raise" propagates directly.
+            if cfg.TRAIN.NONFINITE != "rollback":
+                raise
+            if rollbacks_left <= 0:
+                logger.error(
+                    "rollback budget exhausted (TRAIN.MAX_ROLLBACKS=%d) — "
+                    "the non-finite loss reproduces from the checkpoint; "
+                    "this is not transient corruption",
+                    cfg.TRAIN.MAX_ROLLBACKS,
+                )
+                raise
+            if not ckpt.has_checkpoint():
+                logger.error(
+                    "non-finite loss before any checkpoint exists — "
+                    "nothing to roll back to"
+                )
+                raise
+            rollbacks_left -= 1
+            logger.warning(
+                "non-finite loss at epoch %d batch ~%d — rolling back to "
+                "the last intact checkpoint (%d attempt(s) left)",
+                e.epoch + 1, e.batch, rollbacks_left,
+            )
+            state, epoch, best_acc1, rb_pending = _resume(state, mesh)
+            # the pre-epoch state's buffers were DONATED to the step calls
+            # (donate_argnums=0) — its key is deleted; re-attach the live
+            # base key (the value is seed-derived, identical by definition)
+            state = state.replace(key=key)
+            if rb_pending is not None:
+                # rolled back onto an eval-pending preempt save: finish
+                # that epoch's validation first, as a fresh start would
+                path = _finish_epoch(rb_pending)
+                if path is not None:
+                    return _preempt_exit(path, rb_pending + 1)
+                ckpt.prune_preempts(rb_pending + 1)
+            continue
         watching = cfg.TRAIN.PREEMPT_SAVE
         if interrupted:
             # mid-epoch preemption: persist now; the next run's AUTO_RESUME
@@ -1215,6 +1346,7 @@ def train_model():
             # signaled during the save: ckpt_ep_{epoch} is already on
             # disk — nothing more to persist, just exit promptly
             return _preempt_exit(ckpt.get_checkpoint(epoch), epoch + 1)
+        epoch += 1
     return best_acc1
 
 

@@ -11,6 +11,14 @@ utils.py:385-387), optimizer-state restore optional with graceful fallback
 Formats differ by design: orbax OCDBT directories instead of torch pickles —
 multi-host-safe (every process participates; array shards are written by
 their owners) and framework-portable.
+
+Crash consistency (resilience/manifest.py): every save commits a
+``MANIFEST.json`` (tree spec + file digests + world topology) atomically
+AFTER the orbax payload. ``find_last_valid_checkpoint`` — the trainer's
+resume entry — verifies candidates newest-first, quarantines corrupt or
+partial directories to ``*.corrupt``, and walks back to the newest intact
+save; the raw lexicographic pick (``get_last_checkpoint``) previously
+selected a half-written dir and killed the resume inside tensorstore.
 """
 
 from __future__ import annotations
@@ -23,6 +31,7 @@ import numpy as np
 import orbax.checkpoint as ocp
 
 from distribuuuu_tpu.config import cfg
+from distribuuuu_tpu.resilience import manifest as manifest_lib
 
 _NAME_PREFIX = "ckpt_ep_"
 _BEST_NAME = "best"
@@ -30,6 +39,19 @@ _BEST_NAME = "best"
 # is the INTERRUPTED epoch, so preempt_ep_e outranks ckpt_ep_{e-1} (it holds
 # strictly newer optimizer progress) and is superseded by ckpt_ep_e.
 _PREEMPT_PREFIX = "preempt_ep_"
+
+
+class CheckpointError(RuntimeError):
+    """Base for checkpoint failures this module can diagnose."""
+
+
+class NoValidCheckpointError(CheckpointError, FileNotFoundError):
+    """Checkpoint dirs exist (or don't) but none verifies intact."""
+
+
+class CheckpointLoadError(CheckpointError):
+    """An orbax restore failed; the message names the path, the quarantine
+    action taken, and the resume-from-previous command."""
 
 
 def get_checkpoint_dir() -> str:
@@ -59,20 +81,85 @@ def _scan(prefix: str) -> dict[int, str]:
     return out
 
 
+def _ordered_candidates() -> list[str]:
+    """Every resumable checkpoint, newest state first. Recency rank:
+    ``preempt_ep_e`` (mid-epoch state of interrupted epoch e) sits between
+    ``ckpt_ep_{e-1}`` and ``ckpt_ep_e`` — it holds strictly newer progress
+    than the former and is superseded by the latter."""
+    ranked = [(2 * e + 2, p) for e, p in _scan(_NAME_PREFIX).items()]
+    ranked += [(2 * e + 1, p) for e, p in _scan(_PREEMPT_PREFIX).items()]
+    return [p for _, p in sorted(ranked, reverse=True)]
+
+
 def get_last_checkpoint() -> str:
-    """Latest resumable checkpoint (ref numeric-order pick: utils.py:337-342),
-    extended for preemption: ``preempt_ep_e`` (mid-epoch state of an
-    interrupted epoch e) is preferred over ``ckpt_ep_{e-1}`` and ignored as
-    stale once ``ckpt_ep_e`` exists."""
-    epochs = _scan(_NAME_PREFIX)
-    preempts = _scan(_PREEMPT_PREFIX)
-    last_epoch = max(epochs) if epochs else -1
-    live_preempts = {e: p for e, p in preempts.items() if e > last_epoch}
-    if live_preempts:
-        return live_preempts[max(live_preempts)]
-    if epochs:
-        return epochs[last_epoch]
-    raise FileNotFoundError(f"No checkpoints in {get_checkpoint_dir()}")
+    """Newest checkpoint by the recency ordering — UNVERIFIED (the raw
+    reference semantics, ref numeric-order pick: utils.py:337-342). The
+    trainer resumes through ``find_last_valid_checkpoint`` instead, which
+    skips/quarantines saves that fail manifest verification."""
+    cands = _ordered_candidates()
+    if not cands:
+        raise FileNotFoundError(f"No checkpoints in {get_checkpoint_dir()}")
+    return cands[0]
+
+
+def quarantine_checkpoint(path: str, reason: str) -> str | None:
+    """Move a broken checkpoint dir aside as ``<name>.corrupt[.N]`` so it
+    never outranks intact saves again (and stays inspectable). Primary
+    process only — a plain filesystem op on shared storage, like
+    ``prune_preempts``; other ranks just log the skip."""
+    from distribuuuu_tpu.utils.logger import get_logger
+
+    if jax.process_index() != 0:
+        get_logger().warning(
+            "checkpoint %s failed verification (%s) — skipping "
+            "(primary quarantines)", path, reason,
+        )
+        return None
+    dest = path + ".corrupt"
+    n = 0
+    while os.path.exists(dest):
+        n += 1
+        dest = f"{path}.corrupt.{n}"
+    try:
+        os.replace(path, dest)
+    except OSError as e:  # already moved by a concurrent restart, etc.
+        get_logger().warning(
+            "could not quarantine %s (%s); skipping it", path, e
+        )
+        return None
+    get_logger().warning(
+        "quarantined corrupt checkpoint %s -> %s (%s)", path, dest, reason
+    )
+    return dest
+
+
+def find_last_valid_checkpoint() -> str:
+    """The newest checkpoint that passes manifest verification
+    (resilience/manifest.verify_checkpoint), walking back over — and
+    quarantining — corrupt or partial saves instead of crashing the
+    resume on them. Raises ``NoValidCheckpointError`` when nothing
+    survives."""
+    from distribuuuu_tpu.utils.logger import get_logger
+
+    cands = _ordered_candidates()
+    if not cands:
+        raise NoValidCheckpointError(
+            f"No checkpoints in {get_checkpoint_dir()}"
+        )
+    for i, path in enumerate(cands):
+        ok, reason = manifest_lib.verify_checkpoint(path)
+        if ok:
+            if i:
+                get_logger().warning(
+                    "walked back over %d broken checkpoint(s) to %s", i, path
+                )
+            return path
+        quarantine_checkpoint(path, reason)
+    raise NoValidCheckpointError(
+        f"{len(cands)} checkpoint(s) under {get_checkpoint_dir()} but none "
+        "verified intact (all quarantined to *.corrupt); inspect the "
+        "quarantined dirs or restart training from scratch"
+    )
 
 
 def has_checkpoint() -> bool:
@@ -138,7 +225,9 @@ def _save_full(
 ):
     """The one save protocol: reference-shaped payload {epoch, state,
     best_acc1} (ref: utils.py:375-380), collective orbax write (every
-    process participates; array shards written by their owners)."""
+    process participates; array shards written by their owners), then the
+    manifest commit marker (primary only, atomic, AFTER the payload — a
+    crash at any earlier point leaves a dir that verification rejects)."""
     os.makedirs(get_checkpoint_dir(), exist_ok=True)
     payload = dict(state_tree)
     if "opt_state" in payload:
@@ -148,6 +237,9 @@ def _save_full(
     if extra:
         payload.update(extra)
     ocp.PyTreeCheckpointer().save(path, payload, force=True)
+    if jax.process_index() == 0:
+        manifest_lib.write_manifest(path, payload, kind="full",
+                                    epoch=epoch_cursor)
     return path
 
 
@@ -171,7 +263,14 @@ def save_checkpoint(state_tree: dict, epoch: int, best_acc1: float, is_best: boo
     if is_best:
         best = {"params": state_tree["params"], "batch_stats": state_tree["batch_stats"]}
         ocp.PyTreeCheckpointer().save(get_best_checkpoint(), best, force=True)
+        if jax.process_index() == 0:
+            manifest_lib.write_manifest(
+                get_best_checkpoint(), best, kind="weights", epoch=epoch
+            )
     prune_preempts(epoch)
+    from distribuuuu_tpu.utils import faults
+
+    faults.maybe_corrupt_checkpoint(path, epoch)  # no-op unless injected
     return path
 
 
@@ -199,11 +298,45 @@ def save_preempt_checkpoint(
     )
 
 
+def _is_managed_checkpoint(path: str) -> bool:
+    """True for dirs this module owns (under the run's checkpoint dir with
+    a recognized name) — the only ones quarantine may rename. A user-given
+    MODEL.WEIGHTS path pointing anywhere else is never touched."""
+    name = os.path.basename(os.path.normpath(path))
+    return os.path.dirname(os.path.normpath(path)) == get_checkpoint_dir() and (
+        bool(re.fullmatch(f"({_NAME_PREFIX}|{_PREEMPT_PREFIX})\\d+", name))
+        or name == _BEST_NAME
+    )
+
+
 def load_checkpoint(path: str):
     """Restore a checkpoint as a numpy pytree (host-side; the trainer
     re-places arrays onto the mesh). Weights-only checkpoints return without
     ``opt_state``/``epoch`` keys and the caller falls back gracefully
-    (ref semantics: utils.py:391-410)."""
+    (ref semantics: utils.py:391-410).
+
+    A failed restore raises ``CheckpointLoadError`` naming the path, the
+    quarantine action taken, and how to resume from the previous intact
+    save — instead of a raw tensorstore traceback."""
+    path = os.path.abspath(path)
     ckptr = ocp.PyTreeCheckpointer()
-    restored = ckptr.restore(os.path.abspath(path))
-    return restored
+    try:
+        return ckptr.restore(path)
+    except Exception as e:  # orbax/tensorstore raise many concrete types
+        if _is_managed_checkpoint(path):
+            dest = quarantine_checkpoint(path, f"restore failed: {e}")
+            action = (
+                f"quarantined to {dest}" if dest
+                else "quarantine skipped (non-primary process or rename failed)"
+            )
+        else:
+            action = "no quarantine (path is outside this run's checkpoint dir)"
+        raise CheckpointLoadError(
+            f"failed to restore checkpoint {path} "
+            f"({type(e).__name__}: {e}). Action taken: {action}. "
+            "To resume from the previous intact save, rerun with "
+            "TRAIN.AUTO_RESUME True (auto-resume walks back via "
+            "find_last_valid_checkpoint), or point at it explicitly: "
+            "python train_net.py --cfg <your.yaml> MODEL.WEIGHTS "
+            f"{get_checkpoint_dir()}/ckpt_ep_NNN"
+        ) from e

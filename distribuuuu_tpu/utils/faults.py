@@ -1,0 +1,145 @@
+"""Deterministic fault injection (the ``FAULTS.*`` config node).
+
+A recovery path that is never exercised is a recovery path that does not
+work. This module turns each failure class the resilience layer claims to
+survive into a reproducible, config-driven event, so tests and
+``tools/resilience_drill.py`` drive the REAL recovery code — not mocks:
+
+  truncated checkpoint   ``FAULTS.CORRUPT_EPOCH`` — after ``ckpt_ep_e``
+                         is saved+committed, truncate its largest payload
+                         file ("truncate" mode: digest-mismatch path) or
+                         delete its manifest ("partial" mode: the
+                         crash-before-commit path);
+  NaN at step k          ``FAULTS.NAN_STEP`` — the train step compiles in
+                         ``loss × where(step==k, NaN, 1)`` so loss AND
+                         grads go non-finite exactly once, in-graph;
+  decode error           ``FAULTS.DECODE_ERROR_IDX`` — sample i's decode
+                         raises ("once": the loader's first retry
+                         succeeds; "always": the sample is skipped and
+                         logged);
+  killed rank            ``FAULTS.KILL_RANK/KILL_EPOCH/KILL_AT_BATCH`` —
+                         SIGKILL this process at a batch boundary (no
+                         handler can run: the hard-crash case);
+  stalled step           ``FAULTS.STALL_EPOCH/STALL_AT_BATCH/STALL_S`` —
+                         sleep mid-loop so the heartbeat watchdog flags.
+
+Every hook is a no-op (one attribute read) unless ``FAULTS.ENABLED`` —
+zero overhead in production paths.
+"""
+
+from __future__ import annotations
+
+import os
+import signal
+import time
+
+from distribuuuu_tpu.config import cfg
+
+__all__ = [
+    "InjectedFault", "enabled", "nan_injection_step", "maybe_decode_error",
+    "maybe_kill", "maybe_stall", "maybe_corrupt_checkpoint", "reset",
+]
+
+
+class InjectedFault(RuntimeError):
+    """An injected failure — distinguishable from organic errors in logs."""
+
+
+_state: dict = {"decode_raised": set()}
+
+
+def reset() -> None:
+    """Clear once-mode bookkeeping (tests)."""
+    _state["decode_raised"] = set()
+
+
+def enabled() -> bool:
+    return bool(cfg.FAULTS.ENABLED)
+
+
+def nan_injection_step() -> int | None:
+    """Trace-time consult: the global step whose loss the train step body
+    multiplies by NaN, or None (the common case — nothing is compiled in)."""
+    if enabled() and cfg.FAULTS.NAN_STEP >= 0:
+        return int(cfg.FAULTS.NAN_STEP)
+    return None
+
+
+def maybe_decode_error(idx: int) -> None:
+    """Raise for the configured sample index. "once" mode raises only the
+    first time the index is touched — the loader's retry-with-backoff
+    succeeds (the transient-I/O case); "always" keeps raising — the
+    loader's skip-and-log path engages (the corrupt-file case)."""
+    if not enabled() or cfg.FAULTS.DECODE_ERROR_IDX < 0:
+        return
+    if int(idx) != int(cfg.FAULTS.DECODE_ERROR_IDX):
+        return
+    if cfg.FAULTS.DECODE_ERROR_MODE == "once":
+        if idx in _state["decode_raised"]:
+            return
+        _state["decode_raised"].add(idx)
+    raise InjectedFault(f"injected decode error on sample {idx}")
+
+
+def maybe_kill(epoch: int, batch: int) -> None:
+    """SIGKILL this process at the configured (rank, epoch, batch) — the
+    uncatchable hard crash (OOM-killer / host death). Nothing below this
+    line runs; recovery is entirely the next process's problem."""
+    if not enabled() or cfg.FAULTS.KILL_RANK < 0:
+        return
+    import jax
+
+    if (
+        jax.process_index() == int(cfg.FAULTS.KILL_RANK)
+        and epoch == int(cfg.FAULTS.KILL_EPOCH)
+        and batch == int(cfg.FAULTS.KILL_AT_BATCH)
+    ):
+        os.kill(os.getpid(), signal.SIGKILL)
+
+
+def maybe_stall(epoch: int, batch: int) -> None:
+    """Sleep ``FAULTS.STALL_S`` at the configured batch boundary — long
+    enough that the heartbeat watchdog (TRAIN.STALL_TIMEOUT) must flag."""
+    if not enabled() or cfg.FAULTS.STALL_AT_BATCH < 0:
+        return
+    if (
+        epoch == int(cfg.FAULTS.STALL_EPOCH)
+        and batch == int(cfg.FAULTS.STALL_AT_BATCH)
+        and cfg.FAULTS.STALL_S > 0
+    ):
+        time.sleep(float(cfg.FAULTS.STALL_S))
+
+
+def maybe_corrupt_checkpoint(path: str, epoch: int) -> None:
+    """Damage a just-committed checkpoint of the configured epoch:
+    "truncate" halves the largest payload file (manifest digests then
+    mismatch — the bit-rot/partial-write path); "partial" deletes the
+    manifest (the crash-before-commit path). Primary process only —
+    the same process that owns the manifest commit."""
+    if not enabled() or cfg.FAULTS.CORRUPT_EPOCH < 0:
+        return
+    if epoch != int(cfg.FAULTS.CORRUPT_EPOCH):
+        return
+    import jax
+
+    if jax.process_index() != 0:
+        return
+    from distribuuuu_tpu.resilience.manifest import MANIFEST_NAME
+
+    if cfg.FAULTS.CORRUPT_MODE == "partial":
+        man = os.path.join(path, MANIFEST_NAME)
+        if os.path.isfile(man):
+            os.unlink(man)
+        return
+    largest, largest_size = None, -1
+    for dirpath, _, names in os.walk(path):
+        for name in names:
+            if name == MANIFEST_NAME:
+                continue
+            full = os.path.join(dirpath, name)
+            size = os.path.getsize(full)
+            if size > largest_size:
+                largest, largest_size = full, size
+    if largest is not None:
+        with open(largest, "r+b") as f:
+            f.truncate(max(1, largest_size // 2))

@@ -29,13 +29,31 @@ _state = {"requested": False, "installed": False}
 def install(signals=(signal.SIGTERM,)) -> None:
     """Install the preemption handler (idempotent). Call from the main
     thread before the epoch loop (the trainer does this when
-    ``TRAIN.PREEMPT_SAVE`` is on)."""
+    ``TRAIN.PREEMPT_SAVE`` is on).
 
-    def handler(signum, frame):
-        _state["requested"] = True
+    CHAINS to any previously installed handler instead of clobbering it:
+    multiple subsystems legitimately watch SIGTERM in one process (the
+    serve drain in ``serve/admission.py`` registers it too), and before
+    this fix whichever installed last silently disabled the other. A
+    re-install is detected by the marker attribute and left alone — the
+    chain never loops back into itself."""
+
+    def _make(prev):
+        def handler(signum, frame):
+            _state["requested"] = True
+            if callable(prev):
+                prev(signum, frame)
+
+        handler._dtpu_preempt = True
+        return handler
 
     for s in signals:
-        signal.signal(s, handler)
+        prev = signal.getsignal(s)
+        if getattr(prev, "_dtpu_preempt", False):
+            continue  # already ours (with its chain) — idempotent
+        if prev in (signal.SIG_DFL, signal.SIG_IGN, None):
+            prev = None  # nothing meaningful to chain to
+        signal.signal(s, _make(prev))
     _state["installed"] = True
 
 

@@ -1,0 +1,640 @@
+"""Resilience layer (ISSUE 3): verified checkpoints, elastic resume,
+failure supervision, fault injection.
+
+Fast tier (default): manifest commit/verify, corrupt-checkpoint walk-back
+with quarantine, the actionable load error, SIGTERM handler chaining, the
+in-graph non-finite guard, loader retry/skip, the heartbeat watchdog, and
+topology classification — the pure recovery logic, on tiny trees so a
+regression in any path fails ``pytest -m 'not slow'``.
+
+Slow tier: elastic cross-topology resume proven on real state (save at
+dp=4 → resume at dp=2 AND dp=8, ZeRO-1 included, trajectory-equivalent to
+the uninterrupted run within the lockstep tolerance of tests/test_zero.py)
+and the NaN-injection policies through a real compiled train step.
+"""
+
+import json
+import os
+import signal
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+import pytest
+
+import distribuuuu_tpu.config as config
+from distribuuuu_tpu.config import cfg
+from distribuuuu_tpu.resilience import manifest, supervisor
+from distribuuuu_tpu.utils import checkpoint as ckpt, faults
+
+
+@pytest.fixture(autouse=True)
+def _clean_faults():
+    faults.reset()
+    yield
+    faults.reset()
+
+
+def _tiny_tree(seed: float = 0.0):
+    return {
+        "params": {"w": jnp.arange(6, dtype=jnp.float32).reshape(2, 3) + seed},
+        "batch_stats": {"m": jnp.ones((3,), jnp.float32)},
+        "opt_state": {"mu": jnp.full((2, 3), 0.5 + seed, jnp.float32)},
+    }
+
+
+def _truncate_largest(path: str):
+    largest, size = None, -1
+    for dirpath, _, names in os.walk(path):
+        for name in names:
+            if name == manifest.MANIFEST_NAME:
+                continue
+            full = os.path.join(dirpath, name)
+            if os.path.getsize(full) > size:
+                largest, size = full, os.path.getsize(full)
+    assert largest is not None and size > 0
+    with open(largest, "r+b") as f:
+        f.truncate(size // 2)
+    return largest
+
+
+# ------------------------------------------------------- manifest commit
+
+
+def test_save_commits_manifest_and_verifies(tmp_path):
+    cfg.OUT_DIR = str(tmp_path)
+    ckpt.save_checkpoint(_tiny_tree(), epoch=0, best_acc1=1.0, is_best=True)
+    path = ckpt.get_checkpoint(0)
+    man = manifest.read_manifest(path)
+    assert man is not None and man["kind"] == "full" and man["epoch"] == 0
+    # tree spec covers the payload leaves; files carry size+sha256
+    assert any("params" in k for k in man["tree"])
+    assert man["files"] and all(
+        "sha256" in v and v["size"] > 0 for v in man["files"].values()
+    )
+    ok, reason = manifest.verify_checkpoint(path)
+    assert ok, reason
+    # the weights-only best checkpoint is committed too
+    ok, reason = manifest.verify_checkpoint(ckpt.get_best_checkpoint())
+    assert ok, reason
+
+
+def test_verify_detects_truncation_and_missing_manifest(tmp_path):
+    cfg.OUT_DIR = str(tmp_path)
+    ckpt.save_checkpoint(_tiny_tree(), epoch=0, best_acc1=0.0, is_best=False)
+    path = ckpt.get_checkpoint(0)
+    _truncate_largest(path)
+    ok, reason = manifest.verify_checkpoint(path)
+    assert not ok and ("truncated" in reason or "digest" in reason), reason
+    # no manifest ⇒ the save never committed ⇒ invalid by definition
+    os.unlink(manifest.manifest_path(path))
+    ok, reason = manifest.verify_checkpoint(path)
+    assert not ok and "manifest" in reason, reason
+
+
+# ---------------------------------------------- walk-back + quarantine
+
+
+def test_walkback_quarantines_and_lands_on_previous_epoch(tmp_path):
+    """The ISSUE's headline regression: a half-written newest ckpt_ep_* no
+    longer kills the resume — it is quarantined to *.corrupt and the scan
+    walks back to the newest intact save."""
+    cfg.OUT_DIR = str(tmp_path)
+    ckpt.save_checkpoint(_tiny_tree(0.0), epoch=0, best_acc1=0.0, is_best=False)
+    ckpt.save_checkpoint(_tiny_tree(9.0), epoch=1, best_acc1=0.0, is_best=False)
+    _truncate_largest(ckpt.get_checkpoint(1))
+
+    found = ckpt.find_last_valid_checkpoint()
+    assert found.endswith("ckpt_ep_000")
+    names = sorted(os.listdir(ckpt.get_checkpoint_dir()))
+    assert "ckpt_ep_001.corrupt" in names and "ckpt_ep_001" not in names, names
+    # the survivor restores cleanly with epoch-0 values
+    restored = ckpt.load_checkpoint(found)
+    np.testing.assert_array_equal(
+        np.asarray(restored["params"]["w"]),
+        np.arange(6, dtype=np.float32).reshape(2, 3),
+    )
+
+
+def test_partial_save_without_manifest_is_walked_past(tmp_path):
+    """Crash-before-commit: a dir with payload bytes but no manifest (the
+    window the atomic commit protocol closes) is treated as partial."""
+    cfg.OUT_DIR = str(tmp_path)
+    ckpt.save_checkpoint(_tiny_tree(), epoch=0, best_acc1=0.0, is_best=False)
+    partial = ckpt.get_checkpoint(1)
+    os.makedirs(partial)
+    with open(os.path.join(partial, "junk"), "wb") as f:
+        f.write(b"half-written")
+    assert ckpt.get_last_checkpoint() == partial  # the raw scan would pick it
+    assert ckpt.find_last_valid_checkpoint().endswith("ckpt_ep_000")
+    assert "ckpt_ep_001.corrupt" in os.listdir(ckpt.get_checkpoint_dir())
+
+
+def test_all_corrupt_raises_no_valid(tmp_path):
+    cfg.OUT_DIR = str(tmp_path)
+    ckpt.save_checkpoint(_tiny_tree(), epoch=0, best_acc1=0.0, is_best=False)
+    _truncate_largest(ckpt.get_checkpoint(0))
+    with pytest.raises(ckpt.NoValidCheckpointError, match="none verified"):
+        ckpt.find_last_valid_checkpoint()
+
+
+def test_corrupt_preempt_walks_back_to_epoch_checkpoint(tmp_path):
+    """Preference ordering survives verification: a corrupt preempt_ep_1
+    (which outranks ckpt_ep_000) is quarantined, not selected forever."""
+    from distribuuuu_tpu.utils.checkpoint import save_preempt_checkpoint
+
+    cfg.OUT_DIR = str(tmp_path)
+    ckpt.save_checkpoint(_tiny_tree(), epoch=0, best_acc1=0.0, is_best=False)
+    save_preempt_checkpoint(_tiny_tree(1.0), epoch=1, best_acc1=0.0)
+    assert ckpt.find_last_valid_checkpoint().endswith("preempt_ep_001")
+    _truncate_largest(os.path.join(ckpt.get_checkpoint_dir(), "preempt_ep_001"))
+    assert ckpt.find_last_valid_checkpoint().endswith("ckpt_ep_000")
+
+
+# -------------------------------------------------- actionable load error
+
+
+def test_load_checkpoint_failure_is_actionable(tmp_path):
+    """Satellite 2: a broken orbax restore names the path, the quarantine
+    action, and the resume-from-previous command — no raw tensorstore
+    traceback as the only signal."""
+    cfg.OUT_DIR = str(tmp_path)
+    ckpt.save_checkpoint(_tiny_tree(), epoch=3, best_acc1=0.0, is_best=False)
+    path = ckpt.get_checkpoint(3)
+    _truncate_largest(path)
+    with pytest.raises(ckpt.CheckpointLoadError) as ei:
+        ckpt.load_checkpoint(path)
+    msg = str(ei.value)
+    assert "ckpt_ep_003" in msg
+    assert "quarantined to" in msg and ".corrupt" in msg
+    assert "TRAIN.AUTO_RESUME" in msg and "MODEL.WEIGHTS" in msg
+    assert not os.path.exists(path)  # really moved aside
+
+
+def test_load_checkpoint_outside_run_dir_not_quarantined(tmp_path):
+    """A user-supplied path (MODEL.WEIGHTS) is never renamed."""
+    cfg.OUT_DIR = str(tmp_path)
+    alien = tmp_path / "my_weights"
+    alien.mkdir()
+    (alien / "junk").write_bytes(b"not a checkpoint")
+    with pytest.raises(ckpt.CheckpointLoadError, match="no quarantine"):
+        ckpt.load_checkpoint(str(alien))
+    assert alien.exists()
+
+
+# ------------------------------------------------- topology classification
+
+
+def test_topology_classification(tmp_path):
+    cfg.OUT_DIR = str(tmp_path)
+    cfg.MODEL.ARCH = "resnet18"
+    cfg.MODEL.NUM_CLASSES = 10
+    ckpt.save_checkpoint(_tiny_tree(), epoch=0, best_acc1=0.0, is_best=False)
+    man = manifest.read_manifest(ckpt.get_checkpoint(0))
+
+    live_spec = manifest.tree_spec(
+        {k: _tiny_tree()[k] for k in ("params", "batch_stats")}
+    )
+    kind, _ = manifest.classify_topology(man, live_spec)
+    assert kind == "exact"
+
+    # a different world (the elastic case) ⇒ reshardable, named diff
+    man2 = json.loads(json.dumps(man))
+    man2["topology"]["devices"] = 64
+    man2["topology"]["zero"] = 1
+    kind, detail = manifest.classify_topology(man2, live_spec)
+    assert kind == "reshardable" and "devices 64" in detail, detail
+
+    # arch identity changed ⇒ incompatible via fingerprint
+    cfg.MODEL.NUM_CLASSES = 1000
+    kind, detail = manifest.classify_topology(man, live_spec)
+    assert kind == "incompatible" and "fingerprint" in detail
+    cfg.MODEL.NUM_CLASSES = 10
+
+    # param shape changed ⇒ incompatible via tree spec
+    bad_spec = dict(live_spec)
+    key = next(k for k in bad_spec if "w" in k)
+    bad_spec[key] = {"shape": [4, 3], "dtype": "float32"}
+    kind, detail = manifest.classify_topology(man, bad_spec)
+    assert kind == "incompatible" and "shape" in detail
+
+
+# ---------------------------------------------- SIGTERM handler chaining
+
+
+def test_preempt_install_chains_prior_sigterm_handler():
+    """Satellite 1: preempt.install no longer clobbers a previously
+    installed SIGTERM handler (the serve drain registers one too) — both
+    flags trip on one signal, in either install order."""
+    from distribuuuu_tpu.serve import admission
+    from distribuuuu_tpu.utils import preempt
+
+    orig = signal.getsignal(signal.SIGTERM)
+    try:
+        for first, second in (
+            (admission.install_drain, preempt.install),
+            (preempt.install, admission.install_drain),
+        ):
+            signal.signal(signal.SIGTERM, signal.SIG_DFL)
+            preempt.reset()
+            admission.reset_drain()
+            first()
+            second()
+            os.kill(os.getpid(), signal.SIGTERM)
+            assert preempt.requested_local(), (first, second)
+            assert admission.drain_requested(), (first, second)
+        # idempotent re-install must not chain to itself (no recursion)
+        preempt.reset()
+        preempt.install()
+        preempt.install()
+        os.kill(os.getpid(), signal.SIGTERM)
+        assert preempt.requested_local()
+    finally:
+        signal.signal(signal.SIGTERM, orig)
+        preempt.reset()
+        admission.reset_drain()
+
+
+# ------------------------------------------------- in-graph nonfinite guard
+
+
+def _guard_fixture():
+    from distribuuuu_tpu.trainer import TrainState
+
+    old = TrainState(
+        params={"w": jnp.ones((2, 2))},
+        batch_stats={"m": jnp.zeros((2,))},
+        opt_state={"mu": jnp.full((2, 2), 0.5)},
+        step=jnp.int32(7),
+        key=jax.random.key(0),
+    )
+    new = TrainState(
+        params={"w": jnp.full((2, 2), 2.0)},
+        batch_stats={"m": jnp.ones((2,))},
+        opt_state={"mu": jnp.full((2, 2), 0.9)},
+        step=old.step + 1,
+        key=old.key,  # the step never touches the base key (same object)
+    )
+    return old, new
+
+
+@pytest.mark.parametrize("policy", ["raise", "skip", "rollback"])
+def test_guard_nonfinite_annotates_every_policy(policy):
+    old, new = _guard_fixture()
+    guarded, metrics = jax.jit(
+        lambda o, n, loss: supervisor.guard_nonfinite(
+            o, n, {"loss": loss}, policy
+        )
+    )(old, new, jnp.float32(1.25))
+    assert float(metrics["nonfinite"]) == 0.0
+    np.testing.assert_array_equal(np.asarray(guarded.params["w"]), 2.0)
+
+
+def test_guard_nonfinite_skip_reverts_state_but_advances_step():
+    old, new = _guard_fixture()
+    guarded, metrics = jax.jit(
+        lambda o, n, loss: supervisor.guard_nonfinite(
+            o, n, {"loss": loss}, "skip"
+        )
+    )(old, new, jnp.float32(np.nan))
+    assert float(metrics["nonfinite"]) == 1.0
+    # poisoned update discarded wholesale...
+    np.testing.assert_array_equal(np.asarray(guarded.params["w"]), 1.0)
+    np.testing.assert_array_equal(np.asarray(guarded.opt_state["mu"]), 0.5)
+    np.testing.assert_array_equal(np.asarray(guarded.batch_stats["m"]), 0.0)
+    # ...but the step cursor advances (RNG folding moves on)
+    assert int(guarded.step) == 8
+
+
+def test_guard_nonfinite_raise_policy_keeps_state():
+    """'raise' detects at the host; the graph must not silently skip."""
+    old, new = _guard_fixture()
+    guarded, metrics = jax.jit(
+        lambda o, n, loss: supervisor.guard_nonfinite(
+            o, n, {"loss": loss}, "raise"
+        )
+    )(old, new, jnp.float32(np.inf))
+    assert float(metrics["nonfinite"]) == 1.0
+    np.testing.assert_array_equal(np.asarray(guarded.params["w"]), 2.0)
+
+
+def test_nonfinite_monitor_policies():
+    mon = supervisor.NonFiniteMonitor("skip", epoch=0)
+    assert mon.observe(1.0, 0.0, batch=3) is False
+    assert mon.observe(float("nan"), 1.0, batch=4) is True
+    assert mon.skipped == 1
+    mon = supervisor.NonFiniteMonitor("raise", epoch=2)
+    with pytest.raises(supervisor.NonFiniteLossError, match="epoch 3"):
+        mon.observe(float("nan"), 1.0, batch=5)
+    with pytest.raises(ValueError, match="TRAIN.NONFINITE"):
+        supervisor.NonFiniteMonitor("bogus", epoch=0)
+
+
+def test_nonfinite_policy_validated_in_config_checks():
+    from distribuuuu_tpu import trainer
+
+    config.reset_cfg()
+    cfg.TRAIN.NONFINITE = "explode"
+    with pytest.raises(ValueError, match="TRAIN.NONFINITE"):
+        trainer.check_trainer_mesh()
+
+
+# ------------------------------------------------------ loader resilience
+
+
+def _tiny_loader(batch_size=4, length=16):
+    from distribuuuu_tpu.data.dummy import DummyDataset
+    from distribuuuu_tpu.data.loader import Loader
+
+    return Loader(
+        DummyDataset(length=length, size=8),
+        batch_size=batch_size, shuffle=False, drop_last=True, workers=1,
+    )
+
+
+def test_loader_retry_recovers_transient_decode_error():
+    """FAULTS 'once' mode: the first touch of sample 3 raises; the loader's
+    retry-with-backoff succeeds — the epoch completes with real data."""
+    cfg.DATA.RETRY_BACKOFF_S = 0.001
+    cfg.FAULTS.ENABLED = True
+    cfg.FAULTS.DECODE_ERROR_IDX = 3
+    cfg.FAULTS.DECODE_ERROR_MODE = "once"
+    batches = list(_tiny_loader())
+    assert len(batches) == 4
+    assert all(b["image"].shape == (4, 8, 8, 3) for b in batches)
+    # retry delivered the REAL sample 3, not a substitute
+    expected = np.random.default_rng(3).standard_normal(
+        (8, 8, 3), dtype=np.float32
+    )
+    np.testing.assert_array_equal(batches[0]["image"][3], expected)
+
+
+def test_loader_skips_and_substitutes_persistently_corrupt_sample():
+    """'always' mode: sample 5 never decodes; it is replaced by a good
+    sample from the same batch (shape-stable for jit) and the epoch
+    completes instead of aborting."""
+    cfg.DATA.RETRIES = 1
+    cfg.DATA.RETRY_BACKOFF_S = 0.001
+    cfg.FAULTS.ENABLED = True
+    cfg.FAULTS.DECODE_ERROR_IDX = 5
+    cfg.FAULTS.DECODE_ERROR_MODE = "always"
+    batches = list(_tiny_loader())
+    assert len(batches) == 4
+    # slot 5 (batch 1, position 1) now holds batch 1's first good sample
+    expected = np.random.default_rng(4).standard_normal(
+        (8, 8, 3), dtype=np.float32
+    )
+    np.testing.assert_array_equal(batches[1]["image"][1], expected)
+
+
+def test_loader_fail_stop_when_skip_disabled():
+    cfg.DATA.RETRIES = 0
+    cfg.DATA.SKIP_CORRUPT = False
+    cfg.FAULTS.ENABLED = True
+    cfg.FAULTS.DECODE_ERROR_IDX = 5
+    cfg.FAULTS.DECODE_ERROR_MODE = "always"
+    with pytest.raises(RuntimeError, match="fail-stop"):
+        list(_tiny_loader())
+
+
+# ------------------------------------------------------ heartbeat watchdog
+
+
+def test_heartbeat_flags_stall_and_quiet_when_beaten():
+    import time
+
+    hb = supervisor.Heartbeat(0.05)
+    try:
+        time.sleep(0.3)
+        assert hb.stall_count >= 1
+        stalled = hb.stall_count
+        # one stall is flagged once, not once per poll
+        time.sleep(0.15)
+        assert hb.stall_count == stalled
+        hb.beat("recovered")
+        time.sleep(0.02)
+        assert hb.stall_count == stalled
+    finally:
+        hb.stop()
+
+    hb = supervisor.Heartbeat(0.2)
+    try:
+        for _ in range(10):
+            hb.beat("busy")
+            time.sleep(0.02)
+        assert hb.stall_count == 0
+    finally:
+        hb.stop()
+
+    hb = supervisor.Heartbeat(0.0)  # disabled: no thread, no-ops
+    hb.beat()
+    hb.stop()
+    assert hb.stall_count == 0
+
+
+# ----------------------------------------------- elastic resume (slow tier)
+
+
+BATCH = 16
+LOCKSTEP_ATOL = (1e-5, 2e-2)  # step-0 exactness, step-1 drift (test_zero.py)
+
+
+def _stream_batch(step: int, n: int = BATCH):
+    rng = np.random.default_rng(7_000 + step)
+    images = rng.standard_normal((n, 32, 32, 3)).astype(np.float32)
+    labels = (
+        (images.mean(axis=(1, 2, 3)) * 40.0).astype(np.int64) % 10
+    ).astype(np.int32)
+    images += labels[:, None, None, None] * 0.1
+    return {"image": images, "label": labels, "mask": np.ones((n,), np.float32)}
+
+
+def _elastic_setup(tmp_path, dp: int, zero_stage: int):
+    from distribuuuu_tpu import trainer
+    from distribuuuu_tpu.parallel import mesh as mesh_lib
+    from distribuuuu_tpu.utils.optim import construct_optimizer
+
+    config.reset_cfg()
+    cfg.MODEL.ARCH = "resnet18"
+    cfg.MODEL.NUM_CLASSES = 10
+    cfg.MODEL.BN_GROUP = 8
+    cfg.OPTIM.BASE_LR = 0.05
+    cfg.DEVICE.COMPUTE_DTYPE = "float32"
+    cfg.MESH.ZERO = zero_stage
+    cfg.OUT_DIR = str(tmp_path)
+    mesh = mesh_lib.build_mesh(data=dp, devices=jax.devices()[:dp])
+    model = trainer.build_model_from_cfg()
+    layout = trainer._state_layout(model, mesh, 32) if zero_stage else None
+    state = trainer.create_train_state(
+        model, jax.random.key(0), mesh, 32, layout=layout
+    )
+    step = trainer.make_train_step(
+        model, construct_optimizer(), topk=5, layout=layout
+    )
+    return mesh, model, state, step
+
+
+def _run_steps(mesh, state, step, first: int, last: int):
+    from distribuuuu_tpu.parallel import sharding as sharding_lib
+
+    losses = []
+    for it in range(first, last):
+        batch = sharding_lib.shard_batch(mesh, _stream_batch(it))
+        state, m = step(state, batch)
+        losses.append(float(m["loss"]))
+    return state, losses
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize("zero_stage", [0, 1])
+def test_elastic_resume_dp4_to_dp2_and_dp8(tmp_path, zero_stage):
+    """The acceptance drill: save at dp=4, resume at dp=2 AND dp=8 (ZeRO-1
+    variant reassembles sharded optimizer state through pack_opt_state),
+    each reproducing the uninterrupted dp=4 trajectory within the lockstep
+    tolerance — elastic resume is trajectory-equivalent, not merely
+    crash-free."""
+    from distribuuuu_tpu import trainer
+
+    # uninterrupted reference: 4 steps, then 2 more, all at dp=4
+    mesh4, _, state, step = _elastic_setup(tmp_path / "ref", 4, zero_stage)
+    state, _ = _run_steps(mesh4, state, step, 0, 4)
+    _, base_tail = _run_steps(mesh4, state, step, 4, 6)
+
+    # interrupted run: identical 4 steps at dp=4, checkpointed
+    mesh4b, _, state_b, step_b = _elastic_setup(tmp_path / "run", 4, zero_stage)
+    state_b, _ = _run_steps(mesh4b, state_b, step_b, 0, 4)
+    ckpt.save_checkpoint(trainer._state_tree(state_b), 0, 0.0, False)
+    man = manifest.read_manifest(ckpt.get_checkpoint(0))
+    assert man["topology"]["mesh"].get("data") == 4
+
+    for dp in (2, 8):
+        mesh_n, _, fresh, step_n = _elastic_setup(tmp_path / "run", dp, zero_stage)
+        resumed, start_epoch, _, _ = trainer._resume(fresh, mesh_n)
+        assert start_epoch == 1 and int(resumed.step) == 4
+        # no silent weights-only fallback: momenta must equal the saved ones
+        saved_mom = [
+            np.asarray(x) for x in jax.tree.leaves(state_b.opt_state)
+            if hasattr(x, "ndim") and x.ndim >= 2
+        ]
+        got_mom = [
+            np.asarray(x) for x in jax.tree.leaves(resumed.opt_state)
+            if hasattr(x, "ndim") and x.ndim >= 2
+        ]
+        assert any(np.abs(m).max() > 0 for m in saved_mom)
+        for a, b in zip(saved_mom, got_mom):
+            np.testing.assert_array_equal(a, b)
+        _, tail = _run_steps(mesh_n, resumed, step_n, 4, 6)
+        assert np.isfinite(tail).all(), (dp, tail)
+        np.testing.assert_allclose(
+            tail[0], base_tail[0], rtol=0, atol=LOCKSTEP_ATOL[0],
+            err_msg=f"dp={dp} zero={zero_stage} first resumed step",
+        )
+        np.testing.assert_allclose(
+            tail[1], base_tail[1], rtol=0, atol=LOCKSTEP_ATOL[1],
+            err_msg=f"dp={dp} zero={zero_stage} second resumed step",
+        )
+
+
+@pytest.mark.slow
+def test_elastic_resume_refuses_incompatible_model(tmp_path):
+    """The manifest topology check distinguishes re-shardable from
+    incompatible: a NUM_CLASSES change refuses with the reason instead of
+    a shape error deep in device_put."""
+    from distribuuuu_tpu import trainer
+
+    mesh, _, state, step = _elastic_setup(tmp_path, 4, 0)
+    state, _ = _run_steps(mesh, state, step, 0, 1)
+    ckpt.save_checkpoint(trainer._state_tree(state), 0, 0.0, False)
+
+    cfg.MODEL.NUM_CLASSES = 37
+    model2 = trainer.build_model_from_cfg()
+    fresh = trainer.create_train_state(model2, jax.random.key(1), mesh, 32)
+    with pytest.raises(ckpt.CheckpointError, match="cannot feed"):
+        trainer._resume(fresh, mesh)
+
+
+# -------------------------------------- NaN injection e2e (slow tier)
+
+
+@pytest.mark.slow
+def test_nan_injection_skip_policy_through_real_step(tmp_path):
+    """FAULTS.NAN_STEP=1 + TRAIN.NONFINITE=skip through a real compiled
+    step: step 1's poisoned update is discarded in-graph (params equal the
+    post-step-0 params), the flag reads 1.0 exactly there, and training
+    continues finite afterward."""
+    mesh, model, state, _ = _elastic_setup(tmp_path, 8, 0)
+    # rebuild the step with the injection + skip policy compiled in
+    cfg.TRAIN.NONFINITE = "skip"
+    cfg.FAULTS.ENABLED = True
+    cfg.FAULTS.NAN_STEP = 1
+    from distribuuuu_tpu import trainer
+    from distribuuuu_tpu.utils.optim import construct_optimizer
+
+    step = trainer.make_train_step(model, construct_optimizer(), topk=5)
+    from distribuuuu_tpu.parallel import sharding as sharding_lib
+
+    state, m0 = step(state, sharding_lib.shard_batch(mesh, _stream_batch(0)))
+    assert float(m0["nonfinite"]) == 0.0
+    w_after0 = np.asarray(jax.tree.leaves(state.params)[0])
+
+    state, m1 = step(state, sharding_lib.shard_batch(mesh, _stream_batch(1)))
+    assert float(m1["nonfinite"]) == 1.0
+    assert not np.isfinite(float(m1["loss"]))
+    np.testing.assert_array_equal(
+        np.asarray(jax.tree.leaves(state.params)[0]), w_after0
+    )
+    assert int(state.step) == 2  # the cursor advanced past the bad step
+
+    state, m2 = step(state, sharding_lib.shard_batch(mesh, _stream_batch(2)))
+    assert float(m2["nonfinite"]) == 0.0
+    assert np.isfinite(float(m2["loss"]))
+    assert np.isfinite(np.asarray(jax.tree.leaves(state.params)[0])).all()
+
+
+@pytest.mark.slow
+def test_nan_rollback_policy_reloads_checkpoint(tmp_path):
+    """TRAIN.NONFINITE=rollback through train_model: a deterministic NaN in
+    epoch 1 rolls the run back to ckpt_ep_000 (logged), re-trips, and
+    surfaces once TRAIN.MAX_ROLLBACKS is spent — while a clean rerun (the
+    transient passed) completes from the same checkpoint."""
+    import logging
+
+    from distribuuuu_tpu import trainer
+
+    config.reset_cfg()
+    cfg.MODEL.ARCH = "resnet18"
+    cfg.MODEL.NUM_CLASSES = 10
+    cfg.MODEL.DUMMY_INPUT = True
+    cfg.DEVICE.COMPUTE_DTYPE = "float32"
+    cfg.TRAIN.BATCH_SIZE = 2
+    cfg.TRAIN.IM_SIZE = 32
+    cfg.TRAIN.PRINT_FREQ = 2
+    cfg.TEST.BATCH_SIZE = 4
+    cfg.TEST.IM_SIZE = 32
+    cfg.OPTIM.MAX_EPOCH = 2
+    cfg.OUT_DIR = str(tmp_path)
+    cfg.RNG_SEED = 0
+    cfg.TRAIN.NONFINITE = "rollback"
+    cfg.TRAIN.MAX_ROLLBACKS = 1
+    cfg.FAULTS.ENABLED = True
+    cfg.FAULTS.NAN_STEP = 11  # inside epoch 1 (8 batches/epoch at this size)
+
+    # the package logger has propagate=False, so capture with our own
+    # handler rather than caplog
+    messages = []
+    handler = logging.Handler()
+    handler.emit = lambda record: messages.append(record.getMessage())
+    logging.getLogger("distribuuuu_tpu").addHandler(handler)
+    try:
+        with pytest.raises(supervisor.NonFiniteLossError):
+            trainer.train_model()
+    finally:
+        logging.getLogger("distribuuuu_tpu").removeHandler(handler)
+    assert any("rolling back" in m for m in messages), messages[-5:]
+    # epoch 0's checkpoint is intact; the clean rerun resumes and finishes
+    cfg.FAULTS.ENABLED = False
+    cfg.FAULTS.NAN_STEP = -1
+    best = trainer.train_model()
+    assert np.isfinite(best)
+    names = os.listdir(ckpt.get_checkpoint_dir())
+    assert "ckpt_ep_001" in names, names

@@ -1,0 +1,116 @@
+"""Hard-crash recovery across real OS processes (slow tier).
+
+SIGKILL — unlike the SIGTERM drills in test_multiprocess_e2e.py — gives
+the dying rank NO grace window: no preempt save, no drain, nothing. The
+recovery story is entirely the restart's: the surviving checkpoint on
+disk must verify intact and the next launch must resume from it cleanly.
+This drill kills one of two ranks mid-epoch-1 (deterministically, via
+``FAULTS.KILL_RANK/KILL_EPOCH/KILL_AT_BATCH`` — the worker SIGKILLs
+itself at a batch boundary), reaps the wedged survivor the way a fleet
+scheduler would, and asserts a full-group restart completes the run from
+``ckpt_ep_000`` with no corrupt checkpoint ever selected.
+"""
+
+import os
+import re
+import signal
+import subprocess
+import sys
+import time
+
+import pytest
+
+import test_multiprocess_e2e as mp
+
+REPO = mp.REPO
+
+WORKER = """
+import os, sys
+os.environ["JAX_PLATFORMS"] = "cpu"
+os.environ["XLA_FLAGS"] = (
+    os.environ.get("XLA_FLAGS", "")
+    + " --xla_force_host_platform_device_count="
+    + os.environ.get("DTPU_TEST_NDEV", "2")
+).strip()
+import jax
+jax.config.update("jax_platforms", "cpu")
+
+import distribuuuu_tpu.config as config
+from distribuuuu_tpu.config import cfg
+from distribuuuu_tpu import trainer
+
+out_dir = sys.argv[1]
+config.reset_cfg()
+cfg.MODEL.ARCH = "resnet18"
+cfg.MODEL.NUM_CLASSES = 10
+cfg.MODEL.DUMMY_INPUT = True
+cfg.OPTIM.MAX_EPOCH = 2
+cfg.TRAIN.BATCH_SIZE = 2
+cfg.TRAIN.IM_SIZE = 32
+cfg.TRAIN.PRINT_FREQ = 4
+cfg.TEST.BATCH_SIZE = 4
+cfg.TEST.IM_SIZE = 32
+cfg.RNG_SEED = 1
+cfg.DEVICE.COMPUTE_DTYPE = "float32"
+cfg.OUT_DIR = out_dir
+if len(sys.argv) > 2:
+    cfg.merge_from_list(sys.argv[2:])
+best = trainer.train_model()
+print(f"WORKER_DONE rank={jax.process_index()} best={best:.3f}", flush=True)
+"""
+
+
+@pytest.mark.slow
+def test_sigkilled_rank_recovers_on_restart(tmp_path):
+    out_dir = str(tmp_path / "run")
+    script = tmp_path / "worker.py"
+    script.write_text(WORKER)
+    ckpt_dir = os.path.join(out_dir, "checkpoints")
+
+    # ---- run 1: rank 1 SIGKILLs itself at epoch 1, batch 2 (after the
+    # collective ckpt_ep_000 save committed) ----
+    kill_args = (
+        "FAULTS.ENABLED", "True", "FAULTS.KILL_RANK", "1",
+        "FAULTS.KILL_EPOCH", "1", "FAULTS.KILL_AT_BATCH", "2",
+    )
+    procs, logs = mp._launch_group(
+        tmp_path, script, (out_dir, *kill_args), nprocs=2, ndev=2,
+        log_name=lambda rank, port: f"kill{rank}_{port}.log",
+    )
+    procs[1].wait(timeout=900)
+    assert procs[1].returncode == -signal.SIGKILL, procs[1].returncode
+    # the survivor is now wedged in (or erroring out of) a collective with
+    # a dead peer; give it a moment to die on its own, then reap it the
+    # way a fleet scheduler reaps a broken group
+    deadline = time.time() + 30
+    while time.time() < deadline and procs[0].poll() is None:
+        time.sleep(1.0)
+    if procs[0].poll() is None:
+        procs[0].kill()
+        procs[0].wait(timeout=60)
+    for log in logs:
+        log.close()
+
+    names = sorted(os.listdir(ckpt_dir))
+    assert "ckpt_ep_000" in names, names  # epoch 0 committed before the kill
+    assert not any(n.startswith("ckpt_ep_001") for n in names), names
+
+    # ---- run 2: full-group restart, no faults — must resume and finish ----
+    procs, logs = mp._launch_group(
+        tmp_path, script, (out_dir,), nprocs=2, ndev=2,
+        log_name=lambda rank, port: f"restart{rank}_{port}.log",
+    )
+    outs = []
+    for p, log in zip(procs, logs):
+        p.wait(timeout=900)
+        log.seek(0)
+        outs.append(log.read())
+        log.close()
+    for rank, (p, out) in enumerate(zip(procs, outs)):
+        assert p.returncode == 0, f"rank {rank} failed:\n{out[-3000:]}"
+        assert "WORKER_DONE" in out, out[-2000:]
+    assert re.search(r"resumed from .*ckpt_ep_000", outs[0]), outs[0][-2000:]
+    names = sorted(os.listdir(ckpt_dir))
+    assert {"best", "ckpt_ep_000", "ckpt_ep_001"} <= set(names), names
+    # the committed save was intact — nothing should have been quarantined
+    assert not any(".corrupt" in n for n in names), names
