@@ -390,6 +390,23 @@ _C.MESH.ZERO = 0
 
 # ------------------------------- data pipeline -------------------------------
 _C.DATA = CfgNode()
+# Dataset storage format. "imagefolder" reads root/split/class/*.jpg one
+# file per sample (the reference layout). "shards" streams indexed record
+# shards packed by tools/make_shards.py (data/shards/): sequential IO from
+# a few large files, a (seed, epoch)-only topology-independent sample
+# order, and exact mid-epoch resume — the preemption checkpoint embeds the
+# loader's global cursor, so a restart continues at the exact next batch
+# instead of re-running the epoch. TRAIN/TEST.DATASET point at the shards
+# root (the directory holding <split>/MANIFEST.json).
+_C.DATA.FORMAT = "imagefolder"
+# Shard-streaming order knobs (data/shards/order.py): storage order is cut
+# into SHARDS_BLOCK-record sequential runs, the runs are permuted, and a
+# SHARDS_WINDOW-sample shuffle buffer decorrelates neighbors. Bigger block
+# = more sequential IO, less mixing; bigger window = better mixing, more
+# read scatter. block=1 + window≥dataset restores the exact uniform
+# shuffle of the imagefolder sampler.
+_C.DATA.SHARDS_BLOCK = 64
+_C.DATA.SHARDS_WINDOW = 1024
 # Decode backend: "auto" uses the C++ kernel (native/decode.cc) when it
 # builds, else PIL; "native" requires it; "pil" forces pure Python.
 _C.DATA.BACKEND = "auto"
@@ -436,6 +453,18 @@ _C.FAULTS.KILL_AT_BATCH = -1
 _C.FAULTS.STALL_EPOCH = 0
 _C.FAULTS.STALL_AT_BATCH = -1
 _C.FAULTS.STALL_S = 0.0
+# Deliver SIGTERM to this process at (PREEMPT_EPOCH, PREEMPT_AT_BATCH) —
+# a deterministic scheduler preemption through the REAL signal handler
+# (utils/preempt.py): the epoch loop exits at the next boundary and the
+# mid-epoch checkpoint (with the shards data cursor) is written. -1 = off.
+_C.FAULTS.PREEMPT_EPOCH = 0
+_C.FAULTS.PREEMPT_AT_BATCH = -1
+# Truncate shard file #TRUNCATE_SHARD of the dataset split to 60% of its
+# manifest size before the reader opens it (DATA.FORMAT=shards): kills the
+# index footer and the tail records — the reader must recover the index by
+# forward scan and the lost records must flow through DATA.SKIP_CORRUPT.
+# -1 = off.
+_C.FAULTS.TRUNCATE_SHARD = -1
 # After ckpt_ep_{CORRUPT_EPOCH} commits: "truncate" halves its largest
 # payload file (digest-mismatch path); "partial" deletes its manifest
 # (crash-before-commit path). -1 = off.

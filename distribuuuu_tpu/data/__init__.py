@@ -5,6 +5,11 @@ Reference surface (ref: /root/reference/distribuuuu/utils.py:109-184):
 ImageFolder-or-dummy pipelines with DistributedSampler sharding. Here each
 *host process* loads only its shard (images/sec scale with hosts) and the
 trainer assembles global sharded arrays on the data mesh axis.
+
+``DATA.FORMAT = shards`` swaps the storage layer for indexed record
+shards (data/shards/): sequential IO from a few large files, a
+(seed, epoch)-only topology-independent sample order, and an exact
+mid-epoch resume cursor embedded in preemption checkpoints.
 """
 
 from distribuuuu_tpu.data.dummy import DummyDataset  # noqa: F401

@@ -73,19 +73,126 @@ class Loader:
         # row (model/pipe axes spanning hosts) must load identical data
         # (parallel/mesh.data_process_groups; ≡ (rank, world) in pure DP)
         data_rank, data_world = mesh_lib.data_process_groups()
-        self.sampler = DistributedSampler(
-            len(dataset),
-            num_replicas=data_world,
-            rank=data_rank,
-            shuffle=shuffle,
-            seed=seed,
-            drop_last=False,  # torch pads in the sampler; drop happens per-batch
-        )
+        # Datasets may supply their own sampler (the shard reader's
+        # window-shuffled sequential order, data/shards/order.py); the
+        # torch-semantics DistributedSampler is the default. Both draw the
+        # GLOBAL per-epoch order from (seed, epoch) alone and stride it by
+        # rank, so k consumed global batches ≡ the order's first
+        # k × global_batch entries on any topology — the invariant the
+        # exact mid-epoch resume cursor (state_dict) rests on.
+        self.sampler = None
+        mk = getattr(dataset, "make_sampler", None)
+        if mk is not None:
+            self.sampler = mk(
+                num_replicas=data_world, rank=data_rank, shuffle=shuffle,
+                seed=seed, drop_last=False,
+            )
+        if self.sampler is None:
+            self.sampler = DistributedSampler(
+                len(dataset),
+                num_replicas=data_world,
+                rank=data_rank,
+                shuffle=shuffle,
+                seed=seed,
+                drop_last=False,  # torch pads in the sampler; drop per-batch
+            )
+        self._epoch = 0
+        self._resume: dict | None = None  # {"epoch", "skip"} — one-shot
 
     def set_epoch(self, epoch: int) -> None:
+        self._epoch = epoch
         self.sampler.set_epoch(epoch)
         if hasattr(self.dataset, "set_epoch_seed"):
             self.dataset.set_epoch_seed(epoch)
+
+    # ------------------------------------------------- exact mid-epoch resume
+    def can_save_state(self) -> bool:
+        """True when this loader's position is exactly resumable: the
+        shard-format dataset plus an order whose identity is saveable
+        (WindowShuffleSampler.order_state). The imagefolder path keeps the
+        coarser epoch-granular resume."""
+        return (
+            getattr(self.dataset, "FORMAT", "") == "shards"
+            and hasattr(self.sampler, "order_state")
+        )
+
+    def state_dict(self, batches_consumed: int) -> dict:
+        """Saveable iterator state after ``batches_consumed`` batches of
+        the current epoch: the epoch, the GLOBAL sample cursor (world-size
+        independent — k global batches consume the order's first
+        k × global_batch entries on any topology), and the shuffle-order
+        identity incl. the shuffle-RNG state. JSON-able by construction;
+        ``utils/checkpoint.save_preempt_checkpoint`` embeds it."""
+        sd = {
+            "v": 1,
+            "format": getattr(self.dataset, "FORMAT", "imagefolder"),
+            "epoch": int(self._epoch),
+            "cursor": int(batches_consumed)
+            * self.batch_size
+            * self.sampler.num_replicas,
+            "num_records": len(self.dataset),
+        }
+        if hasattr(self.sampler, "order_state"):
+            sd["order"] = self.sampler.order_state()
+        return sd
+
+    def load_state_dict(self, sd: dict) -> int:
+        """Arm the one-shot mid-epoch skip from a saved ``state_dict``.
+        Returns the number of per-rank batches that will be skipped when
+        the matching epoch is iterated. Raises ``ValueError`` when the
+        cursor cannot be trusted (format/corpus/shuffle-identity changed)
+        — the caller falls back to re-running the epoch from batch 0."""
+        live_fmt = getattr(self.dataset, "FORMAT", "imagefolder")
+        if sd.get("format") != live_fmt:
+            raise ValueError(
+                f"saved data state is {sd.get('format')!r}, live pipeline "
+                f"is {live_fmt!r}"
+            )
+        if int(sd.get("num_records", -1)) != len(self.dataset):
+            raise ValueError(
+                f"corpus changed: saved {sd.get('num_records')} records, "
+                f"live dataset has {len(self.dataset)}"
+            )
+        saved_order = sd.get("order")
+        if saved_order is not None:
+            if not hasattr(self.sampler, "order_state"):
+                raise ValueError("live sampler has no saveable order")
+            epoch = int(sd["epoch"])
+            cur_epoch = self.sampler.epoch
+            self.sampler.set_epoch(epoch)
+            live_order = self.sampler.order_state()
+            self.sampler.set_epoch(cur_epoch)
+            if live_order != saved_order:
+                diff = [
+                    k for k in sorted(set(live_order) | set(saved_order))
+                    if live_order.get(k) != saved_order.get(k)
+                ]
+                raise ValueError(
+                    "shuffle order identity changed since the save "
+                    f"(fields: {', '.join(diff)}) — the cursor would point "
+                    "into a different permutation"
+                )
+        cursor = int(sd["cursor"])
+        global_batch = self.batch_size * self.sampler.num_replicas
+        skip, rem = divmod(cursor, global_batch)
+        if rem:
+            # topology grew (global batch no longer divides the cursor):
+            # round DOWN — re-trains at most one partial batch, exactness
+            # degrades to at-least-once for those samples (logged)
+            get_logger().warning(
+                "restored cursor %d is not a multiple of the live global "
+                "batch %d — resuming at batch %d (up to %d samples re-run)",
+                cursor, global_batch, skip, rem,
+            )
+        self._resume = {"epoch": int(sd["epoch"]), "skip": int(skip)}
+        return int(skip)
+
+    def resume_skip(self, epoch: int) -> int:
+        """Batches the NEXT iteration of ``epoch`` will skip (armed by
+        ``load_state_dict``; consumed one-shot by ``__iter__``)."""
+        if self._resume is not None and self._resume["epoch"] == int(epoch):
+            return self._resume["skip"]
+        return 0
 
     def __len__(self):
         n = self.sampler.num_samples
@@ -208,6 +315,12 @@ class Loader:
             idxs[b * self.batch_size : (b + 1) * self.batch_size]
             for b in range(n_batches)
         ]
+        if self._resume is not None and self._resume["epoch"] == self._epoch:
+            # exact mid-epoch resume: the skipped batches were already
+            # consumed (and trained) by the preempted run — jump the
+            # cursor, don't decode them (one-shot; later epochs are whole)
+            chunks = chunks[self._resume["skip"] :]
+            self._resume = None
         # Parallel background assembly (the torch worker-pool analogue):
         # `workers` batches decode/augment concurrently ahead of the consumer.
         # PIL decode and numpy transforms release the GIL, so threads give
@@ -292,20 +405,32 @@ def _build_dataset(split: str, train: bool):
             length=cfg.TRAIN.BATCH_SIZE * 64, size=cfg.TRAIN.IM_SIZE,
             raw_u8=raw_u8,
         )
-    from distribuuuu_tpu.data.imagefolder import ImageFolderDataset
-
     root = cfg.TRAIN.DATASET if train else cfg.TEST.DATASET
     # train: RandomResizedCrop target; val: shorter-side resize to
     # TEST.IM_SIZE, center-crop to the model input size TRAIN.IM_SIZE
     # (ref: utils.py:131,169-170 — Resize(256) + CenterCrop(224))
     im_size = cfg.TRAIN.IM_SIZE if train else cfg.TEST.IM_SIZE
-    return ImageFolderDataset(
-        root, split, im_size=im_size, train=train,
+    common = dict(
+        im_size=im_size, train=train,
         base_seed=cfg.RNG_SEED or 0,
         crop_size=None if train else cfg.TRAIN.IM_SIZE,
         backend=cfg.DATA.BACKEND,
         raw_u8=raw_u8,
     )
+    if cfg.DATA.FORMAT == "shards":
+        # indexed record shards (data/shards/) — DATASET points at the
+        # packed root (tools/make_shards.py); sequential IO + exact
+        # mid-epoch resume
+        from distribuuuu_tpu.data.shards.reader import ShardDataset
+
+        return ShardDataset(root, split, **common)
+    if cfg.DATA.FORMAT != "imagefolder":
+        raise ValueError(
+            f"DATA.FORMAT must be imagefolder|shards, got {cfg.DATA.FORMAT!r}"
+        )
+    from distribuuuu_tpu.data.imagefolder import ImageFolderDataset
+
+    return ImageFolderDataset(root, split, **common)
 
 
 def construct_train_loader() -> Loader:

@@ -1,0 +1,26 @@
+"""Sharded dataset subsystem (``DATA.FORMAT = shards``).
+
+Indexed record shards + topology-independent streaming + exact mid-epoch
+resume: ``format.py`` is the on-disk contract (length-prefixed CRC'd
+records, per-shard index footer, atomically-committed MANIFEST.json),
+``order.py`` the (seed, epoch)-only window-shuffled sample order, and
+``reader.py`` the dataset the existing loader stack consumes. Pack a tree
+with ``tools/make_shards.py``; certify it with ``--verify``.
+"""
+
+from distribuuuu_tpu.data.shards.format import (  # noqa: F401
+    MANIFEST_NAME,
+    ShardFormatError,
+    ShardReadError,
+    ShardWriter,
+    pack_imagefolder,
+    read_shard_index,
+    read_shard_manifest,
+    verify_split,
+    write_shard_manifest,
+)
+from distribuuuu_tpu.data.shards.order import (  # noqa: F401
+    WindowShuffleSampler,
+    global_order,
+)
+from distribuuuu_tpu.data.shards.reader import ShardDataset  # noqa: F401

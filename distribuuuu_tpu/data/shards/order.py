@@ -1,0 +1,137 @@
+"""Topology-independent, IO-friendly sample order for shard streaming.
+
+The global order for an epoch is a function of ``(seed, epoch)`` ALONE —
+never of world size, rank, or mesh shape. Every data rank strides the same
+global permutation (rank r takes ``order[r::world]``, the
+DistributedSampler convention), so after k global batches the consumed set
+is exactly ``order[:k × global_batch]`` on ANY topology: a dp=4 → dp=2
+elastic resume (resilience layer) continues the identical stream, and the
+saved global cursor means the same thing on both sides.
+
+Unlike the full uniform permutation the imagefolder sampler draws, this
+order is built for sequential shard IO: storage order is cut into
+``block``-record runs, the RUNS are permuted, and a ``window``-sample
+shuffle buffer decorrelates neighbors — every read lands within ~window
+records of a sequential sweep position (page-cache/readahead friendly),
+while any two samples can still meet in a batch across epochs. This is the
+tf.data ``shuffle(buffer)`` regime the MLPerf TPU input pipelines use; at
+``block=1, window=n`` it degenerates to the exact uniform shuffle.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+
+def shuffle_rng(seed: int, epoch: int) -> np.random.Generator:
+    """The epoch's shuffle generator. (seed, epoch)-derived, nothing else."""
+    return np.random.default_rng(
+        np.random.SeedSequence([int(seed) & 0xFFFFFFFF, int(epoch)])
+    )
+
+
+def global_order(n: int, seed: int, epoch: int, block: int = 64,
+                 window: int = 1024) -> np.ndarray:
+    """The epoch's global sample permutation of ``[0, n)`` (int64).
+
+    Two stages, both drawn from :func:`shuffle_rng`:
+      1. block shuffle — storage order is split into ``block``-record runs
+         and the runs are permuted (sequential IO within each run);
+      2. window shuffle — a ``window``-slot buffer over that stream emits a
+         uniformly-chosen slot per step (refilled from the stream), then
+         drains fully shuffled.
+    """
+    n, block, window = int(n), max(1, int(block)), max(1, int(window))
+    if n <= 0:
+        return np.empty((0,), np.int64)
+    rng = shuffle_rng(seed, epoch)
+    n_blocks = -(-n // block)
+    stream = np.concatenate([
+        np.arange(b * block, min((b + 1) * block, n), dtype=np.int64)
+        for b in rng.permutation(n_blocks)
+    ])
+    w = min(window, n)
+    if w <= 1:
+        return stream
+    buf = stream[:w].copy()
+    out = np.empty((n,), np.int64)
+    draws = rng.integers(0, w, size=n - w)
+    for k in range(n - w):
+        j = draws[k]
+        out[k] = buf[j]
+        buf[j] = stream[w + k]
+    rng.shuffle(buf)
+    out[n - w:] = buf
+    return out
+
+
+class WindowShuffleSampler:
+    """Drop-in for ``data/sampler.DistributedSampler`` whose per-epoch
+    permutation is :func:`global_order` — the shard-streaming order. Same
+    padding/striding contract (pad by wrapping to a world multiple, rank r
+    takes ``order[r::world]``), plus ``order_state()`` — the saveable
+    identity of the epoch's shuffle that ``Loader.state_dict`` embeds in
+    preemption checkpoints (exact mid-epoch resume verifies it before
+    trusting a restored cursor)."""
+
+    def __init__(self, dataset_len: int, num_replicas: int, rank: int,
+                 seed: int = 0, block: int = 64, window: int = 1024,
+                 drop_last: bool = False):
+        if rank >= num_replicas:
+            raise ValueError(f"rank {rank} >= num_replicas {num_replicas}")
+        self.dataset_len = int(dataset_len)
+        self.num_replicas = num_replicas
+        self.rank = rank
+        self.seed = int(seed)
+        self.block = int(block)
+        self.window = int(window)
+        self.drop_last = drop_last
+        self.epoch = 0
+        if drop_last and dataset_len % num_replicas != 0:
+            self.num_samples = dataset_len // num_replicas
+        else:
+            self.num_samples = -(-dataset_len // num_replicas)
+        self.total_size = self.num_samples * num_replicas
+        self._cache: tuple[int, np.ndarray] | None = None  # (epoch, order)
+
+    def set_epoch(self, epoch: int) -> None:
+        self.epoch = epoch
+
+    def epoch_order(self) -> np.ndarray:
+        """The epoch's GLOBAL order (shared by all ranks), cached."""
+        if self._cache is None or self._cache[0] != self.epoch:
+            self._cache = (
+                self.epoch,
+                global_order(self.dataset_len, self.seed, self.epoch,
+                             self.block, self.window),
+            )
+        return self._cache[1]
+
+    def indices(self) -> np.ndarray:
+        order = self.epoch_order()
+        if not self.drop_last and len(order) < self.total_size:
+            pad = self.total_size - len(order)
+            order = np.concatenate([order, order[:pad]])
+        else:
+            order = order[: self.total_size]
+        return order[self.rank :: self.num_replicas]
+
+    def order_state(self) -> dict:
+        """The shuffle identity for this epoch: the knobs that determine
+        the order plus the initial shuffle-RNG state (bit-generator state
+        dict — plain ints, JSON-able). A restored cursor is only honored
+        when the live sampler regenerates the SAME state; anything else
+        (changed RNG_SEED / block / window / corpus) means the cursor
+        would point into a different permutation."""
+        return {
+            "kind": "window_shuffle",
+            "seed": self.seed,
+            "epoch": int(self.epoch),
+            "block": self.block,
+            "window": self.window,
+            "num_records": self.dataset_len,
+            "rng_state": shuffle_rng(self.seed, self.epoch).bit_generator.state,
+        }
+
+    def __len__(self):
+        return self.num_samples

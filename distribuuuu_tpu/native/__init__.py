@@ -22,7 +22,7 @@ import numpy as np
 
 _SRC = os.path.join(os.path.dirname(__file__), "decode.cc")
 _LIB = os.path.join(os.path.dirname(__file__), "_libdtpu_decode.so")
-_ABI_VERSION = 3
+_ABI_VERSION = 4
 
 _lock = threading.Lock()
 _lib = None
@@ -105,6 +105,40 @@ def _load():
         lib.dtpu_load_batch_u8.restype = None
         lib.dtpu_load_batch_u8.argtypes = [
             ctypes.POINTER(ctypes.c_char_p),
+            ctypes.c_void_p,
+            ctypes.c_int32,
+            ctypes.c_int32,
+            ctypes.c_int32,
+            ctypes.c_int32,
+            ctypes.POINTER(ctypes.c_uint8),
+            ctypes.POINTER(ctypes.c_int32),
+        ]
+        # memory-buffer entry points (shard records) — ABI 4
+        lib.dtpu_mem_dims.restype = ctypes.c_int
+        lib.dtpu_mem_dims.argtypes = [
+            ctypes.c_char_p,
+            ctypes.c_int64,
+            ctypes.POINTER(ctypes.c_int32),
+            ctypes.POINTER(ctypes.c_int32),
+        ]
+        lib.dtpu_load_batch_mem.restype = None
+        lib.dtpu_load_batch_mem.argtypes = [
+            ctypes.POINTER(ctypes.c_char_p),
+            ctypes.POINTER(ctypes.c_int64),
+            ctypes.c_void_p,
+            ctypes.c_int32,
+            ctypes.c_int32,
+            ctypes.c_int32,
+            ctypes.POINTER(ctypes.c_float),
+            ctypes.POINTER(ctypes.c_float),
+            ctypes.c_int32,
+            ctypes.POINTER(ctypes.c_float),
+            ctypes.POINTER(ctypes.c_int32),
+        ]
+        lib.dtpu_load_batch_u8_mem.restype = None
+        lib.dtpu_load_batch_u8_mem.argtypes = [
+            ctypes.POINTER(ctypes.c_char_p),
+            ctypes.POINTER(ctypes.c_int64),
             ctypes.c_void_p,
             ctypes.c_int32,
             ctypes.c_int32,
@@ -198,6 +232,101 @@ def load_batch_u8(
     assert geoms.nbytes == n * ctypes.sizeof(Geom), "geom layout mismatch"
     lib.dtpu_load_batch_u8(
         c_paths,
+        geoms.ctypes.data_as(ctypes.c_void_p),
+        n,
+        out_w,
+        out_h,
+        n_threads,
+        images.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)),
+        statuses.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
+    )
+    return images, statuses
+
+
+def has_mem_api() -> bool:
+    """True when the loaded kernel speaks the memory-buffer entry points
+    (ABI ≥ 4 — the version gate in ``_load`` already enforces it, so this
+    is equivalent to ``available()``; kept separate for call-site intent)."""
+    return available()
+
+
+def mem_dims(data: bytes) -> tuple[int, int] | None:
+    """(width, height) from an in-memory encoded image, or None."""
+    lib = _load()
+    if lib is None or not data:
+        return None
+    w, h = ctypes.c_int32(), ctypes.c_int32()
+    if lib.dtpu_mem_dims(data, len(data), ctypes.byref(w), ctypes.byref(h)):
+        return None
+    return w.value, h.value
+
+
+def _mem_args(bufs: list[bytes]):
+    n = len(bufs)
+    c_bufs = (ctypes.c_char_p * n)(*bufs)
+    c_lens = (ctypes.c_int64 * n)(*[len(b) for b in bufs])
+    return c_bufs, c_lens
+
+
+def load_batch_mem(
+    bufs: list[bytes],
+    geoms: np.ndarray,  # structured array matching Geom, len n
+    out_size: tuple[int, int],  # (h, w)
+    mean: np.ndarray,
+    std: np.ndarray,
+    n_threads: int,
+) -> tuple[np.ndarray, np.ndarray]:
+    """``load_batch`` over in-memory encoded buffers (shard records): one
+    GIL-free call, internal thread pool. An empty buffer is the caller's
+    fallback sentinel — it fails instantly with nonzero status."""
+    lib = _load()
+    if lib is None:
+        raise RuntimeError(f"native decode unavailable: {_build_error}")
+    n = len(bufs)
+    out_h, out_w = out_size
+    images = np.empty((n, out_h, out_w, 3), np.float32)
+    statuses = np.empty((n,), np.int32)
+    c_bufs, c_lens = _mem_args(bufs)
+    mean32 = np.ascontiguousarray(mean, np.float32)
+    std32 = np.ascontiguousarray(std, np.float32)
+    geoms = np.ascontiguousarray(geoms)
+    assert geoms.nbytes == n * ctypes.sizeof(Geom), "geom layout mismatch"
+    lib.dtpu_load_batch_mem(
+        c_bufs,
+        c_lens,
+        geoms.ctypes.data_as(ctypes.c_void_p),
+        n,
+        out_w,
+        out_h,
+        mean32.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
+        std32.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
+        n_threads,
+        images.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
+        statuses.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
+    )
+    return images, statuses
+
+
+def load_batch_u8_mem(
+    bufs: list[bytes],
+    geoms: np.ndarray,  # structured array matching Geom, len n
+    out_size: tuple[int, int],  # (h, w)
+    n_threads: int,
+) -> tuple[np.ndarray, np.ndarray]:
+    """Raw-u8 variant of ``load_batch_mem`` (``DATA.DEVICE_NORMALIZE``)."""
+    lib = _load()
+    if lib is None:
+        raise RuntimeError(f"native decode unavailable: {_build_error}")
+    n = len(bufs)
+    out_h, out_w = out_size
+    images = np.empty((n, out_h, out_w, 3), np.uint8)
+    statuses = np.empty((n,), np.int32)
+    c_bufs, c_lens = _mem_args(bufs)
+    geoms = np.ascontiguousarray(geoms)
+    assert geoms.nbytes == n * ctypes.sizeof(Geom), "geom layout mismatch"
+    lib.dtpu_load_batch_u8_mem(
+        c_bufs,
+        c_lens,
         geoms.ctypes.data_as(ctypes.c_void_p),
         n,
         out_w,

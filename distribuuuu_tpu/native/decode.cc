@@ -342,11 +342,22 @@ bool load_resampled(const char* path, const Geom& g, int out_w, int out_h,
   return true;
 }
 
-// Load path → decode → resample → (flip) → normalize into out[HWC].
-bool load_one(const char* path, const Geom& g, int out_w, int out_h,
-              const float* mean, const float* stdv, float* out) {
-  std::vector<uint8_t> res;
-  if (!load_resampled(path, g, out_w, out_h, &res)) return false;
+// Memory-buffer front half (shard records hand encoded bytes directly —
+// no filesystem round-trip): buffer → decode → resample.
+bool load_resampled_mem(const uint8_t* data, int64_t len, const Geom& g,
+                        int out_w, int out_h, std::vector<uint8_t>* res) {
+  if (data == nullptr || len <= 0) return false;
+  std::vector<uint8_t> bytes(data, data + len);
+  ImageU8 img;
+  if (!decode_any(bytes, &img)) return false;
+  resample(img, g.box_x, g.box_y, g.scale_x, g.scale_y, g.out_x0, g.out_y0,
+           out_w, out_h, res);
+  return true;
+}
+
+// Post-resample back halves, shared by the path and memory entry points.
+void finish_one(const std::vector<uint8_t>& res, const Geom& g, int out_w,
+                int out_h, const float* mean, const float* stdv, float* out) {
   const float inv255 = 1.0f / 255.0f;
   float inv_std[3] = {1.0f / stdv[0], 1.0f / stdv[1], 1.0f / stdv[2]};
   for (int y = 0; y < out_h; ++y) {
@@ -360,16 +371,10 @@ bool load_one(const char* path, const Geom& g, int out_w, int out_h,
         q[c] = (p[c] * inv255 - mean[c]) * inv_std[c];
     }
   }
-  return true;
 }
 
-// Raw-u8 variant (DATA.DEVICE_NORMALIZE): same decode/resample/flip, no
-// normalize — the trainer does (x/255 - mean)/std in-graph on device, so
-// the host ships 4× fewer bytes (uint8 vs float32 over PCIe/tunnel).
-bool load_one_u8(const char* path, const Geom& g, int out_w, int out_h,
-                 uint8_t* out) {
-  std::vector<uint8_t> res;
-  if (!load_resampled(path, g, out_w, out_h, &res)) return false;
+void finish_one_u8(const std::vector<uint8_t>& res, const Geom& g, int out_w,
+                   int out_h, uint8_t* out) {
   for (int y = 0; y < out_h; ++y) {
     const uint8_t* srow = res.data() + static_cast<size_t>(y) * out_w * 3;
     uint8_t* drow = out + static_cast<size_t>(y) * out_w * 3;
@@ -385,6 +390,43 @@ bool load_one_u8(const char* path, const Geom& g, int out_w, int out_h,
       q[2] = p[2];
     }
   }
+}
+
+// Load path → decode → resample → (flip) → normalize into out[HWC].
+bool load_one(const char* path, const Geom& g, int out_w, int out_h,
+              const float* mean, const float* stdv, float* out) {
+  std::vector<uint8_t> res;
+  if (!load_resampled(path, g, out_w, out_h, &res)) return false;
+  finish_one(res, g, out_w, out_h, mean, stdv, out);
+  return true;
+}
+
+// Raw-u8 variant (DATA.DEVICE_NORMALIZE): same decode/resample/flip, no
+// normalize — the trainer does (x/255 - mean)/std in-graph on device, so
+// the host ships 4× fewer bytes (uint8 vs float32 over PCIe/tunnel).
+bool load_one_u8(const char* path, const Geom& g, int out_w, int out_h,
+                 uint8_t* out) {
+  std::vector<uint8_t> res;
+  if (!load_resampled(path, g, out_w, out_h, &res)) return false;
+  finish_one_u8(res, g, out_w, out_h, out);
+  return true;
+}
+
+// Memory-buffer variants (shard records).
+bool load_one_mem(const uint8_t* data, int64_t len, const Geom& g, int out_w,
+                  int out_h, const float* mean, const float* stdv,
+                  float* out) {
+  std::vector<uint8_t> res;
+  if (!load_resampled_mem(data, len, g, out_w, out_h, &res)) return false;
+  finish_one(res, g, out_w, out_h, mean, stdv, out);
+  return true;
+}
+
+bool load_one_u8_mem(const uint8_t* data, int64_t len, const Geom& g,
+                     int out_w, int out_h, uint8_t* out) {
+  std::vector<uint8_t> res;
+  if (!load_resampled_mem(data, len, g, out_w, out_h, &res)) return false;
+  finish_one_u8(res, g, out_w, out_h, out);
   return true;
 }
 
@@ -397,7 +439,7 @@ bool load_one_u8(const char* path, const Geom& g, int out_w, int out_h,
 extern "C" {
 
 // ABI version — bump when struct layouts change; Python checks it.
-int dtpu_abi_version() { return 3; }
+int dtpu_abi_version() { return 4; }
 
 // Header-only dims probe. Returns 0 on success. Reads a bounded prefix
 // (enough for any realistic SOF/IHDR placement); retries with the full file
@@ -470,6 +512,81 @@ void dtpu_load_batch_u8(const char** paths, const void* geoms, int32_t n,
       if (i >= n) return;
       bool ok = load_one_u8(paths[i], gs[i], out_w, out_h,
                             out + img_elems * i);
+      statuses[i] = ok ? 0 : 1;
+    }
+  };
+  int nt = std::max(1, std::min<int>(n_threads, n));
+  if (nt == 1) {
+    worker();
+    return;
+  }
+  std::vector<std::thread> threads;
+  threads.reserve(nt);
+  for (int t = 0; t < nt; ++t) threads.emplace_back(worker);
+  for (auto& th : threads) th.join();
+}
+
+// Header-only dims probe over an in-memory buffer (shard records).
+int dtpu_mem_dims(const uint8_t* data, int64_t len, int32_t* w, int32_t* h) {
+  if (data == nullptr || len <= 0) return 1;
+  int iw = 0, ih = 0;
+  bool ok = false;
+  const size_t n = static_cast<size_t>(len);
+  if (is_jpeg(data, n))
+    ok = jpeg_dims(data, n, &iw, &ih);
+  else if (is_png(data, n))
+    ok = png_dims(data, n, &iw, &ih);
+  else
+    return 2;  // unknown magic
+  if (!ok) return 2;
+  *w = iw;
+  *h = ih;
+  return 0;
+}
+
+// Batch decode+transform from in-memory encoded buffers (shard records):
+// same contract as dtpu_load_batch, but inputs are (pointer, length) pairs
+// instead of paths — no per-image filesystem round-trip.
+void dtpu_load_batch_mem(const uint8_t** bufs, const int64_t* lens,
+                         const void* geoms, int32_t n, int32_t out_w,
+                         int32_t out_h, const float* mean, const float* stdv,
+                         int32_t n_threads, float* out, int32_t* statuses) {
+  const Geom* gs = static_cast<const Geom*>(geoms);
+  const size_t img_elems = static_cast<size_t>(out_h) * out_w * 3;
+  std::atomic<int32_t> next(0);
+  auto worker = [&]() {
+    for (;;) {
+      const int32_t i = next.fetch_add(1);
+      if (i >= n) return;
+      bool ok = load_one_mem(bufs[i], lens[i], gs[i], out_w, out_h, mean,
+                             stdv, out + img_elems * i);
+      statuses[i] = ok ? 0 : 1;
+    }
+  };
+  int nt = std::max(1, std::min<int>(n_threads, n));
+  if (nt == 1) {
+    worker();
+    return;
+  }
+  std::vector<std::thread> threads;
+  threads.reserve(nt);
+  for (int t = 0; t < nt; ++t) threads.emplace_back(worker);
+  for (auto& th : threads) th.join();
+}
+
+void dtpu_load_batch_u8_mem(const uint8_t** bufs, const int64_t* lens,
+                            const void* geoms, int32_t n, int32_t out_w,
+                            int32_t out_h, int32_t n_threads, uint8_t* out,
+                            int32_t* statuses) {
+  const Geom* gs = static_cast<const Geom*>(geoms);
+  const size_t img_elems = static_cast<size_t>(out_h) * out_w * 3;
+  std::atomic<int32_t> next(0);
+  auto worker = [&]() {
+    for (;;) {
+      const int32_t i = next.fetch_add(1);
+      if (i >= n) return;
+      bool ok = load_one_u8_mem(bufs[i], lens[i], gs[i], out_w, out_h,
+                                out + img_elems * i);
       statuses[i] = ok ? 0 : 1;
     }
   };

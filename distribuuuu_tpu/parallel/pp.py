@@ -34,6 +34,40 @@ from jax.sharding import NamedSharding, PartitionSpec as P
 from distribuuuu_tpu.parallel.compat import axis_size, shard_map
 
 
+_logged_schedules: set[tuple[int, int]] = set()
+
+
+def log_bubble_fraction(S: int, M: int) -> None:
+    """Record the statically-known GPipe bubble at step-build (trace) time:
+    of the T = M + S - 1 schedule ticks, S - 1 are fill/drain — every stage
+    idles for exactly that fraction of the step regardless of how fast the
+    hardware runs. Emitted once per distinct (S, M) as a kind="pp_bubble"
+    jsonlog record plus a rank-0 log line, so an operator sees the
+    schedule-inherent ceiling next to the measured step time instead of
+    hunting it in a trace (PERF.md "Pipeline bubble accounting")."""
+    key = (int(S), int(M))
+    if key in _logged_schedules:
+        return
+    _logged_schedules.add(key)
+    T = M + S - 1
+    bubble = (S - 1) / T
+    from distribuuuu_tpu.utils.jsonlog import metrics_log
+
+    metrics_log(
+        "pp_bubble", stages=int(S), microbatches=int(M), ticks=int(T),
+        bubble=round(bubble, 4),
+    )
+    if jax.process_index() == 0:
+        from distribuuuu_tpu.utils.logger import get_logger
+
+        get_logger().info(
+            "PP schedule: %d stages × %d microbatches = %d ticks; "
+            "statically-known bubble fraction (S-1)/(M+S-1) = %.3f "
+            "(raise MESH.MICROBATCH to amortize fill/drain)",
+            S, M, T, bubble,
+        )
+
+
 def stack_stage_params(param_list):
     """Stack per-stage param pytrees (same structure) into one pytree with a
     leading stage dim — shard that dim over ``pipe``."""
@@ -83,6 +117,7 @@ def pipeline_apply(
     s = jax.lax.axis_index(axis)
     M = microbatches.shape[0]
     T = M + S - 1
+    log_bubble_fraction(S, M)  # static schedule cost, once per (S, M)
     my_params = jax.tree.map(lambda x: x[0], stacked_params)
     mb_shape = microbatches.shape[1:]
 

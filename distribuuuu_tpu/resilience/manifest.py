@@ -43,7 +43,9 @@ MANIFEST_NAME = "MANIFEST.json"
 MANIFEST_SCHEMA = 1
 
 
-def _sha256_file(path: str, chunk: int = 1 << 20) -> str:
+def sha256_file(path: str, chunk: int = 1 << 20) -> str:
+    """Streaming file digest — shared by checkpoint manifests and the
+    shard-dataset manifests (data/shards/format.py)."""
     h = hashlib.sha256()
     with open(path, "rb") as f:
         while True:
@@ -52,6 +54,9 @@ def _sha256_file(path: str, chunk: int = 1 << 20) -> str:
                 break
             h.update(b)
     return h.hexdigest()
+
+
+_sha256_file = sha256_file  # internal call sites / tests predate the alias
 
 
 def config_fingerprint() -> str:

@@ -591,15 +591,30 @@ def train_epoch(loader, mesh, state, train_step, epoch: int, logger,
     granularity (rounded up to the fold size); the profiler window rounds to
     call boundaries.
 
-    Returns ``(state, interrupted)``: with ``TRAIN.PREEMPT_SAVE`` on, a
-    SIGTERM (utils/preempt.py) ends the epoch at the next dispatch
-    boundary with ``interrupted=True`` so the caller can write the
-    mid-epoch checkpoint.
+    Returns ``(state, interrupted, batches_done)``: with
+    ``TRAIN.PREEMPT_SAVE`` on, a SIGTERM (utils/preempt.py) ends the epoch
+    at the next dispatch boundary with ``interrupted=True`` so the caller
+    can write the mid-epoch checkpoint; ``batches_done`` is the absolute
+    batch cursor (counting any resume-skipped prefix), which the shards
+    pipeline persists for exact mid-epoch resume.
+
+    When the loader was armed by ``load_state_dict`` (a restored shards
+    cursor for THIS epoch), iteration skips the already-trained prefix —
+    the epoch continues at the exact next batch instead of re-running.
     """
     lr = get_epoch_lr(epoch)
     set_lr(state.opt_state, lr)  # epoch-granular LR (ref: trainer.py:25-26)
     loader.set_epoch(epoch)  # reshuffle shards (ref: trainer.py:33)
     num_batches = len(loader)
+    # exact mid-epoch resume (DATA.FORMAT=shards): batches [0, start) were
+    # consumed and trained by the preempted run — continue, don't re-run
+    start_batch = getattr(loader, "resume_skip", lambda e: 0)(epoch)
+    if start_batch and mesh_lib.is_primary():
+        logger.info(
+            "exact mid-epoch resume: continuing epoch %d at batch %d/%d "
+            "(restored global cursor)",
+            epoch + 1, start_batch + 1, num_batches,
+        )
     watch_preemption = cfg.TRAIN.PREEMPT_SAVE
     interrupted = False
     # multi-host: the cross-host flag agreement is a blocking collective,
@@ -626,7 +641,7 @@ def train_epoch(loader, mesh, state, train_step, epoch: int, logger,
     prof = _ProfilerWindow(epoch, first_epoch)
     pending = []  # (n_steps, device metrics) awaiting async fetch
     n_buffered = 0  # fold slots filled since the last dispatch
-    done = 0  # batches whose step has been dispatched
+    done = start_batch  # absolute batches dispatched (incl. skipped prefix)
 
     # dispatch-MoE only: fraction of routed assignments lost to capacity
     moe_dropped = AverageMeter("MoEDrop", ":.4f")
@@ -733,11 +748,13 @@ def train_epoch(loader, mesh, state, train_step, epoch: int, logger,
             end = time.perf_counter()
             win_start = end  # start of the current fold window (incl. buffering)
             for it, host_batch in enumerate(loader):
-                heartbeat.beat(f"epoch {epoch + 1} batch {it}")
-                faults.maybe_stall(epoch, it)  # injection no-ops (FAULTS.*)
-                faults.maybe_kill(epoch, it)
+                abs_it = start_batch + it  # loader skipped the resumed prefix
+                heartbeat.beat(f"epoch {epoch + 1} batch {abs_it}")
+                faults.maybe_stall(epoch, abs_it)  # injection no-ops (FAULTS.*)
+                faults.maybe_kill(epoch, abs_it)
+                faults.maybe_preempt(epoch, abs_it)
                 data_time.update(time.perf_counter() - end)
-                is_last = it + 1 == num_batches
+                is_last = abs_it + 1 == num_batches
                 # copy into the preallocated fold slot NOW (spreads the host
                 # memcpy across the fold window, overlapped with the device
                 # executing the previous call) instead of np.stack-ing the
@@ -805,28 +822,32 @@ def train_epoch(loader, mesh, state, train_step, epoch: int, logger,
             depth = max(0, cfg.TRAIN.PREFETCH_DEVICE)
             end = time.perf_counter()
             for it, batch, tl in device_prefetch(loader, put_batch, depth):
-                heartbeat.beat(f"epoch {epoch + 1} batch {it}")
-                faults.maybe_stall(epoch, it)  # injection no-ops (FAULTS.*)
-                faults.maybe_kill(epoch, it)
+                abs_it = start_batch + it  # loader skipped the resumed prefix
+                heartbeat.beat(f"epoch {epoch + 1} batch {abs_it}")
+                faults.maybe_stall(epoch, abs_it)  # injection no-ops (FAULTS.*)
+                faults.maybe_kill(epoch, abs_it)
+                faults.maybe_preempt(epoch, abs_it)
                 data_time.update(tl["get1"] - tl["get0"])
-                prof.begin(it)
+                prof.begin(abs_it)
                 tl["step0"] = time.perf_counter()
                 state, metrics = train_step(state, batch)
                 tl["step1"] = time.perf_counter()
-                prof.end(it, state)
+                prof.end(abs_it, state)
                 pending.append((1, metrics))
                 done += 1
                 batch_time.update(time.perf_counter() - end)
                 end = time.perf_counter()
                 if emit_timeline:
-                    timeline_log("train", epoch + 1, it, tl.pop("n", 0), **tl)
+                    timeline_log(
+                        "train", epoch + 1, abs_it, tl.pop("n", 0), **tl
+                    )
                 maybe_print()
-                if preempt_break(it + 1):
+                if preempt_break(done):
                     break
         prof.finish(state)
     finally:
         heartbeat.stop()
-    return state, interrupted
+    return state, interrupted, done
 
 
 def validate(loader, mesh, state, eval_step, epoch: int, logger):
@@ -1001,7 +1022,7 @@ def _with_restored_weights(state: TrainState, path: str, model) -> TrainState:
 
 def _resume(
     state: TrainState, mesh
-) -> tuple[TrainState, int, float, int | None]:
+) -> tuple[TrainState, int, float, int | None, dict | None]:
     """Auto-resume from the last INTACT checkpoint (ref: trainer.py:143-149,
     hardened): candidates are manifest-verified newest-first, corrupt or
     partial saves are quarantined to ``*.corrupt`` and walked past
@@ -1055,6 +1076,10 @@ def _resume(
     best_acc1 = float(restored.get("best_acc1", 0.0))
     pending = restored.get("pending_eval")
     pending_eval = None if pending is None else int(pending)
+    # shards exact-resume cursor (save_preempt_checkpoint embedded the
+    # loader's state_dict); None on epoch-boundary saves / older formats
+    ds_arr = restored.get("data_state")
+    data_state = None if ds_arr is None else ckpt.decode_data_state(ds_arr)
     logger.info("resumed from %s (epoch %d)", path, start_epoch)
     return (
         TrainState(
@@ -1067,6 +1092,7 @@ def _resume(
         start_epoch,
         best_acc1,
         pending_eval,
+        data_state,
     )
 
 
@@ -1145,6 +1171,37 @@ def check_batch_geometry(mesh, eval_only: bool = False):
     return global_micro
 
 
+def _arm_exact_resume(train_loader, data_state, start_epoch: int, logger):
+    """Hand a restored shards cursor (``_resume``'s ``data_state``) to the
+    loader so epoch ``start_epoch`` CONTINUES at the exact next batch. Any
+    mismatch (format/corpus/shuffle-identity/epoch drift) degrades to the
+    epoch-granular resume with a warning — exactness is best-effort, the
+    resume itself never fails on a cursor."""
+    if data_state is None:
+        return
+    if int(data_state.get("epoch", -1)) != start_epoch:
+        logger.warning(
+            "saved data cursor is for epoch %s but resume starts at epoch "
+            "%d — re-running from batch 0",
+            data_state.get("epoch"), start_epoch,
+        )
+        return
+    try:
+        skip = train_loader.load_state_dict(data_state)
+    except ValueError as e:
+        logger.warning(
+            "mid-epoch data cursor not restored (%s) — re-running epoch %d "
+            "from batch 0", e, start_epoch + 1,
+        )
+        return
+    if mesh_lib.is_primary():
+        logger.info(
+            "restored shards data cursor: epoch %d resumes after %d "
+            "batches (global sample cursor %d)",
+            start_epoch + 1, skip, int(data_state.get("cursor", -1)),
+        )
+
+
 def train_model():
     """End-to-end training (ref: trainer.py:106-173)."""
     mesh_lib.apply_backend_flags(cfg.DEVICE.DETERMINISTIC or cfg.CUDNN.DETERMINISTIC)
@@ -1189,8 +1246,11 @@ def train_model():
     resumed = False
     if cfg.TRAIN.AUTO_RESUME and ckpt.has_checkpoint():
         try:
-            state, start_epoch, best_acc1, pending_eval = _resume(state, mesh)
+            state, start_epoch, best_acc1, pending_eval, data_state = _resume(
+                state, mesh
+            )
             resumed = True
+            _arm_exact_resume(train_loader, data_state, start_epoch, logger)
         except ckpt.NoValidCheckpointError as e:
             # every checkpoint on disk failed verification (all quarantined
             # to *.corrupt) — recover by starting over rather than crashing
@@ -1277,7 +1337,7 @@ def train_model():
     rollbacks_left = max(0, int(cfg.TRAIN.MAX_ROLLBACKS))
     while epoch < cfg.OPTIM.MAX_EPOCH:
         try:
-            state, interrupted = train_epoch(
+            state, interrupted, batches_done = train_epoch(
                 loader=train_loader, mesh=mesh, state=state,
                 train_step=train_step, epoch=epoch, logger=logger,
                 first_epoch=start_epoch, scan_step=scan_step)
@@ -1308,11 +1368,13 @@ def train_model():
                 "the last intact checkpoint (%d attempt(s) left)",
                 e.epoch + 1, e.batch, rollbacks_left,
             )
-            state, epoch, best_acc1, rb_pending = _resume(state, mesh)
+            state, epoch, best_acc1, rb_pending, rb_ds = _resume(state, mesh)
             # the pre-epoch state's buffers were DONATED to the step calls
             # (donate_argnums=0) — its key is deleted; re-attach the live
             # base key (the value is seed-derived, identical by definition)
             state = state.replace(key=key)
+            # rolling back onto a preempt save: honor its data cursor too
+            _arm_exact_resume(train_loader, rb_ds, epoch, logger)
             if rb_pending is not None:
                 # rolled back onto an eval-pending preempt save: finish
                 # that epoch's validation first, as a fresh start would
@@ -1325,9 +1387,16 @@ def train_model():
         if interrupted:
             # mid-epoch preemption: persist now; the next run's AUTO_RESUME
             # prefers this checkpoint and re-runs this epoch from it
-            # (utils/preempt.py has the full story)
+            # (utils/preempt.py has the full story). The shards pipeline
+            # additionally embeds the loader's exact global cursor, so the
+            # re-run CONTINUES at batch `batches_done` instead of batch 0.
+            data_state = (
+                train_loader.state_dict(batches_done)
+                if train_loader.can_save_state()
+                else None
+            )
             path = ckpt.save_preempt_checkpoint(
-                _state_tree(state), epoch, best_acc1
+                _state_tree(state), epoch, best_acc1, data_state=data_state
             )
             return _preempt_exit(path, epoch)
         if watching and preempt.requested_global():

@@ -274,9 +274,33 @@ def save_checkpoint(state_tree: dict, epoch: int, best_acc1: float, is_best: boo
     return path
 
 
+def encode_data_state(data_state: dict) -> np.ndarray:
+    """Loader iterator state (``data/loader.Loader.state_dict`` — a
+    JSON-able dict: epoch, global sample cursor, shuffle-order identity)
+    as a uint8 array, so it rides the orbax pytree payload like any other
+    leaf. The big-int shuffle-RNG state rules out a numeric pytree."""
+    import json
+
+    return np.frombuffer(
+        json.dumps(data_state, sort_keys=True).encode(), np.uint8
+    ).copy()
+
+
+def decode_data_state(arr) -> dict | None:
+    """Inverse of ``encode_data_state``; None on anything unreadable (a
+    damaged cursor only costs the mid-epoch exactness, never the resume)."""
+    import json
+
+    try:
+        return json.loads(np.asarray(arr, np.uint8).tobytes().decode())
+    except (ValueError, UnicodeDecodeError):
+        return None
+
+
 def save_preempt_checkpoint(
     state_tree: dict, epoch: int, best_acc1: float,
     pending_eval: int | None = None,
+    data_state: dict | None = None,
 ):
     """Mid-epoch checkpoint on preemption (utils/preempt.py).
 
@@ -285,16 +309,20 @@ def save_preempt_checkpoint(
     from this (strictly newer) params/optimizer state. ``pending_eval``
     marks a COMPLETED epoch whose validation was preempted — the resume
     path validates it and writes its real epoch checkpoint before
-    continuing. Same collective save protocol as ``save_checkpoint``.
+    continuing. ``data_state`` (shards pipeline, ``Loader.state_dict``)
+    embeds the exact global sample cursor: the resumed epoch then
+    CONTINUES at the next batch instead of re-running from batch 0 —
+    trajectory-equivalent to the uninterrupted run. Same collective save
+    protocol as ``save_checkpoint``.
     """
-    extra = (
-        {"pending_eval": np.int32(pending_eval)}
-        if pending_eval is not None
-        else None
-    )
+    extra = {}
+    if pending_eval is not None:
+        extra["pending_eval"] = np.int32(pending_eval)
+    if data_state is not None:
+        extra["data_state"] = encode_data_state(data_state)
     return _save_full(
         os.path.join(get_checkpoint_dir(), f"{_PREEMPT_PREFIX}{epoch:03d}"),
-        state_tree, epoch - 1, best_acc1, extra,
+        state_tree, epoch - 1, best_acc1, extra or None,
     )
 
 

@@ -21,7 +21,14 @@ survive into a reproducible, config-driven event, so tests and
                          SIGKILL this process at a batch boundary (no
                          handler can run: the hard-crash case);
   stalled step           ``FAULTS.STALL_EPOCH/STALL_AT_BATCH/STALL_S`` —
-                         sleep mid-loop so the heartbeat watchdog flags.
+                         sleep mid-loop so the heartbeat watchdog flags;
+  preemption             ``FAULTS.PREEMPT_EPOCH/PREEMPT_AT_BATCH`` —
+                         self-deliver SIGTERM at a batch boundary through
+                         the real handler chain (the scheduler-preemption
+                         case: mid-epoch save with the shards data cursor);
+  truncated shard        ``FAULTS.TRUNCATE_SHARD`` — cut a record shard
+                         (DATA.FORMAT=shards) to 60% before the reader
+                         opens it: index-footer recovery + record skips.
 
 Every hook is a no-op (one attribute read) unless ``FAULTS.ENABLED`` —
 zero overhead in production paths.
@@ -37,7 +44,8 @@ from distribuuuu_tpu.config import cfg
 
 __all__ = [
     "InjectedFault", "enabled", "nan_injection_step", "maybe_decode_error",
-    "maybe_kill", "maybe_stall", "maybe_corrupt_checkpoint", "reset",
+    "maybe_kill", "maybe_stall", "maybe_corrupt_checkpoint",
+    "maybe_preempt", "maybe_truncate_shard", "reset",
 ]
 
 
@@ -45,12 +53,15 @@ class InjectedFault(RuntimeError):
     """An injected failure — distinguishable from organic errors in logs."""
 
 
-_state: dict = {"decode_raised": set()}
+_state: dict = {"decode_raised": set(), "preempted": False,
+                "truncated_shards": set()}
 
 
 def reset() -> None:
     """Clear once-mode bookkeeping (tests)."""
     _state["decode_raised"] = set()
+    _state["preempted"] = False
+    _state["truncated_shards"] = set()
 
 
 def enabled() -> bool:
@@ -95,6 +106,49 @@ def maybe_kill(epoch: int, batch: int) -> None:
         and batch == int(cfg.FAULTS.KILL_AT_BATCH)
     ):
         os.kill(os.getpid(), signal.SIGKILL)
+
+
+def maybe_preempt(epoch: int, batch: int) -> None:
+    """Self-deliver SIGTERM at the configured (epoch, batch) boundary —
+    a deterministic scheduler preemption. Goes through the REAL installed
+    handler chain (utils/preempt.py), so the epoch loop exits at the next
+    boundary and writes the mid-epoch checkpoint exactly as it would for
+    a fleet SIGTERM. One-shot per process."""
+    if not enabled() or cfg.FAULTS.PREEMPT_AT_BATCH < 0 or _state["preempted"]:
+        return
+    if (
+        epoch == int(cfg.FAULTS.PREEMPT_EPOCH)
+        and batch == int(cfg.FAULTS.PREEMPT_AT_BATCH)
+    ):
+        _state["preempted"] = True
+        os.kill(os.getpid(), signal.SIGTERM)
+
+
+def maybe_truncate_shard(split_dir: str) -> None:
+    """Truncate shard file #``FAULTS.TRUNCATE_SHARD`` of the split to 60%
+    of its manifest size — destroying its index footer and tail records —
+    BEFORE the reader opens it. Exercises the reader's forward-scan index
+    recovery plus the loader's DATA.SKIP_CORRUPT substitution for the
+    physically lost records. Idempotent per (process, split)."""
+    if not enabled() or cfg.FAULTS.TRUNCATE_SHARD < 0:
+        return
+    if split_dir in _state["truncated_shards"]:
+        return
+    _state["truncated_shards"].add(split_dir)
+    import json
+
+    from distribuuuu_tpu.data.shards.format import MANIFEST_NAME
+
+    try:
+        with open(os.path.join(split_dir, MANIFEST_NAME)) as f:
+            man = json.load(f)
+        meta = man["shards"][int(cfg.FAULTS.TRUNCATE_SHARD)]
+    except (OSError, json.JSONDecodeError, IndexError, KeyError):
+        return  # nothing to damage — the reader will complain on its own
+    path = os.path.join(split_dir, meta["file"])
+    if os.path.isfile(path) and os.path.getsize(path) == meta["size"]:
+        with open(path, "r+b") as f:
+            f.truncate(max(1, int(meta["size"]) * 6 // 10))
 
 
 def maybe_stall(epoch: int, batch: int) -> None:

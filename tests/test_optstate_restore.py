@@ -67,7 +67,7 @@ def test_resume_restores_momentum_exactly(tmp_path, kind):
 
     # fresh process-equivalent: new state, then the REAL resume path
     fresh = trainer.create_train_state(model, jax.random.key(1), mesh, 32)
-    resumed, start_epoch, best_acc1, pending = trainer._resume(fresh, mesh)
+    resumed, start_epoch, best_acc1, pending, _ = trainer._resume(fresh, mesh)
     assert start_epoch == 1 and best_acc1 == 11.0 and pending is None
     assert int(resumed.step) == 1
     # the optax container structure survived (namedtuples, not dicts)
@@ -90,7 +90,7 @@ def test_resume_mismatched_optimizer_falls_back_gracefully(tmp_path):
     cfg.OPTIM.OPTIMIZER = "adamw"
     model2 = trainer.build_model_from_cfg()
     fresh = trainer.create_train_state(model2, jax.random.key(1), mesh, 32)
-    resumed, start_epoch, _, _ = trainer._resume(fresh, mesh)
+    resumed, start_epoch, _, _, _ = trainer._resume(fresh, mesh)
     assert start_epoch == 1
     # params came from the checkpoint…
     np.testing.assert_array_equal(

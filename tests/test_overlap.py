@@ -180,7 +180,7 @@ def test_prefetch_ring_bit_identical_and_timeline(tmp_path):
             DummyDataset(length=24, size=16), batch_size=8, shuffle=True,
             drop_last=True, workers=2,
         )
-        state, interrupted = trainer.train_epoch(
+        state, interrupted, _ = trainer.train_epoch(
             loader=loader, mesh=mesh, state=state, train_step=step,
             epoch=0, logger=get_logger(),
         )

@@ -509,7 +509,7 @@ def test_elastic_resume_dp4_to_dp2_and_dp8(tmp_path, zero_stage):
 
     for dp in (2, 8):
         mesh_n, _, fresh, step_n = _elastic_setup(tmp_path / "run", dp, zero_stage)
-        resumed, start_epoch, _, _ = trainer._resume(fresh, mesh_n)
+        resumed, start_epoch, _, _, _ = trainer._resume(fresh, mesh_n)
         assert start_epoch == 1 and int(resumed.step) == 4
         # no silent weights-only fallback: momenta must equal the saved ones
         saved_mom = [

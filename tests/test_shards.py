@@ -1,0 +1,430 @@
+"""Sharded dataset subsystem (data/shards/): format round-trip, the
+topology-independent order, truncation recovery, and exact mid-epoch
+resume — the PR 4 acceptance gates.
+
+Pinned invariants:
+  - pack→read round-trips are BYTE-identical to the source imagefolder
+    (stored bytes verbatim; decoded+augmented arrays equal bit-for-bit);
+  - the global sample order is a function of (seed, epoch) alone —
+    interleaving the per-rank streams of dp∈{1,2,4} reproduces the same
+    global order bit-identically;
+  - a truncated shard (footer gone) recovers its index by forward scan
+    and the lost records flow through DATA.SKIP_CORRUPT instead of
+    killing the epoch;
+  - mid-epoch save/restore through the REAL preempt-checkpoint path
+    continues at the exact next batch and lands on the uninterrupted
+    run's trajectory.
+"""
+
+import json
+import os
+import subprocess
+import sys
+
+import jax
+import numpy as np
+import pytest
+from PIL import Image
+
+import distribuuuu_tpu.config as config
+from distribuuuu_tpu.config import cfg
+from distribuuuu_tpu.data.loader import Loader
+from distribuuuu_tpu.data.shards import (
+    ShardDataset,
+    ShardReadError,
+    WindowShuffleSampler,
+    global_order,
+    pack_imagefolder,
+    read_shard_index,
+    read_shard_manifest,
+    verify_split,
+)
+from distribuuuu_tpu.utils import faults, preempt
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.fixture(autouse=True)
+def _clean_faults():
+    faults.reset()
+    preempt.reset()
+    yield
+    faults.reset()
+    preempt.reset()
+
+
+@pytest.fixture(scope="module")
+def corpus(tmp_path_factory):
+    """Small imagefolder + packed shards (multiple shards per split)."""
+    root = tmp_path_factory.mktemp("shards_corpus")
+    src = root / "src"
+    rng = np.random.default_rng(0)
+    for split, per_cls in (("train", 16), ("val", 4)):
+        for cls in ("class_a", "class_b", "class_c"):
+            d = src / split / cls
+            d.mkdir(parents=True)
+            for i in range(per_cls):
+                arr = rng.integers(0, 255, (40, 50, 3)).astype(np.uint8)
+                Image.fromarray(arr).save(d / f"img_{i}.jpg", quality=90)
+    out = root / "shards"
+    pack_imagefolder(str(src), str(out), target_bytes=16 * 1024)
+    return {"src": str(src), "shards": str(out)}
+
+
+# ------------------------------------------------------------------- format
+def test_pack_roundtrip_byte_identical(corpus):
+    from distribuuuu_tpu.data.imagefolder import ImageFolderDataset
+
+    ds = ShardDataset(corpus["shards"], "train", im_size=32, train=True,
+                      base_seed=3, backend="pil")
+    ifd = ImageFolderDataset(corpus["src"], "train", im_size=32, train=True,
+                             base_seed=3, backend="pil")
+    assert len(ds) == len(ifd) == 48
+    assert ds.classes == ifd.classes
+    man = read_shard_manifest(os.path.join(corpus["shards"], "train"))
+    assert len(man["shards"]) > 1  # the tiny target really rolled shards
+    for i in (0, 7, 23, 47):
+        image_bytes, label, key = ds.record(i)
+        path, src_label = ifd.samples[i]
+        with open(path, "rb") as f:
+            assert image_bytes == f.read()  # encoded bytes verbatim
+        assert label == src_label
+        assert key == os.path.relpath(path, os.path.join(corpus["src"], "train"))
+    # decoded + augmented arrays are bit-identical (same PIL ops, same
+    # (seed, epoch, idx) RNG stream)
+    ds.set_epoch_seed(2)
+    ifd.set_epoch_seed(2)
+    for i in (0, 23, 47):
+        a, la = ds[i]
+        b, lb = ifd[i]
+        np.testing.assert_array_equal(a, b)
+        assert la == lb
+
+
+def test_verify_split_certifies_and_catches_corruption(corpus, tmp_path):
+    import shutil
+
+    ok, problems = verify_split(os.path.join(corpus["shards"], "val"))
+    assert ok, problems
+    # flip one byte in a shard → sha256 mismatch names the shard
+    work = tmp_path / "val"
+    shutil.copytree(os.path.join(corpus["shards"], "val"), work)
+    man = read_shard_manifest(str(work))
+    victim = work / man["shards"][0]["file"]
+    data = bytearray(victim.read_bytes())
+    data[len(data) // 2] ^= 0xFF
+    victim.write_bytes(bytes(data))
+    ok, problems = verify_split(str(work))
+    assert not ok
+    assert any(man["shards"][0]["file"] in p for p in problems), problems
+
+
+def test_make_shards_cli_pack_and_verify(corpus, tmp_path):
+    out = tmp_path / "cli_shards"
+    r = subprocess.run(
+        [sys.executable, "tools/make_shards.py", "--src", corpus["src"],
+         "--out", str(out), "--splits", "val", "--shard-mb", "0.02"],
+        capture_output=True, text=True, cwd=REPO, timeout=300,
+    )
+    assert r.returncode == 0, r.stdout + r.stderr
+    r = subprocess.run(
+        [sys.executable, "tools/make_shards.py", "--out", str(out),
+         "--verify", "--splits", "val"],
+        capture_output=True, text=True, cwd=REPO, timeout=300,
+    )
+    assert r.returncode == 0, r.stdout + r.stderr
+    assert json.loads(r.stdout.strip().splitlines()[-1])["ok"] is True
+
+
+def test_native_batch_matches_imagefolder_native(corpus):
+    from distribuuuu_tpu import native
+    from distribuuuu_tpu.data.imagefolder import ImageFolderDataset
+
+    if not (native.available() and native.has_mem_api()):
+        pytest.skip(f"native kernel unavailable: {native.build_error()}")
+    ds = ShardDataset(corpus["shards"], "train", im_size=32, train=True,
+                      base_seed=3)
+    ifd = ImageFolderDataset(corpus["src"], "train", im_size=32, train=True,
+                             base_seed=3)
+    ds.set_epoch_seed(1)
+    ifd.set_epoch_seed(1)
+    idxs = [0, 5, 17, 46]
+    imgs, labels = ds.load_batch(idxs, n_threads=2)
+    ref, ref_labels = ifd.load_batch(idxs, n_threads=2)
+    # same kernel, same geometry draws, byte-identical inputs → identical
+    np.testing.assert_array_equal(imgs, ref)
+    np.testing.assert_array_equal(labels, ref_labels)
+
+
+# -------------------------------------------------------------------- order
+def test_global_order_is_seed_epoch_permutation():
+    o = global_order(100, seed=7, epoch=3, block=8, window=16)
+    assert sorted(o.tolist()) == list(range(100))
+    np.testing.assert_array_equal(
+        o, global_order(100, seed=7, epoch=3, block=8, window=16)
+    )
+    assert not np.array_equal(
+        o, global_order(100, seed=7, epoch=4, block=8, window=16)
+    )
+    assert not np.array_equal(
+        o, global_order(100, seed=8, epoch=3, block=8, window=16)
+    )
+    # degenerate knobs reduce to a plain uniform permutation domain
+    tiny = global_order(5, seed=0, epoch=0, block=1, window=5)
+    assert sorted(tiny.tolist()) == list(range(5))
+
+
+def test_global_order_identical_across_dp_1_2_4():
+    """The acceptance gate: interleaving the per-rank streams of any world
+    size reproduces the SAME global order — dp=1/2/4 see one stream."""
+    n, seed, epoch = 96, 11, 2
+    ref = global_order(n, seed, epoch, block=8, window=32)
+    for world in (1, 2, 4):
+        samplers = [
+            WindowShuffleSampler(n, world, r, seed=seed, block=8, window=32)
+            for r in range(world)
+        ]
+        inter = np.empty((n,), np.int64)
+        for r, s in enumerate(samplers):
+            s.set_epoch(epoch)
+            inter[r::world] = s.indices()
+        np.testing.assert_array_equal(inter, ref)
+
+
+def test_order_state_identity():
+    s = WindowShuffleSampler(48, 1, 0, seed=5, block=4, window=8)
+    s.set_epoch(3)
+    st = s.order_state()
+    assert st["epoch"] == 3 and st["seed"] == 5
+    # JSON round-trip clean (it rides the preempt checkpoint as JSON)
+    assert json.loads(json.dumps(st)) == json.loads(json.dumps(st))
+    s2 = WindowShuffleSampler(48, 4, 2, seed=5, block=4, window=8)
+    s2.set_epoch(3)
+    assert json.loads(json.dumps(s2.order_state())) == json.loads(json.dumps(st))
+
+
+# ------------------------------------------------------- truncation recovery
+def _truncated_copy(corpus, tmp_path):
+    import shutil
+
+    work = tmp_path / "trunc"
+    shutil.copytree(os.path.join(corpus["shards"], "train"), work / "train")
+    man = read_shard_manifest(str(work / "train"))
+    victim = work / "train" / man["shards"][-1]["file"]
+    size = victim.stat().st_size
+    with open(victim, "r+b") as f:
+        f.truncate(size * 6 // 10)
+    return str(work), man
+
+
+def test_truncated_shard_recovers_index_and_skips_lost_records(
+    corpus, tmp_path
+):
+    work, man = _truncated_copy(corpus, tmp_path)
+    victim = os.path.join(work, "train", man["shards"][-1]["file"])
+    offsets, recovered = read_shard_index(victim)
+    assert recovered and 0 < len(offsets) < man["shards"][-1]["records"]
+
+    ds = ShardDataset(work, "train", im_size=16, train=True, backend="pil")
+    assert len(ds) == man["num_records"]  # manifest length is authoritative
+    ds[0]  # early records decode fine
+    with pytest.raises(ShardReadError, match="lost to truncation"):
+        ds[len(ds) - 1]
+
+    # the loader's SKIP_CORRUPT path substitutes and completes the epoch
+    cfg.DATA.RETRIES = 0
+    loader = Loader(ds, batch_size=8, shuffle=True, drop_last=True,
+                    workers=2, seed=0)
+    loader.set_epoch(0)
+    batches = list(loader)
+    assert len(batches) == len(loader)
+    assert all(b["image"].shape[0] == 8 for b in batches)
+
+
+def test_faults_truncate_shard_knob(corpus, tmp_path):
+    import shutil
+
+    work = tmp_path / "injected"
+    shutil.copytree(os.path.join(corpus["shards"], "train"), work / "train")
+    man = read_shard_manifest(str(work / "train"))
+    cfg.FAULTS.ENABLED = True
+    cfg.FAULTS.TRUNCATE_SHARD = len(man["shards"]) - 1
+    ds = ShardDataset(str(work), "train", im_size=16, train=True,
+                      backend="pil")
+    victim = work / "train" / man["shards"][-1]["file"]
+    assert victim.stat().st_size < man["shards"][-1]["size"]  # damaged
+    with pytest.raises(ShardReadError):
+        ds[len(ds) - 1]
+    ds[0]  # surviving prefix still serves
+
+
+# ------------------------------------------------------- exact resume cursor
+def _shard_loader(corpus, **kw):
+    ds = ShardDataset(corpus["shards"], "train", im_size=16, train=True,
+                      base_seed=0, backend="pil")
+    return Loader(ds, batch_size=8, shuffle=True, drop_last=True, workers=2,
+                  seed=7, **kw)
+
+
+def test_loader_state_roundtrip_resumes_exact_stream(corpus):
+    cfg.DATA.SHARDS_BLOCK = 4
+    cfg.DATA.SHARDS_WINDOW = 16
+    loader = _shard_loader(corpus)
+    assert loader.can_save_state()
+    loader.set_epoch(1)
+    full = [b["label"].tolist() for b in loader]
+    sd = loader.state_dict(2)
+    assert sd["cursor"] == 2 * 8  # world size 1 in tests
+    sd = json.loads(json.dumps(sd))  # the checkpoint round-trip is JSON
+
+    fresh = _shard_loader(corpus)
+    skip = fresh.load_state_dict(sd)
+    assert skip == 2 and fresh.resume_skip(1) == 2 and fresh.resume_skip(0) == 0
+    fresh.set_epoch(1)
+    assert [b["label"].tolist() for b in fresh] == full[2:]
+    # one-shot: the next epoch iterates whole
+    fresh.set_epoch(2)
+    assert len(list(fresh)) == len(fresh)
+
+
+def test_loader_state_rejects_drifted_identity(corpus):
+    cfg.DATA.SHARDS_BLOCK = 4
+    cfg.DATA.SHARDS_WINDOW = 16
+    loader = _shard_loader(corpus)
+    loader.set_epoch(0)
+    sd = json.loads(json.dumps(loader.state_dict(1)))
+
+    wrong_seed = _shard_loader(corpus)
+    wrong_seed.sampler.seed = 99  # ≙ RNG_SEED changed between runs
+    with pytest.raises(ValueError, match="order identity"):
+        wrong_seed.load_state_dict(sd)
+
+    other = dict(sd)
+    other["num_records"] = 7
+    with pytest.raises(ValueError, match="corpus changed"):
+        _shard_loader(corpus).load_state_dict(other)
+
+    other = dict(sd)
+    other["format"] = "imagefolder"
+    with pytest.raises(ValueError, match="live pipeline"):
+        _shard_loader(corpus).load_state_dict(other)
+
+
+def test_data_state_checkpoint_encoding_roundtrip():
+    from distribuuuu_tpu.utils import checkpoint as ckpt
+
+    sd = {"v": 1, "format": "shards", "epoch": 3, "cursor": 1024,
+          "order": {"seed": 5, "rng_state": {"state": 2**100}}}
+    arr = ckpt.encode_data_state(sd)
+    assert arr.dtype == np.uint8
+    assert ckpt.decode_data_state(arr) == sd
+    assert ckpt.decode_data_state(np.zeros((4,), np.uint8)) is None
+
+
+# --------------------------------------------- trajectory through the trainer
+def test_midepoch_preempt_resume_matches_uninterrupted(corpus, tmp_path):
+    """The tentpole acceptance: preempt at batch k through the REAL
+    signal → preempt-checkpoint → resume chain (FAULTS.PREEMPT_AT_BATCH,
+    save_preempt_checkpoint with the embedded cursor, _resume +
+    train_epoch continuation), then compare against the uninterrupted run.
+    The continued epoch consumes batch k+1 next and the final state lands
+    on the same trajectory."""
+    from distribuuuu_tpu import trainer
+    from distribuuuu_tpu.parallel import mesh as mesh_lib
+    from distribuuuu_tpu.utils import checkpoint as ckpt
+    from distribuuuu_tpu.utils.logger import get_logger
+    from distribuuuu_tpu.utils.optim import construct_optimizer
+
+    def setup(out_dir):
+        config.reset_cfg()
+        cfg.MODEL.ARCH = "resnet18"
+        cfg.MODEL.NUM_CLASSES = 3
+        cfg.DEVICE.COMPUTE_DTYPE = "float32"
+        cfg.TRAIN.IM_SIZE = 16
+        cfg.TRAIN.BATCH_SIZE = 1  # ×8 virtual devices = per-host batch 8
+        cfg.TRAIN.PRINT_FREQ = 16
+        cfg.DATA.FORMAT = "shards"
+        cfg.DATA.SHARDS_BLOCK = 4
+        cfg.DATA.SHARDS_WINDOW = 16
+        cfg.RNG_SEED = 1
+        cfg.OUT_DIR = str(out_dir)
+        mesh = mesh_lib.build_mesh()
+        model = trainer.build_model_from_cfg()
+        step = trainer.make_train_step(model, construct_optimizer(), topk=3)
+        state = trainer.create_train_state(model, jax.random.key(0), mesh, 16)
+        return trainer, mesh, model, step, state
+
+    logger = get_logger()
+
+    # ---- reference: one uninterrupted epoch ----
+    trn, mesh, model, step, state = setup(tmp_path / "ref")
+    ref_loader = _shard_loader(corpus)
+    state, interrupted, done = trn.train_epoch(
+        loader=ref_loader, mesh=mesh, state=state, train_step=step,
+        epoch=0, logger=logger,
+    )
+    assert not interrupted and done == len(ref_loader)
+    ref_params = jax.tree.map(np.asarray, jax.device_get(state.params))
+
+    # ---- interrupted run: identical init, preempted at batch 2 ----
+    trn, mesh, model, step, state = setup(tmp_path / "run")
+    cfg.FAULTS.ENABLED = True
+    cfg.FAULTS.PREEMPT_EPOCH = 0
+    cfg.FAULTS.PREEMPT_AT_BATCH = 2
+    preempt.install()
+    loader = _shard_loader(corpus)
+    state, interrupted, done = trn.train_epoch(
+        loader=loader, mesh=mesh, state=state, train_step=step,
+        epoch=0, logger=logger,
+    )
+    assert interrupted and 0 < done < len(loader)
+    ckpt.save_preempt_checkpoint(
+        trn._state_tree(state), 0, 0.0,
+        data_state=loader.state_dict(done),
+    )
+
+    # ---- "restart": fresh template state, resume + continue ----
+    preempt.reset()
+    cfg.FAULTS.ENABLED = False
+    fresh = trn.create_train_state(model, jax.random.key(0), mesh, 16)
+    resumed, start_epoch, _, _, data_state = trn._resume(fresh, mesh)
+    assert start_epoch == 0 and int(resumed.step) == done
+    assert data_state is not None and data_state["cursor"] == done * 8
+    loader2 = _shard_loader(corpus)
+    trn._arm_exact_resume(loader2, data_state, start_epoch, logger)
+    assert loader2.resume_skip(0) == done  # consumes batch done+1 next
+    resumed, interrupted, total = trn.train_epoch(
+        loader=loader2, mesh=mesh, state=resumed, train_step=step,
+        epoch=0, logger=logger,
+    )
+    assert not interrupted and total == len(loader2)
+    got_params = jax.tree.map(np.asarray, jax.device_get(resumed.params))
+    # float32 state round-trips orbax exactly; same batches, same step
+    # math → the trajectories coincide (well inside the lockstep tolerance
+    # of tests/test_resilience.py)
+    jax.tree.map(
+        lambda a, b: np.testing.assert_allclose(a, b, rtol=0, atol=1e-5),
+        ref_params, got_params,
+    )
+
+
+# ----------------------------------------------------------- pp bubble (sat)
+def test_pp_bubble_logged_once_per_schedule(tmp_path):
+    from distribuuuu_tpu.parallel import pp
+    from distribuuuu_tpu.utils import jsonlog
+
+    pp._logged_schedules.clear()
+    jsonlog.setup_metrics_log(str(tmp_path))
+    pp.log_bubble_fraction(4, 8)
+    pp.log_bubble_fraction(4, 8)  # dedup: one record per distinct (S, M)
+    pp.log_bubble_fraction(2, 2)
+    jsonlog.close_metrics_log()
+    recs = [
+        json.loads(ln)
+        for ln in open(tmp_path / "metrics.jsonl").read().splitlines()
+        if json.loads(ln)["kind"] == "pp_bubble"
+    ]
+    assert len(recs) == 2
+    assert recs[0]["stages"] == 4 and recs[0]["microbatches"] == 8
+    assert recs[0]["ticks"] == 11 and abs(recs[0]["bubble"] - 3 / 11) < 1e-4
+    assert abs(recs[1]["bubble"] - 1 / 3) < 1e-3

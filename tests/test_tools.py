@@ -56,6 +56,28 @@ def test_data_bench_rejects_empty_measurement():
     assert "drop_last" in out.stderr + out.stdout
 
 
+def test_data_bench_shards_paired_mode(tmp_path):
+    """--backend shards: one paired imagefolder-vs-record-shards command,
+    same decode kernel, writing the comparison JSON (the SHARDS_r01.json
+    artifact shape)."""
+    import json
+
+    json_out = tmp_path / "shards_bench.json"
+    out = _run(
+        ["tools/data_bench.py", "--backend", "shards", "--n-images", "32",
+         "--batch-size", "8", "--epochs", "1", "--im-size", "64",
+         "--workers", "2", "--shard-mb", "0.05", "--json-out", str(json_out)]
+    )
+    assert out.returncode == 0, out.stdout[-2000:] + out.stderr[-1000:]
+    assert "input_pipeline_imagefolder_images_per_sec" in out.stdout
+    assert "input_pipeline_shards_images_per_sec" in out.stdout
+    doc = json.loads(json_out.read_text())
+    assert doc["imagefolder"]["img_per_sec"] > 0
+    assert doc["shards"]["img_per_sec"] > 0
+    assert doc["shards_speedup"] > 0
+    assert doc["corpus"]["shards"] >= 1
+
+
 @pytest.mark.slow
 def test_zoo_check_yaml_mode():
     """--yamls certifies shipped configs through the exact train_net merge

@@ -12,6 +12,12 @@ workers (ref: /root/reference/distribuuuu/utils.py:147).
         [--im-size 224] [--workers 8]
 
 Prints one JSON line per available backend.
+
+``--backend shards`` runs the PAIRED storage-format comparison instead:
+the same corpus is read as one-file-per-JPEG (imagefolder) and as packed
+record shards (tools/make_shards.py layout) with the SAME decode kernel,
+so the delta is purely the IO pattern — per-file open/read vs positioned
+reads from a few large files. ``--json-out SHARDS_r01.json`` records it.
 """
 
 from __future__ import annotations
@@ -45,14 +51,23 @@ def make_corpus(root: str, n_images: int, min_side=256, max_side=512):
 
 
 def bench_backend(root: str, backend: str, epochs: int, im_size: int,
-                  workers: int, batch_size: int):
-    from distribuuuu_tpu.data.imagefolder import ImageFolderDataset
+                  workers: int, batch_size: int, fmt: str = "imagefolder"):
     from distribuuuu_tpu.data.loader import Loader
 
-    dataset = ImageFolderDataset(
-        root, "train", im_size=im_size, train=True, base_seed=0,
-        backend=backend,
-    )
+    if fmt == "shards":
+        from distribuuuu_tpu.data.shards.reader import ShardDataset
+
+        dataset = ShardDataset(
+            root, "train", im_size=im_size, train=True, base_seed=0,
+            backend=backend,
+        )
+    else:
+        from distribuuuu_tpu.data.imagefolder import ImageFolderDataset
+
+        dataset = ImageFolderDataset(
+            root, "train", im_size=im_size, train=True, base_seed=0,
+            backend=backend,
+        )
     loader = Loader(
         dataset, batch_size=batch_size, shuffle=True, drop_last=True,
         workers=workers, seed=0,
@@ -102,6 +117,16 @@ def main():
                     help="comma list (e.g. 1,2,4,8): decode-thread scaling "
                          "curve per backend over one shared corpus "
                          "(VERDICT r4 #7)")
+    ap.add_argument("--backend", default="auto",
+                    choices=["auto", "pil", "native", "shards"],
+                    help="decode backend(s) to bench; 'auto' = pil + native "
+                         "when available; 'shards' = the PAIRED imagefolder-"
+                         "vs-record-shards storage comparison (same decode)")
+    ap.add_argument("--shard-mb", type=float, default=8.0,
+                    help="target shard size (MiB) for --backend shards")
+    ap.add_argument("--json-out", default="",
+                    help="write the full result document here "
+                         "(e.g. SHARDS_r01.json for --backend shards)")
     args = ap.parse_args()
 
     from distribuuuu_tpu import native
@@ -118,8 +143,13 @@ def main():
             )
         make_corpus(root, args.n_images)
 
-    backends = ["pil"] + (["native"] if native.available() else [])
-    if "native" not in backends:
+    if args.backend == "shards":
+        return bench_shards_paired(args, root)
+    if args.backend == "auto":
+        backends = ["pil"] + (["native"] if native.available() else [])
+    else:
+        backends = [args.backend]
+    if args.backend == "auto" and "native" not in backends:
         print(f"# native backend unavailable: {native.build_error()}")
     if args.sweep_workers:
         try:
@@ -163,6 +193,90 @@ def main():
         for w in worker_counts:
             print(f"# workers={w}: native speedup over PIL "
                   f"{results[('native', w)]['img_per_sec'] / results[('pil', w)]['img_per_sec']:.2f}x")
+    if args.json_out:
+        doc = {
+            "schema": 1,
+            "generated_by": "tools/data_bench.py",
+            "n_images": args.n_images if not args.data else None,
+            "epochs": args.epochs,
+            "im_size": args.im_size,
+            "batch_size": args.batch_size,
+            "results": [
+                {"backend": b, "workers": w, **{k: round(v, 3) for k, v in r.items()}}
+                for (b, w), r in results.items()
+            ],
+        }
+        with open(args.json_out, "w") as f:
+            json.dump(doc, f, indent=1)
+        print(f"# wrote {args.json_out}")
+
+
+def bench_shards_paired(args, root: str):
+    """The ``--backend shards`` mode: pack ``root`` into record shards and
+    measure the SAME corpus, SAME decode kernel, SAME loader machinery
+    through both storage layouts — per-file imagefolder reads vs
+    positioned reads from a few large shard files. One paired command, one
+    JSON document (the SHARDS_r01.json artifact)."""
+    import shutil
+
+    from distribuuuu_tpu import native
+    from distribuuuu_tpu.data.shards import format as shards_format
+
+    decode = "native" if native.available() else "pil"
+    shard_root = tempfile.mkdtemp(prefix="data_bench_shards_")
+    try:
+        t0 = time.perf_counter()
+        shards_format.pack_imagefolder(
+            root, shard_root, splits=("train",),
+            target_bytes=max(1, int(args.shard_mb * 1024 * 1024)),
+        )
+        pack_s = time.perf_counter() - t0
+        man = shards_format.read_shard_manifest(
+            os.path.join(shard_root, "train")
+        )
+        results = {}
+        for fmt, src in (("imagefolder", root), ("shards", shard_root)):
+            results[fmt] = r = bench_backend(
+                src, decode, args.epochs, args.im_size, args.workers,
+                args.batch_size, fmt=fmt,
+            )
+            print(json.dumps({
+                "metric": f"input_pipeline_{fmt}_images_per_sec",
+                "value": round(r["img_per_sec"], 1),
+                "unit": "images/sec",
+                "workers": args.workers,
+                "decode_backend": decode,
+                "decode_ms_per_img": round(r["decode_ms_per_img"], 3),
+                "assemble_ms_per_img": round(r["assemble_ms_per_img"], 3),
+            }), flush=True)
+        speedup = results["shards"]["img_per_sec"] / results["imagefolder"]["img_per_sec"]
+        print(f"# shards speedup over imagefolder: {speedup:.3f}x "
+              f"(decode={decode}, workers={args.workers})")
+        if args.json_out:
+            doc = {
+                "schema": 1,
+                "generated_by": "tools/data_bench.py --backend shards",
+                "decode_backend": decode,
+                "workers": args.workers,
+                "epochs": args.epochs,
+                "im_size": args.im_size,
+                "batch_size": args.batch_size,
+                "corpus": {
+                    "images": man["num_records"],
+                    "classes": len(man["classes"]),
+                    "shards": len(man["shards"]),
+                    "shard_bytes": sum(s["size"] for s in man["shards"]),
+                    "pack_seconds": round(pack_s, 2),
+                },
+                "imagefolder": {k: round(v, 3) for k, v in results["imagefolder"].items()},
+                "shards": {k: round(v, 3) for k, v in results["shards"].items()},
+                "shards_speedup": round(speedup, 3),
+            }
+            with open(args.json_out, "w") as f:
+                json.dump(doc, f, indent=1)
+            print(f"# wrote {args.json_out}")
+    finally:
+        shutil.rmtree(shard_root, ignore_errors=True)
 
 
 if __name__ == "__main__":

@@ -27,6 +27,12 @@ way an operator would verify a production incident:
   killed_rank           SIGKILL of rank 1 of 2 mid-epoch-1 (no grace
                         window) → the group restart resumes from the
                         intact ckpt_ep_000 and finishes
+  shards_midepoch       real shard corpus (DATA.FORMAT=shards): the
+                        scheduler preempts (SIGTERM) mid-epoch-1 and the
+                        process is SIGKILLed right after the preempt
+                        checkpoint commits → the restart must CONTINUE
+                        epoch 1 from the saved batch cursor (not batch 0)
+                        and complete, trajectory-continuous
 
 Writes ``RESILIENCE_r01.json`` (``--out``) with per-drill ok/detail and
 ``all_ok``. A fast subset of the same recovery paths gates tier-1 in
@@ -344,6 +350,112 @@ def drill_killed_rank(work):
     return all(checks.values()), checks
 
 
+def _make_shard_corpus(work: str) -> str:
+    """Tiny real shard corpus for the mid-epoch-resume drill: a synthetic
+    4-class imagefolder packed by the real packer (multiple small shards)."""
+    import numpy as np
+    from PIL import Image
+
+    src = os.path.join(work, "imagefolder")
+    rng = np.random.default_rng(0)
+    for split, per_cls in (("train", 16), ("val", 4)):
+        for c in range(4):
+            d = os.path.join(src, split, f"class{c}")
+            os.makedirs(d, exist_ok=True)
+            for i in range(per_cls):
+                arr = rng.integers(0, 256, size=(48, 56, 3), dtype=np.uint8)
+                arr[:, :, c % 3] |= 0x80  # class-conditional tint
+                Image.fromarray(arr).save(
+                    os.path.join(d, f"img{i}.jpg"), "JPEG", quality=90
+                )
+    from distribuuuu_tpu.data.shards.format import pack_imagefolder
+
+    out = os.path.join(work, "shards")
+    pack_imagefolder(src, out, target_bytes=64 * 1024)
+    return out
+
+
+SHARD_OVERRIDES = (
+    "MODEL.DUMMY_INPUT", "False", "MODEL.NUM_CLASSES", 4,
+    "DATA.FORMAT", "shards", "TRAIN.BATCH_SIZE", 4, "TEST.BATCH_SIZE", 8,
+    "DATA.SHARDS_BLOCK", 4, "DATA.SHARDS_WINDOW", 16,
+    "OPTIM.MAX_EPOCH", 2,
+)
+
+
+@_drill("shards_midepoch_resume")
+def drill_shards_midepoch_resume(work):
+    """Exact mid-epoch resume under DATA.FORMAT=shards: preempt (SIGTERM,
+    via FAULTS.PREEMPT_AT_BATCH — the deterministic scheduler signal) at
+    epoch 1 batch 5, SIGKILL the process as soon as the preempt checkpoint
+    has committed (no orderly teardown), then restart and assert the run
+    CONTINUES from the saved batch cursor instead of batch 0."""
+    shards_root = _make_shard_corpus(work)
+    out = os.path.join(work, "out")
+    data_over = SHARD_OVERRIDES + (
+        "TRAIN.DATASET", shards_root, "TEST.DATASET", shards_root,
+    )
+    kill_over = data_over + (
+        "FAULTS.ENABLED", "True", "FAULTS.PREEMPT_EPOCH", 1,
+        "FAULTS.PREEMPT_AT_BATCH", 5,
+    )
+
+    # run 1: launch, then hard-kill the moment the preempt save commits —
+    # the cursor checkpoint, not a clean exit, must carry the resume
+    script = os.path.join(work, "worker.py")
+    with open(script, "w") as f:
+        f.write(WORKER)
+    env = dict(os.environ)
+    env["PYTHONPATH"] = ROOT + os.pathsep + env.get("PYTHONPATH", "")
+    marker = os.path.join(out, "checkpoints", "preempt_ep_001", "MANIFEST.json")
+    log_path = os.path.join(work, "preempt.log")
+    with open(log_path, "w+") as log:
+        proc = subprocess.Popen(
+            [sys.executable, script, out, *map(str, kill_over)],
+            env=env, cwd=ROOT, stdout=log, stderr=subprocess.STDOUT, text=True,
+        )
+        deadline = time.time() + 1800
+        killed = False
+        while time.time() < deadline and proc.poll() is None:
+            if os.path.exists(marker):
+                proc.kill()  # SIGKILL right after the commit marker lands
+                killed = True
+                break
+            time.sleep(0.05)
+        try:
+            proc.wait(timeout=120)
+        except subprocess.TimeoutExpired:
+            proc.kill()
+            proc.wait()
+        log.seek(0)
+        log1 = log.read()
+    checks = {
+        "preempt_ckpt_committed": os.path.exists(marker),
+        "preempt_logged": "preemption signaled" in log1,
+    }
+    m = re.search(r"leaving epoch 2 at batch (\d+)/(\d+)", log1)
+    checks["left_midepoch"] = bool(m) and 0 < int(m.group(1)) < int(m.group(2))
+    left = int(m.group(1)) if m else -1
+
+    # run 2: restart — must resume from the preempt save and CONTINUE the
+    # interrupted epoch at the exact next batch, then complete
+    rc, log2 = _run_worker(work, out, data_over, tag="resume")
+    names = _ckpts(out)
+    checks["restart_rc==0"] = rc == 0 and "DRILL_DONE" in log2
+    checks["resumed_from_preempt"] = bool(
+        re.search(r"resumed from .*preempt_ep_001", log2)
+    )
+    m2 = re.search(r"continuing epoch 2 at batch (\d+)/(\d+)", log2)
+    checks["continued_from_cursor"] = bool(m2) and int(m2.group(1)) > 1
+    if m2 and left > 0:
+        # the restart's first batch is exactly the one after the cursor
+        checks["cursor_is_next_batch"] = int(m2.group(1)) == left + 1
+    checks["epoch1_completed"] = "ckpt_ep_001" in names
+    checks["killed_after_commit"] = killed  # informational but asserted:
+    # the kill must have landed (the commit marker beat process exit)
+    return all(checks.values()), checks
+
+
 def main():
     ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
     ap.add_argument("--out", default="RESILIENCE_r01.json")
@@ -360,7 +472,7 @@ def main():
         drill_truncated_checkpoint, drill_partial_checkpoint,
         drill_nan_skip, drill_nan_rollback,
         drill_decode_error_retry, drill_decode_error_skip,
-        drill_stall_watchdog,
+        drill_stall_watchdog, drill_shards_midepoch_resume,
     ]
     if not args.skip_multiprocess:
         drills.append(drill_killed_rank)

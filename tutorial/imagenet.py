@@ -126,7 +126,7 @@ def main():
     if ckpt.has_checkpoint():
         # a previous (possibly preempted) run left state — pick it up, the
         # same auto-resume the full trainer does
-        state, start_epoch, best, pending = trainer._resume(state, mesh)
+        state, start_epoch, best, pending, _ = trainer._resume(state, mesh)
         if pending is not None:
             # that run finished training epoch `pending` but its eval was
             # preempted: validate it now so it gets best-tracking and its
@@ -142,7 +142,7 @@ def main():
                 )
                 ckpt.prune_preempts(pending + 1)
     for epoch in range(start_epoch, cfg.OPTIM.MAX_EPOCH):
-        state, interrupted = trainer.train_epoch(
+        state, interrupted, _ = trainer.train_epoch(
             train_loader, mesh, state, train_step, epoch, logger
         )
         if interrupted:
