@@ -496,6 +496,30 @@ _C.SERVE.DEVICE = 0
 _C.SERVE.HOST = "127.0.0.1"
 _C.SERVE.PORT = 8765
 
+# ------------------------------- telemetry -----------------------------------
+# Unified telemetry layer (distribuuuu_tpu/telemetry/): per-rank JSONL
+# event files ({OUT_DIR}/telemetry/rank*.jsonl — spans, compile events,
+# registry snapshots, mirrored resilience events), merged by
+# tools/run_report.py into a run health report and a Perfetto trace.
+# Trajectory-neutral by contract: ENABLED True vs False produces
+# bit-identical training states (tests/test_telemetry.py); overhead is a
+# few JSON lines per batch per rank, off the measured intervals.
+_C.TELEMETRY = CfgNode()
+_C.TELEMETRY.ENABLED = True
+# Per-rank sink directory; "" = {OUT_DIR}/telemetry.
+_C.TELEMETRY.DIR = ""
+# Per-batch wait/h2d/step spans on EVERY rank (the per-rank half of the
+# TRAIN.TIMELINE records, which stay primary-only): cross-rank step-time
+# percentiles and straggler skew come from these. False keeps only
+# epoch-level records (registry snapshots, memstats) and event mirrors.
+_C.TELEMETRY.STEP_SPANS = True
+# Count jit compiles + wall time via the jax.monitoring bus (kind=
+# "compile" records + jit.compiles/jit.compile_s registry counters).
+_C.TELEMETRY.COMPILE_EVENTS = True
+# Sample device.memory_stats() per epoch (kind="memstats"; TPU/GPU
+# backends — the CPU backend reports none and is skipped).
+_C.TELEMETRY.MEMSTATS = True
+
 # ------------------------------- profiler ------------------------------------
 # jax.profiler trace capture (TensorBoard/XProf format). When enabled, the
 # primary process traces NUM_STEPS train steps starting at START_STEP of

@@ -26,6 +26,10 @@ from distribuuuu_tpu.config import cfg
 from distribuuuu_tpu.data.dummy import DummyDataset
 from distribuuuu_tpu.data.sampler import DistributedSampler
 from distribuuuu_tpu.parallel import mesh as mesh_lib
+from distribuuuu_tpu.telemetry import (
+    registry as telemetry_registry,
+    spans as telemetry_spans,
+)
 from distribuuuu_tpu.utils import faults
 from distribuuuu_tpu.utils.jsonlog import metrics_log
 from distribuuuu_tpu.utils.logger import get_logger
@@ -223,8 +227,19 @@ class Loader:
             )
             batch["label"] = np.concatenate([batch["label"], np.zeros(pad, np.int32)])
             batch["mask"] = np.concatenate([batch["mask"], np.zeros(pad, np.float32)])
+        asm1 = time.perf_counter()
+        if telemetry_spans.enabled() and cfg.TELEMETRY.STEP_SPANS:
+            # worker-side halves of the batch timeline, per rank (the
+            # primary-only kind="timeline" records carry the same stamps
+            # for rank 0; these make a rank-3 decode stall visible)
+            telemetry_spans.emit_span("decode", dec0, dec1, track="loader", n=n)
+            telemetry_spans.emit_span("assemble", dec1, asm1, track="loader", n=n)
+        reg = telemetry_registry.get_registry()
+        reg.counter("data.batches").inc(1)
+        reg.counter("data.samples").inc(n)
+        reg.counter("data.decode_s").inc(dec1 - dec0)
         return batch, {"submit": submit, "dec0": dec0, "dec1": dec1,
-                       "asm1": time.perf_counter()}
+                       "asm1": asm1}
 
     def _fetch_sample(self, i: int):
         """One sample with retry-with-backoff; ``None`` marks a
@@ -251,6 +266,7 @@ class Loader:
             "substituting a good sample from the same batch",
             int(i), self.retries + 1, type(err).__name__, err,
         )
+        telemetry_registry.get_registry().counter("data.errors").inc(1)
         metrics_log(
             "data_error", index=int(i), attempts=self.retries + 1,
             error=f"{type(err).__name__}: {err}",

@@ -39,6 +39,7 @@ import time
 import jax
 import jax.numpy as jnp
 
+from distribuuuu_tpu.telemetry import registry as telemetry_registry
 from distribuuuu_tpu.utils.jsonlog import metrics_log
 from distribuuuu_tpu.utils.logger import get_logger
 
@@ -117,6 +118,7 @@ class NonFiniteMonitor:
         """True ⇒ this step was skipped in-graph (exclude it from meters)."""
         if not nonfinite:
             return False
+        telemetry_registry.get_registry().counter("resilience.nonfinite").inc(1)
         if self.policy == "skip":
             self.skipped += 1
             self.logger.warning(
@@ -176,6 +178,9 @@ class Heartbeat:
                     "'Recovering a wedged run'",
                     age, self._label, self.timeout,
                 )
+                telemetry_registry.get_registry().counter(
+                    "resilience.stalls"
+                ).inc(1)
                 metrics_log(
                     "stall", age_s=round(age, 3), last=self._label,
                     count=self.stall_count,

@@ -54,6 +54,7 @@ import numpy as np
 from distribuuuu_tpu.config import cfg
 from distribuuuu_tpu.serve.admission import AdmissionController
 from distribuuuu_tpu.serve.metrics import ServeMetrics
+from distribuuuu_tpu.telemetry import registry as telemetry_registry
 
 # Compilation-count hook: every AOT bucket compile appends its batch size.
 # Steady-state serving must not grow this list (tests/test_serve.py).
@@ -140,6 +141,13 @@ class Engine:
             self._compiled[b] = jit_fwd.lower(variables, sds).compile()
             self.n_compiles += 1
             COMPILE_EVENTS.append(b)
+        # AOT startup compiles in the shared registry (telemetry/): a
+        # run_report over a serve run separates these expected compiles
+        # from steady-state recompile storms (which bump jit.compiles
+        # via the monitoring listener without bumping this)
+        telemetry_registry.get_registry().counter(
+            "serve.aot_compiles"
+        ).inc(self.n_compiles)
 
         self._cond = threading.Condition()
         self._pending: deque[_Request] = deque()

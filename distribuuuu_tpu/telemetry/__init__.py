@@ -1,0 +1,53 @@
+"""Unified telemetry layer (ISSUE 5): per-rank spans, a process-wide
+metrics registry, runtime capture (jit compiles, device memory), a
+declared ``kind=`` schema, and a Perfetto trace exporter.
+
+The subsystem that subsumes the previously scattered sinks — rank-0-only
+``metrics.jsonl`` (utils/jsonlog.py), overlap timeline records (PR 2),
+resilience events (PR 3), shard IO (PR 4), serve meters (PR 1) — into
+one per-rank event stream that merges onto one timebase:
+
+    spans.py     per-rank JSONL sink + span()/emit_span()/emit_event()
+    registry.py  counters/gauges/histograms; one snapshot schema
+    runtime.py   jit-compile listener + per-epoch device memory stats
+    schema.py    the declared kind registry (static + dynamic checks)
+    export.py    N rank files + timeline records -> Perfetto trace JSON
+
+Consumers: tools/run_report.py (run health + regression gate),
+tools/check_telemetry_schema.py (tier-1 schema check), Perfetto.
+
+Hard contract: telemetry is trajectory-neutral — enabled vs disabled
+runs produce bit-identical training states (tests/test_telemetry.py).
+"""
+
+from distribuuuu_tpu.telemetry.registry import (  # noqa: F401
+    Registry,
+    emit_snapshot,
+    get_registry,
+)
+from distribuuuu_tpu.telemetry.spans import (  # noqa: F401
+    close_telemetry,
+    emit_event,
+    emit_span,
+    enabled,
+    setup_telemetry,
+    span,
+)
+
+
+def setup_from_cfg(cfg, rank: int = 0) -> str | None:
+    """The one entry point runs use (train_model / test_model /
+    serve_net): open this rank's sink per the ``TELEMETRY`` config node
+    and install the compile listener. Returns the sink path, or None
+    when ``TELEMETRY.ENABLED`` is off."""
+    import os
+
+    from distribuuuu_tpu.telemetry import runtime
+
+    if not cfg.TELEMETRY.ENABLED:
+        return None
+    tdir = cfg.TELEMETRY.DIR or os.path.join(cfg.OUT_DIR, "telemetry")
+    path = setup_telemetry(tdir, rank=rank)
+    if cfg.TELEMETRY.COMPILE_EVENTS:
+        runtime.install_compile_listener()
+    return path

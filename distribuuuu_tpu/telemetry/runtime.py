@@ -1,0 +1,88 @@
+"""Runtime capture: jit compile events + device memory stats.
+
+**Compile events.** A recompile storm (a shape drifting per step, a
+donation mismatch, an eval path missing its cache) shows up as minutes of
+silence on the rank that hits it — invisible in rank-0 logs. JAX's
+monitoring bus emits a duration event for every backend compile;
+``install_compile_listener`` counts them into the registry
+(``jit.compiles`` / ``jit.compile_s``) and drops one ``kind="compile"``
+record per compile in the per-rank sink, so both the run report (count +
+wall) and the Perfetto trace (a slice on the ``jit`` track) carry them.
+
+The listener registers once per process and stays registered (JAX has no
+public unregister); it is a no-op while the telemetry sink is closed, so
+tests and library use pay one predicate per compile, nothing more.
+
+**Memory stats.** ``device.memory_stats()`` (bytes_in_use /
+peak_bytes_in_use on TPU; ``None`` on the CPU backend — skipped) sampled
+once per epoch into ``kind="memstats"`` records: the slow-leak and
+fragmentation signal at epoch granularity, costing one host call per
+device per epoch.
+"""
+
+from __future__ import annotations
+
+import time
+
+from distribuuuu_tpu.telemetry import registry as registry_lib, spans
+
+# the monitoring key of one backend compilation (jax 0.4.x); the other
+# /jax/core/compile/* keys are sub-phases of the same compile
+_COMPILE_EVENT = "backend_compile"
+
+_state = {"installed": False}
+
+
+def _on_event_duration(event: str, duration: float, **_kw) -> None:
+    if _COMPILE_EVENT not in event:
+        return
+    if not spans.enabled():
+        return
+    reg = registry_lib.get_registry()
+    reg.counter("jit.compiles").inc(1)
+    reg.counter("jit.compile_s").inc(float(duration))
+    # mono stamp approximates the compile's END (the bus reports after)
+    spans.emit_event(
+        "compile", event=event, dur_s=round(float(duration), 6),
+        mono=round(time.perf_counter(), 6),
+    )
+
+
+def install_compile_listener() -> bool:
+    """Idempotent; returns False when the monitoring bus is unavailable
+    (never raises — observability must not take a run down)."""
+    if _state["installed"]:
+        return True
+    try:
+        from jax import monitoring
+    except Exception:  # pragma: no cover — jax without the bus
+        return False
+    monitoring.register_event_duration_secs_listener(_on_event_duration)
+    _state["installed"] = True
+    return True
+
+
+def sample_memstats(**attrs) -> int:
+    """One ``kind="memstats"`` record per local device that reports
+    (TPU/GPU backends; the CPU backend returns None and is skipped).
+    Returns the number of records emitted."""
+    if not spans.enabled():
+        return 0
+    import jax
+
+    n = 0
+    for i, d in enumerate(jax.local_devices()):
+        try:
+            stats = d.memory_stats()
+        except Exception:
+            stats = None
+        if not stats:
+            continue
+        spans.emit_event(
+            "memstats", device=i,
+            bytes_in_use=int(stats.get("bytes_in_use", 0)),
+            peak_bytes_in_use=int(stats.get("peak_bytes_in_use", 0)),
+            **attrs,
+        )
+        n += 1
+    return n

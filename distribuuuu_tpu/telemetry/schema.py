@@ -1,0 +1,73 @@
+"""The ``kind=`` schema registry: every record kind any part of
+``distribuuuu_tpu`` emits — through ``utils/jsonlog.metrics_log`` or the
+per-rank telemetry sink — is declared here with its required fields.
+
+Two enforcement layers keep emitters and consumers (telemetry/export.py,
+tools/run_report.py, external jq/pandas users) from drifting apart:
+
+* **static** — ``tools/check_telemetry_schema.py`` (tier-1 via
+  tests/test_telemetry.py) AST-scans every emit call site in the package:
+  an undeclared kind string, or a literal-kind call missing a required
+  field, fails the build;
+* **dynamic** — ``validate_record`` checks real emitted records (tests
+  run it over whole rank files and metrics.jsonl).
+
+Required = the fields consumers depend on; emitters may add free-form
+extras (span attrs, serve snapshot extensions) without declaring them.
+"""
+
+from __future__ import annotations
+
+# kind -> frozenset of required fields (beyond the envelope: jsonlog
+# records carry {"t"}, telemetry records {"rank", "t"}).
+KINDS: dict[str, frozenset] = {
+    # -- train/eval loop (utils/jsonlog.py, primary metrics.jsonl) --------
+    "train": frozenset({"epoch", "batch", "loss", "top1", "topk", "lr"}),
+    "eval": frozenset({"epoch", "loss", "top1", "topk", "samples"}),
+    "epoch": frozenset({"epoch", "acc1", "best_acc1"}),
+    "timeline": frozenset({"v", "phase", "epoch", "batch", "n"}),
+    # -- parallelism / serving -------------------------------------------
+    "pp_bubble": frozenset({"stages", "microbatches", "ticks", "bubble"}),
+    "serve": frozenset(
+        {"requests", "rejected", "batches", "throughput_rps", "p50_ms",
+         "p90_ms", "p99_ms", "batch_occupancy"}
+    ),
+    # -- resilience (rank-local: mirrored to the per-rank sink) ----------
+    "stall": frozenset({"age_s", "count"}),
+    "data_error": frozenset({"index", "attempts", "error"}),
+    "nonfinite": frozenset({"epoch", "batch", "policy"}),
+    # -- telemetry layer (per-rank sink, telemetry/spans.py) -------------
+    "clock": frozenset({"unix", "mono"}),
+    "span": frozenset({"v", "name", "t0", "dur", "track"}),
+    "registry": frozenset({"v", "counters", "gauges", "histograms"}),
+    "compile": frozenset({"event", "dur_s", "mono"}),
+    "memstats": frozenset({"device", "bytes_in_use", "peak_bytes_in_use"}),
+}
+
+
+class SchemaError(ValueError):
+    """A record (or call site) violates the declared kind schema."""
+
+
+def check_fields(kind: str, fields) -> None:
+    """Raise SchemaError on an undeclared kind or missing required
+    fields; ``fields`` is any iterable of field names."""
+    if kind not in KINDS:
+        raise SchemaError(
+            f"undeclared kind {kind!r} — declare it (with its required "
+            "fields) in distribuuuu_tpu/telemetry/schema.py"
+        )
+    missing = KINDS[kind] - set(fields)
+    if missing:
+        raise SchemaError(
+            f"kind {kind!r} missing required fields {sorted(missing)} "
+            f"(declared in telemetry/schema.py)"
+        )
+
+
+def validate_record(rec: dict) -> None:
+    """Dynamic check of one emitted record (a parsed JSONL line)."""
+    kind = rec.get("kind")
+    if kind is None:
+        raise SchemaError(f"record has no 'kind': {rec}")
+    check_fields(kind, rec.keys())

@@ -47,6 +47,11 @@ from distribuuuu_tpu.parallel import (
     zero,
 )
 from distribuuuu_tpu.resilience import manifest as manifest_lib, supervisor
+from distribuuuu_tpu import telemetry
+from distribuuuu_tpu.telemetry import (
+    runtime as telemetry_runtime,
+    spans as telemetry_spans,
+)
 from distribuuuu_tpu.utils import checkpoint as ckpt
 from distribuuuu_tpu.utils import faults
 from distribuuuu_tpu.utils import preempt
@@ -581,6 +586,34 @@ class _ProfilerWindow:
             )
 
 
+def _emit_batch_spans(phase: str, epoch: int, batch: int, tl: dict) -> None:
+    """Per-rank wait/h2d/step spans for one dispatched batch, from the
+    stage stamps the loop already measured (telemetry/spans.py — the
+    write happens AFTER every measured interval closed, so telemetry
+    never sits inside its own numbers). Unlike the primary-only
+    ``kind="timeline"`` records, these land in EVERY rank's sink: the
+    cross-rank step percentiles and straggler skew in
+    tools/run_report.py come from exactly these spans."""
+    attrs = {"phase": phase, "epoch": epoch, "batch": batch}
+    if "get0" in tl and "get1" in tl:
+        telemetry_spans.emit_span(
+            "wait", tl["get0"], tl["get1"], track="pipeline", **attrs
+        )
+    if "put0" in tl and "put1" in tl:
+        telemetry_spans.emit_span(
+            "h2d", tl["put0"], tl["put1"], track="pipeline", **attrs
+        )
+    if "step0" in tl and "step1" in tl:
+        telemetry_spans.emit_span(
+            "step", tl["step0"], tl["step1"], track="pipeline",
+            n=tl.get("n", 0), **attrs,
+        )
+
+
+def _step_spans_on() -> bool:
+    return telemetry_spans.enabled() and cfg.TELEMETRY.STEP_SPANS
+
+
 def train_epoch(loader, mesh, state, train_step, epoch: int, logger,
                 first_epoch: int = 0, scan_step=None):
     """One epoch of the hot loop (ref: trainer.py:14-64).
@@ -731,6 +764,7 @@ def train_epoch(loader, mesh, state, train_step, epoch: int, logger,
         return False
 
     emit_timeline = cfg.TRAIN.TIMELINE and mesh_lib.is_primary()
+    emit_spans = _step_spans_on()
     try:
         if fold > 1:
             # Two preallocated (fold, batch, ...) host buffers, ping-ponged per
@@ -803,6 +837,15 @@ def train_epoch(loader, mesh, state, train_step, epoch: int, logger,
                 # per-BATCH time over the whole window (incl. the buffering
                 # iterations) so display/ETA keep their per-batch meaning
                 now = time.perf_counter()
+                if emit_spans:
+                    # folded dispatch has no per-step stamps; one span per
+                    # window (n steps) — run_report derives per-step time
+                    # as dur/n when a run has only fold_window spans
+                    telemetry_spans.emit_span(
+                        "fold_window", win_start, now, track="pipeline",
+                        phase="train", epoch=epoch + 1,
+                        batch=done - n, n=n,
+                    )
                 batch_time.update((now - win_start) / n, n=n)
                 win_start = now
                 end = time.perf_counter()
@@ -837,6 +880,8 @@ def train_epoch(loader, mesh, state, train_step, epoch: int, logger,
                 done += 1
                 batch_time.update(time.perf_counter() - end)
                 end = time.perf_counter()
+                if emit_spans:
+                    _emit_batch_spans("train", epoch + 1, abs_it, tl)
                 if emit_timeline:
                     timeline_log(
                         "train", epoch + 1, abs_it, tl.pop("n", 0), **tl
@@ -873,6 +918,7 @@ def validate(loader, mesh, state, eval_step, epoch: int, logger):
     # are a pure sum — overlap order cannot change them (equivalence:
     # tests/test_overlap.py).
     emit_timeline = cfg.TRAIN.TIMELINE and mesh_lib.is_primary()
+    emit_spans = _step_spans_on()
     depth = max(0, cfg.TRAIN.PREFETCH_DEVICE)
     end = time.perf_counter()
     for it, batch, tl in device_prefetch(
@@ -886,6 +932,8 @@ def validate(loader, mesh, state, eval_step, epoch: int, logger):
             else jax.tree.map(jnp.add, totals, m)
         )
         tl["step1"] = time.perf_counter()
+        if emit_spans:
+            _emit_batch_spans("eval", epoch + 1, it, tl)
         if emit_timeline:
             timeline_log("eval", epoch + 1, it, tl.pop("n", 0), **tl)
         at_check_site = (
@@ -1211,6 +1259,10 @@ def train_model():
     setup_env()
     logger = setup_logger()
     setup_metrics_log(cfg.OUT_DIR, primary=mesh_lib.is_primary())
+    # per-rank telemetry sink (telemetry/): spans, compile events, registry
+    # snapshots, mirrored resilience events — rank-local signals survive on
+    # every process, unlike the primary-only metrics.jsonl above
+    telemetry.setup_from_cfg(cfg, rank=jax.process_index())
     mesh = mesh_lib.mesh_from_cfg(cfg)
     key = setup_seed()
 
@@ -1283,12 +1335,24 @@ def train_model():
         preempt.install()
 
     def _preempt_exit(path, resume_epoch):
+        if telemetry.enabled():  # final counters survive the preemption
+            telemetry.emit_snapshot()
         if mesh_lib.is_primary():
             logger.warning(
                 "preempted: state saved to %s; rerun to resume at epoch %d",
                 path, resume_epoch + 1,
             )
         return best_acc1
+
+    def _epoch_telemetry(epoch):
+        """Epoch-boundary sampling: device memory stats (TPU/GPU — the
+        CPU backend reports none) and one registry snapshot (recompile
+        counters, IO tallies) per rank — run_report reads the last."""
+        if not telemetry.enabled():
+            return
+        if cfg.TELEMETRY.MEMSTATS:
+            telemetry_runtime.sample_memstats(epoch=epoch + 1)
+        telemetry.emit_snapshot(epoch=epoch + 1)
 
     def _finish_epoch(epoch):
         """Validate + best-track + save for a completed epoch. Returns the
@@ -1411,6 +1475,7 @@ def train_model():
         path = _finish_epoch(epoch)
         if path is not None:  # eval itself was preempted (validate → None)
             return _preempt_exit(path, epoch + 1)
+        _epoch_telemetry(epoch)
         if watching and preempt.requested_global():
             # signaled during the save: ckpt_ep_{epoch} is already on
             # disk — nothing more to persist, just exit promptly
@@ -1426,6 +1491,7 @@ def test_model():
     mesh_lib.setup_distributed()
     check_trainer_mesh()
     logger = setup_logger()
+    telemetry.setup_from_cfg(cfg, rank=jax.process_index())
     mesh = mesh_lib.mesh_from_cfg(cfg)
     # eval-only checks (GPipe eval divisibility), before the compile — a
     # train-invalid config must not block a pure evaluation (ADVICE r3 #2)
@@ -1444,6 +1510,8 @@ def test_model():
             logger.warning("evaluation preempted before completion")
         return None
     top1, topk = result
+    if telemetry.enabled():
+        telemetry.emit_snapshot()
     if mesh_lib.is_primary():
         logger.info("TEST  Acc@1 %.3f  Acc@%d %.3f", top1, effective_topk(), topk)
     return top1, topk

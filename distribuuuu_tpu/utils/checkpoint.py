@@ -32,6 +32,7 @@ import orbax.checkpoint as ocp
 
 from distribuuuu_tpu.config import cfg
 from distribuuuu_tpu.resilience import manifest as manifest_lib
+from distribuuuu_tpu.telemetry import spans as telemetry_spans
 
 _NAME_PREFIX = "ckpt_ep_"
 _BEST_NAME = "best"
@@ -236,10 +237,17 @@ def _save_full(
     payload["best_acc1"] = np.float32(best_acc1)
     if extra:
         payload.update(extra)
-    ocp.PyTreeCheckpointer().save(path, payload, force=True)
-    if jax.process_index() == 0:
-        manifest_lib.write_manifest(path, payload, kind="full",
-                                    epoch=epoch_cursor)
+    # span covers payload + manifest commit: the save duration an operator
+    # budgets the preemption grace window against (tools/run_report.py
+    # reports count/mean/max per rank from these)
+    with telemetry_spans.span(
+        "ckpt_save", track="ckpt",
+        ckpt=os.path.basename(path), epoch=int(epoch_cursor),
+    ):
+        ocp.PyTreeCheckpointer().save(path, payload, force=True)
+        if jax.process_index() == 0:
+            manifest_lib.write_manifest(path, payload, kind="full",
+                                        epoch=epoch_cursor)
     return path
 
 
@@ -349,7 +357,10 @@ def load_checkpoint(path: str):
     path = os.path.abspath(path)
     ckptr = ocp.PyTreeCheckpointer()
     try:
-        return ckptr.restore(path)
+        with telemetry_spans.span(
+            "ckpt_restore", track="ckpt", ckpt=os.path.basename(path)
+        ):
+            return ckptr.restore(path)
     except Exception as e:  # orbax/tensorstore raise many concrete types
         if _is_managed_checkpoint(path):
             dest = quarantine_checkpoint(path, f"restore failed: {e}")

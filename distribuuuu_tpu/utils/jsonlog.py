@@ -18,6 +18,8 @@ import json
 import os
 import time
 
+from distribuuuu_tpu.telemetry import spans
+
 _sink = {"f": None}
 
 
@@ -69,7 +71,16 @@ def timeline_log(phase: str, epoch: int, batch: int, n: int, **stamps) -> None:
 
 def metrics_log(kind: str, **fields) -> None:
     """Append one record: {"t": unix_time, "kind": kind, **fields}.
-    No-op when the sink is not set up (non-primary, tests, library use)."""
+    No-op when the sink is not set up (non-primary, tests, library use).
+
+    Every record is additionally mirrored to the per-rank telemetry sink
+    when one is open (telemetry/spans.py) — BEFORE the primary gate, so
+    rank-local kinds (stall, data_error, nonfinite) survive on ranks > 0
+    instead of being silently dropped; before the telemetry layer the
+    supervisor's records simply vanished on every non-primary process.
+    ``timeline`` records are not mirrored (they stay primary-only here;
+    the trace exporter reads them from metrics.jsonl directly)."""
+    spans.mirror_event(kind, fields)
     f = _sink["f"]
     if f is None:
         return

@@ -50,13 +50,16 @@ def main(argv=None):
     cfg.merge_from_list(args.opts)
     cfg.freeze()
 
-    from distribuuuu_tpu import trainer
+    from distribuuuu_tpu import telemetry, trainer
     from distribuuuu_tpu.serve import admission, engine_from_cfg, protocol
     from distribuuuu_tpu.utils.jsonlog import setup_metrics_log
     from distribuuuu_tpu.utils.logger import get_logger, setup_logger
 
     setup_logger()
     logger = get_logger()
+    # per-rank telemetry (TELEMETRY node): serving is single-process, so
+    # rank 0 — bucket AOT compiles land as kind="compile" records
+    telemetry.setup_from_cfg(cfg)
     engine = engine_from_cfg()
     logger.info(
         "serving %s: buckets %s compiled (%d shapes), max_wait %.1f ms, "

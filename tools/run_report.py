@@ -1,0 +1,395 @@
+"""Run health + regression report from a finished run's telemetry.
+
+Merges the per-rank telemetry files (``{run}/telemetry/rank*.jsonl`` —
+spans, compile events, registry snapshots, mirrored resilience events)
+with the primary ``metrics.jsonl`` and answers the operator questions the
+scattered sinks couldn't: is a rank straggling, is the run input-bound,
+did anything recompile mid-run, what did checkpoints cost, did resilience
+machinery fire — printed as a table and written as ``RUN_REPORT.json``.
+
+    # report + merged Perfetto trace (trace.json) in one command:
+    python tools/run_report.py --trace out/
+
+    # regression gate against a committed reference point:
+    python tools/run_report.py out/ --compare BENCH_r05.json --tol-pct 10
+
+Metrics:
+
+* **step time** — per-rank p50/p90/p99/mean from the per-rank ``step``
+  spans (or ``fold_window`` spans ÷ steps for folded runs); straggler
+  skew = slowest rank p50 / fastest rank p50 (1.0 = lockstep).
+* **data-wait fraction** — tools/overlap_report.py's exact attribution
+  when timeline records exist (reused, not reimplemented); otherwise the
+  per-rank ``wait`` span fraction of the pipeline wall.
+* **resilience events** — stall / data_error / nonfinite counts across
+  ALL ranks (the per-rank sink is what makes ranks > 0 visible).
+* **recompiles** — ``kind="compile"`` count + wall seconds per rank.
+* **checkpoints** — save/restore span count, mean, max.
+
+``--compare BASELINE.json`` accepts a previous ``RUN_REPORT.json`` or a
+repo ``BENCH_*.json`` artifact (its ``parsed.value`` img/s becomes the
+throughput reference). Direction-aware thresholds: ``--tol-pct`` (global,
+default 10%) and repeatable ``--tol METRIC=PCT`` overrides; any metric
+worse than its tolerance FAILs and the exit code is 1 — the CI gate
+(tests/test_telemetry.py exercises both directions against the committed
+BENCH_r05.json so the gate itself can't rot).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+import _path  # noqa: F401  (repo root onto sys.path)
+
+from distribuuuu_tpu.telemetry import export
+from distribuuuu_tpu.telemetry.registry import percentile
+
+REPORT_SCHEMA = 1
+
+# direction-aware comparison sets: a metric is a regression when it moves
+# the WRONG way by more than its tolerance
+LOWER_BETTER = (
+    "step_ms_p50", "step_ms_p90", "step_ms_p99", "data_wait_frac",
+    "straggler_skew", "recompiles", "ckpt_save_max_s",
+)
+HIGHER_BETTER = ("img_per_sec",)
+
+
+def _load_ranks(run_dir: str) -> dict[int, list[dict]]:
+    return {
+        rank: export.read_jsonl(path)
+        for rank, path in export.rank_files(run_dir).items()
+    }
+
+
+def _spans(recs: list[dict], name: str, phase: str | None = None) -> list[dict]:
+    out = []
+    for r in recs:
+        if r.get("kind") != "span" or r.get("name") != name:
+            continue
+        if phase is not None and r.get("phase") != phase:
+            continue
+        out.append(r)
+    return out
+
+
+def _step_durs(recs: list[dict], phase: str) -> tuple[list[float], str]:
+    """Per-step durations (seconds) for one rank: ``step`` spans when the
+    run dispatched per-step; ``fold_window`` spans ÷ steps otherwise."""
+    steps = _spans(recs, "step", phase)
+    if steps:
+        return [float(r["dur"]) for r in steps], "step"
+    folds = _spans(recs, "fold_window", phase)
+    return (
+        [float(r["dur"]) / max(1, int(r.get("n", 1))) for r in folds],
+        "fold_window",
+    )
+
+
+def _summary_ms(durs: list[float]) -> dict:
+    vals = sorted(durs)
+    ms = 1e3
+    return {
+        "count": len(vals),
+        "mean_ms": round(sum(vals) / len(vals) * ms, 3) if vals else 0.0,
+        "p50_ms": round(percentile(vals, 0.50) * ms, 3),
+        "p90_ms": round(percentile(vals, 0.90) * ms, 3),
+        "p99_ms": round(percentile(vals, 0.99) * ms, 3),
+        "max_ms": round(vals[-1] * ms, 3) if vals else 0.0,
+    }
+
+
+def _wait_frac_from_spans(recs: list[dict], phase: str) -> float | None:
+    """Fallback data-wait fraction for one rank: wait seconds over the
+    pipeline-track wall (first span start → last span end)."""
+    pipeline = [
+        r for r in recs
+        if r.get("kind") == "span" and r.get("track") == "pipeline"
+        and (r.get("phase") == phase)
+    ]
+    if not pipeline:
+        return None
+    t0 = min(float(r["t0"]) for r in pipeline)
+    t1 = max(float(r["t0"]) + float(r["dur"]) for r in pipeline)
+    wall = max(t1 - t0, 1e-9)
+    wait = sum(float(r["dur"]) for r in pipeline if r.get("name") == "wait")
+    return wait / wall
+
+
+def _count_events(ranks: dict[int, list[dict]], metrics: list[dict]) -> dict:
+    """stall/data_error/nonfinite tallies. Rank files carry every record
+    (jsonlog mirrors into them), so they are authoritative when present;
+    a telemetry-off run falls back to the primary metrics.jsonl (which
+    only ever saw rank 0)."""
+    kinds = ("stall", "data_error", "nonfinite")
+    out = {k: 0 for k in kinds}
+    source = ranks.values() if ranks else [metrics]
+    for recs in source:
+        for r in recs:
+            if r.get("kind") in kinds:
+                out[r["kind"]] += 1
+    return out
+
+
+def build_report(run_dir: str, phase: str = "train") -> dict:
+    ranks = _load_ranks(run_dir)
+    metrics_path = os.path.join(run_dir, "metrics.jsonl")
+    metrics = export.read_jsonl(metrics_path) if os.path.exists(metrics_path) else []
+    if not ranks and not metrics:
+        raise FileNotFoundError(
+            f"no telemetry under {run_dir}: expected telemetry/rank*.jsonl "
+            "(TELEMETRY.ENABLED) and/or metrics.jsonl"
+        )
+
+    # -- cross-rank step time + straggler skew ---------------------------
+    per_rank, pooled, source = {}, [], "step"
+    for rank, recs in sorted(ranks.items()):
+        durs, src = _step_durs(recs, phase)
+        if not durs:
+            continue
+        source = src
+        per_rank[str(rank)] = _summary_ms(durs)
+        pooled.extend(durs)
+    rank_p50s = [s["p50_ms"] for s in per_rank.values() if s["count"]]
+    straggler = (
+        round(max(rank_p50s) / max(min(rank_p50s), 1e-9), 4)
+        if len(rank_p50s) >= 2 else 1.0
+    )
+
+    # -- data-wait fraction + throughput ---------------------------------
+    data_wait_frac = None
+    img_per_sec = None
+    timeline = [r for r in metrics if r.get("kind") == "timeline"]
+    if timeline:
+        import overlap_report
+
+        try:
+            att = overlap_report.attribute(timeline, phase=phase)
+            data_wait_frac = att["data_wait_frac"]
+            img_per_sec = att["img_per_sec"]
+        except ValueError:
+            pass
+    if data_wait_frac is None:
+        fracs = [
+            f for f in (
+                _wait_frac_from_spans(recs, phase) for recs in ranks.values()
+            ) if f is not None
+        ]
+        if fracs:
+            data_wait_frac = round(sum(fracs) / len(fracs), 4)
+
+    # -- recompiles / checkpoints / resilience events --------------------
+    compiles = {"count": 0, "wall_s": 0.0}
+    ckpt = {"saves": 0, "save_mean_s": 0.0, "save_max_s": 0.0,
+            "restores": 0, "restore_mean_s": 0.0}
+    saves, restores = [], []
+    for recs in ranks.values():
+        for r in recs:
+            if r.get("kind") == "compile":
+                compiles["count"] += 1
+                compiles["wall_s"] += float(r["dur_s"])
+        saves += [float(r["dur"]) for r in _spans(recs, "ckpt_save")]
+        restores += [float(r["dur"]) for r in _spans(recs, "ckpt_restore")]
+    compiles["wall_s"] = round(compiles["wall_s"], 3)
+    if saves:
+        ckpt.update(saves=len(saves),
+                    save_mean_s=round(sum(saves) / len(saves), 3),
+                    save_max_s=round(max(saves), 3))
+    if restores:
+        ckpt.update(restores=len(restores),
+                    restore_mean_s=round(sum(restores) / len(restores), 3))
+
+    report = {
+        "schema": REPORT_SCHEMA,
+        "run_dir": os.path.abspath(run_dir),
+        "phase": phase,
+        "n_ranks": len(ranks),
+        "step_source": source,
+        "step": _summary_ms(pooled),
+        "per_rank_step": per_rank,
+        "straggler_skew": straggler,
+        "data_wait_frac": data_wait_frac,
+        "img_per_sec": img_per_sec,
+        "events": _count_events(ranks, metrics),
+        "recompiles": compiles,
+        "checkpoint": ckpt,
+    }
+    return report
+
+
+# ------------------------------------------------------------- comparison
+def comparable_metrics(doc: dict) -> dict:
+    """Flatten a baseline/current document into the named comparison
+    metrics. Accepts a RUN_REPORT.json (ours) or a repo BENCH_*.json
+    artifact (``parsed.metric``/``value`` — img/s becomes the throughput
+    reference; its other fields have no counterpart here)."""
+    out = {}
+    if "step" in doc and isinstance(doc.get("step"), dict):
+        for q in ("p50", "p90", "p99"):
+            v = doc["step"].get(f"{q}_ms")
+            if v:
+                out[f"step_ms_{q}"] = float(v)
+        if doc.get("straggler_skew") is not None:
+            out["straggler_skew"] = float(doc["straggler_skew"])
+        if doc.get("data_wait_frac") is not None:
+            out["data_wait_frac"] = float(doc["data_wait_frac"])
+        if doc.get("img_per_sec"):
+            out["img_per_sec"] = float(doc["img_per_sec"])
+        rc = doc.get("recompiles", {})
+        if rc:
+            out["recompiles"] = float(rc.get("count", 0))
+        ck = doc.get("checkpoint", {})
+        if ck.get("saves"):
+            out["ckpt_save_max_s"] = float(ck["save_max_s"])
+    parsed = doc.get("parsed")
+    if parsed and "value" in parsed:
+        metric = str(parsed.get("metric", ""))
+        if "images_per_sec" in metric or "img_per_sec" in metric:
+            out["img_per_sec"] = float(parsed["value"])
+    return out
+
+
+def compare(current: dict, baseline: dict, tol_pct: float,
+            tol_overrides: dict[str, float]) -> dict:
+    """Direction-aware regression check over the metrics both sides
+    have. Returns {"ok", "checked", "rows": [...]}; a row FAILs when the
+    current value is worse than baseline by more than its tolerance."""
+    cur = comparable_metrics(current)
+    base = comparable_metrics(baseline)
+    rows = []
+    for name in sorted(set(cur) & set(base)):
+        b, c = base[name], cur[name]
+        tol = tol_overrides.get(name, tol_pct)
+        delta_pct = (c - b) / abs(b) * 100.0 if b else (100.0 if c else 0.0)
+        if name in HIGHER_BETTER:
+            ok = c >= b * (1.0 - tol / 100.0)
+        else:
+            ok = c <= b * (1.0 + tol / 100.0)
+        rows.append({
+            "metric": name, "baseline": b, "current": c,
+            "delta_pct": round(delta_pct, 2), "tol_pct": tol, "ok": ok,
+            "direction": "higher" if name in HIGHER_BETTER else "lower",
+        })
+    return {
+        "ok": all(r["ok"] for r in rows),
+        "checked": len(rows),
+        "rows": rows,
+    }
+
+
+# ---------------------------------------------------------------- output
+def _print_report(rep: dict) -> None:
+    print(f"run {rep['run_dir']}  phase={rep['phase']}  "
+          f"ranks={rep['n_ranks']}  (step spans: {rep['step_source']})")
+    s = rep["step"]
+    print(f"{'step time':<24}{'count':>8}{'mean':>10}{'p50':>10}"
+          f"{'p90':>10}{'p99':>10}{'max':>10}   (ms)")
+    print(f"{'  all ranks':<24}{s['count']:>8}{s['mean_ms']:>10.3f}"
+          f"{s['p50_ms']:>10.3f}{s['p90_ms']:>10.3f}{s['p99_ms']:>10.3f}"
+          f"{s['max_ms']:>10.3f}")
+    for rank, rs in sorted(rep["per_rank_step"].items(), key=lambda kv: int(kv[0])):
+        print(f"{'  rank ' + rank:<24}{rs['count']:>8}{rs['mean_ms']:>10.3f}"
+              f"{rs['p50_ms']:>10.3f}{rs['p90_ms']:>10.3f}"
+              f"{rs['p99_ms']:>10.3f}{rs['max_ms']:>10.3f}")
+    print(f"straggler_skew (p50 max/min): {rep['straggler_skew']}")
+    dwf = rep["data_wait_frac"]
+    ips = rep["img_per_sec"]
+    print(f"data_wait_frac: {'n/a' if dwf is None else dwf}"
+          + (f"   img_per_sec: {ips}" if ips else ""))
+    ev = rep["events"]
+    print(f"resilience events: stall={ev['stall']} "
+          f"data_error={ev['data_error']} nonfinite={ev['nonfinite']}")
+    rc = rep["recompiles"]
+    print(f"recompiles: {rc['count']} ({rc['wall_s']}s)")
+    ck = rep["checkpoint"]
+    print(f"checkpoints: {ck['saves']} saves "
+          f"(mean {ck['save_mean_s']}s, max {ck['save_max_s']}s), "
+          f"{ck['restores']} restores (mean {ck['restore_mean_s']}s)")
+
+
+def _print_compare(cmp: dict, baseline_path: str) -> None:
+    print(f"\nregression gate vs {baseline_path}:")
+    print(f"{'metric':<18}{'baseline':>12}{'current':>12}{'delta%':>9}"
+          f"{'tol%':>7}{'dir':>8}  verdict")
+    for r in cmp["rows"]:
+        verdict = "PASS" if r["ok"] else "FAIL"
+        print(f"{r['metric']:<18}{r['baseline']:>12.3f}{r['current']:>12.3f}"
+              f"{r['delta_pct']:>9.2f}{r['tol_pct']:>7.1f}"
+              f"{r['direction']:>8}  {verdict}")
+    if not cmp["rows"]:
+        print("  (no overlapping metrics — nothing gated)")
+    print("gate:", "PASS" if cmp["ok"] else "FAIL")
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(
+        description=__doc__, formatter_class=argparse.RawDescriptionHelpFormatter
+    )
+    ap.add_argument("run_dir", nargs="?", default=None,
+                    help="finished run OUT_DIR (telemetry/ + metrics.jsonl)")
+    ap.add_argument("--trace", nargs="?", const="__default__", default=None,
+                    metavar="RUN_DIR",
+                    help="also export the merged Perfetto trace "
+                         "(trace.json in the run dir); the run dir may be "
+                         "given here instead of positionally")
+    ap.add_argument("--phase", default="train", choices=["train", "eval"])
+    ap.add_argument("--json-out", default=None,
+                    help="report destination (default {run}/RUN_REPORT.json)")
+    ap.add_argument("--compare", default=None, metavar="BASELINE.json",
+                    help="regression-gate against a RUN_REPORT.json or "
+                         "BENCH_*.json; exit 1 on any FAIL")
+    ap.add_argument("--tol-pct", type=float, default=10.0,
+                    help="global regression tolerance percent (default 10)")
+    ap.add_argument("--tol", action="append", default=[], metavar="METRIC=PCT",
+                    help="per-metric tolerance override (repeatable), e.g. "
+                         "--tol img_per_sec=5")
+    args = ap.parse_args(argv)
+
+    run_dir = args.run_dir
+    if run_dir is None and args.trace not in (None, "__default__"):
+        run_dir = args.trace  # `run_report.py --trace out/` one-command form
+    if run_dir is None or not os.path.isdir(run_dir):
+        ap.error(f"need a run directory (got {run_dir!r})")
+
+    tol_overrides = {}
+    for item in args.tol:
+        name, _, pct = item.partition("=")
+        if not pct:
+            ap.error(f"--tol wants METRIC=PCT, got {item!r}")
+        tol_overrides[name] = float(pct)
+
+    try:
+        report = build_report(run_dir, phase=args.phase)
+    except FileNotFoundError as e:
+        raise SystemExit(str(e))
+
+    if args.trace is not None:
+        trace_path = export.export_trace(run_dir)
+        n_tracks = len(report["per_rank_step"]) or report["n_ranks"]
+        print(f"merged Perfetto trace -> {trace_path} "
+              f"({n_tracks or 1} rank track(s); open at ui.perfetto.dev)")
+
+    exit_code = 0
+    if args.compare:
+        with open(args.compare) as f:
+            baseline = json.load(f)
+        cmp = compare(report, baseline, args.tol_pct, tol_overrides)
+        report["compare"] = {"baseline": os.path.abspath(args.compare), **cmp}
+        if not cmp["ok"]:
+            exit_code = 1
+
+    out_path = args.json_out or os.path.join(run_dir, "RUN_REPORT.json")
+    with open(out_path, "w") as f:
+        json.dump(report, f, indent=1)
+    _print_report(report)
+    if args.compare:
+        _print_compare(report["compare"], args.compare)
+    print(f"report -> {out_path}")
+    return exit_code
+
+
+if __name__ == "__main__":
+    sys.exit(main())
