@@ -496,6 +496,50 @@ _C.SERVE.DEVICE = 0
 _C.SERVE.HOST = "127.0.0.1"
 _C.SERVE.PORT = 8765
 
+# Serving fleet (serve/fleet/, `serve_net.py --fleet N`): a shared-nothing
+# replica pool behind a router process. The router owns SERVE.HOST:PORT;
+# each replica is a full serve_net engine in its own process on an
+# ephemeral port, dispatched to by least-loaded policy (router in-flight
+# depth + replica queue depth + occupancy + EWMA latency), with idempotent
+# retry on replica failure and verbatim backpressure passthrough when the
+# whole fleet is saturated.
+_C.SERVE.FLEET = CfgNode()
+# Initial replica count (`--fleet N` overrides). The autoscaler moves the
+# target inside [MIN_REPLICAS, MAX_REPLICAS]; the pool keeps the target
+# met (dead replicas are replaced automatically).
+_C.SERVE.FLEET.REPLICAS = 2
+_C.SERVE.FLEET.MIN_REPLICAS = 1
+_C.SERVE.FLEET.MAX_REPLICAS = 4
+# Autoscale-from-telemetry policy loop (fleet/autoscale.py): add a replica
+# after BREACH_N consecutive windows with fleet p99 over P99_TARGET_MS or
+# total queued work over QUEUE_HIGH; remove one after BREACH_N consecutive
+# calm windows (p99 under SCALE_DOWN_FRAC x target AND queue under
+# QUEUE_LOW); COOLDOWN_S of hysteresis after every action. False pins the
+# fleet at its launch size (the pool still replaces dead replicas).
+_C.SERVE.FLEET.AUTOSCALE = True
+_C.SERVE.FLEET.P99_TARGET_MS = 250.0
+_C.SERVE.FLEET.QUEUE_HIGH = 32
+_C.SERVE.FLEET.QUEUE_LOW = 2
+_C.SERVE.FLEET.SCALE_DOWN_FRAC = 0.5
+_C.SERVE.FLEET.BREACH_N = 3
+_C.SERVE.FLEET.EVAL_PERIOD_S = 2.0
+_C.SERVE.FLEET.COOLDOWN_S = 10.0
+# Replica health-checking (fleet/pool.py): a stats probe every
+# HEALTH_PERIOD_S; HEALTH_FAILS consecutive failures (or process exit)
+# marks the replica dead, removes it from routing, and spawns its
+# replacement. WARMUP_TIMEOUT_S bounds how long a fresh replica may take
+# to AOT-compile its bucket shapes before it is abandoned — a replica is
+# never routable before its warm-up probe reports every bucket compiled.
+_C.SERVE.FLEET.HEALTH_PERIOD_S = 1.0
+_C.SERVE.FLEET.HEALTH_FAILS = 3
+_C.SERVE.FLEET.WARMUP_TIMEOUT_S = 180.0
+# Per-request router->replica socket timeout; a replica that sits on one
+# request longer than this is treated as failed (the request reroutes).
+_C.SERVE.FLEET.REQUEST_TIMEOUT_S = 60.0
+# Fleet telemetry cadence: kind="fleet.stats"/"fleet.replica" records
+# into the router's per-rank telemetry sink every EMIT_INTERVAL_S.
+_C.SERVE.FLEET.EMIT_INTERVAL_S = 10.0
+
 # ------------------------------- telemetry -----------------------------------
 # Unified telemetry layer (distribuuuu_tpu/telemetry/): per-rank JSONL
 # event files ({OUT_DIR}/telemetry/rank*.jsonl — spans, compile events,

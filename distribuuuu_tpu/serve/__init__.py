@@ -7,9 +7,12 @@ bucket shapes, double-buffered dispatch, per-request futures),
 ``admission.py`` (bounded-queue backpressure + SIGTERM graceful drain),
 ``metrics.py`` (latency histograms / occupancy / throughput into the
 jsonlog sink), ``protocol.py`` (length-prefixed socket frontend + batch
-mode). Entry points: ``serve_net.py`` (the CLI sibling of
-``train_net.py``/``test_net.py``) and ``tools/serve_bench.py`` (the
-closed/open-loop load generator).
+mode + stats control frames), ``fleet/`` (the multi-replica serving
+fleet: least-loaded router, warm-up-gated replica pool, autoscaler —
+``serve_net.py --fleet N``). Entry points: ``serve_net.py`` (the CLI
+sibling of ``train_net.py``/``test_net.py``) and
+``tools/serve_bench.py`` (the closed/open-loop load generator, fleet
+scaling bench via ``--fleet``).
 """
 
 from distribuuuu_tpu.serve.admission import (  # noqa: F401

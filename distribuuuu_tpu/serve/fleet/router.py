@@ -1,0 +1,455 @@
+"""Fleet router: least-loaded dispatch over a shared-nothing replica pool.
+
+The router is the process clients connect to (it owns ``SERVE.HOST:PORT``
+in ``serve_net.py --fleet``); replicas are full single-engine serve_net
+processes on ephemeral ports. Requests ride the existing length-prefixed
+framing (serve/protocol.py) end to end — the router forwards the raw
+payload bytes and the raw response bytes, so the val transform and the
+engine dtype contract run at the replica and the router stays thin (no
+jax, no PIL on the dispatch path).
+
+Dispatch policy, per request:
+
+1. **Least-loaded pick** — every routable replica carries a
+   ``LoadSnapshot``: router-tracked in-flight depth, plus the replica's
+   own queue depth / batch occupancy (from its Registry instruments,
+   polled by the pool's health probes over the stats control frame), plus
+   an EWMA of latencies the router itself observed. ``pick_replica`` is a
+   pure function over those snapshots (tests drive it with synthetic
+   ones).
+2. **Idempotent retry** — serving requests are read-only, so a transport
+   failure (replica died mid-request, connection refused) reroutes the
+   SAME payload to the next-best replica and marks the failed one
+   unroutable until a health probe clears it. ``fleet.rerouted`` counts
+   these.
+3. **Backpressure passthrough** — a replica's ``queue_full`` rejection is
+   not the router's cue to queue: it tries the remaining replicas, and
+   when EVERY routable replica rejects, the client receives the LAST
+   replica's retry-after rejection payload verbatim (byte-for-byte the
+   serve/admission.py shape). The router never holds a request queue of
+   its own — fleet-wide overload stays client-visible, bounded, and
+   honest, exactly like the single-replica engine's admission contract.
+
+Telemetry: the router owns a Registry (fleet.* counters + the fleet-wide
+latency histogram, plus one histogram per replica) and a recent-latency
+window for the autoscaler's p99 reads; ``emit_telemetry`` lands
+``kind="fleet.stats"`` / ``"fleet.replica"`` records in the per-rank sink
+(declared in telemetry/schema.py).
+"""
+
+from __future__ import annotations
+
+import json
+import socket
+import threading
+import time
+from dataclasses import dataclass, field
+
+from distribuuuu_tpu.serve import protocol
+from distribuuuu_tpu.telemetry.registry import Registry, percentile
+
+_ERROR_PREFIX = b'{"error"'
+# replica rejections the router may retry elsewhere (read-only requests):
+_BUSY_ERRORS = ("queue_full", "draining")
+
+
+# -- the least-loaded policy (pure; tests feed synthetic snapshots) ----------
+
+@dataclass
+class LoadSnapshot:
+    """One replica's load as the router sees it at pick time."""
+
+    inflight: int = 0        # router-tracked: dispatched minus answered
+    queue_depth: int = 0     # replica-reported (stats probe)
+    occupancy: float = 0.0   # replica-reported batch occupancy (0..1)
+    ewma_ms: float = 0.0     # router-observed EWMA request latency
+
+
+def load_score(snap: LoadSnapshot) -> float:
+    """Expected-wait proxy: queued work ahead of a new request (router
+    in-flight + replica queue) x the replica's recent per-request latency,
+    weighted up when its batches are running full (a saturated replica
+    drains slower than its EWMA suggests). Lower is better."""
+    depth = max(0, snap.inflight) + max(0, snap.queue_depth)
+    busy = 1.0 + max(0.0, min(1.0, snap.occupancy))
+    return (1.0 + depth) * busy * max(snap.ewma_ms, 0.1)
+
+
+def pick_replica(snaps: list[LoadSnapshot | None], rr: int = 0) -> int | None:
+    """Index of the least-loaded replica (None entries are unroutable).
+    Ties break round-robin via ``rr`` so equally-idle replicas share cold
+    traffic instead of replica 0 taking it all."""
+    best, best_score = None, None
+    n = len(snaps)
+    for k in range(n):
+        i = (rr + k) % n
+        if snaps[i] is None:
+            continue
+        s = load_score(snaps[i])
+        if best_score is None or s < best_score:
+            best, best_score = i, s
+    return best
+
+
+# -- one replica, as the router tracks it ------------------------------------
+
+@dataclass
+class Replica:
+    id: int
+    host: str
+    port: int
+    proc: object = None            # pool-owned process handle (or None)
+    routable: bool = False
+    warmed: bool = False           # warm-up completed at least once
+    warm_jit_compiles: int = 0     # jit.compiles baseline at warm-up
+    draining: bool = False
+    inflight: int = 0
+    ewma_ms: float = 0.0
+    requests: int = 0
+    stats: dict = field(default_factory=dict)  # last health-probe snapshot
+    fails: int = 0
+    _conns: list = field(default_factory=list)
+    _lock: threading.Lock = field(default_factory=threading.Lock)
+
+    @property
+    def addr(self) -> tuple[str, int]:
+        return (self.host, self.port)
+
+    def snapshot(self) -> LoadSnapshot | None:
+        if not self.routable or self.draining:
+            return None
+        return LoadSnapshot(
+            inflight=self.inflight,
+            queue_depth=int(self.stats.get("queue_depth", 0)),
+            occupancy=float(self.stats.get("batch_occupancy", 0.0)),
+            ewma_ms=self.ewma_ms,
+        )
+
+    def _get_conn(self, timeout: float) -> socket.socket:
+        with self._lock:
+            if self._conns:
+                return self._conns.pop()
+        conn = socket.create_connection(self.addr, timeout=timeout)
+        conn.settimeout(timeout)
+        return conn
+
+    def _put_conn(self, conn: socket.socket) -> None:
+        with self._lock:
+            self._conns.append(conn)
+
+    def close_conns(self) -> None:
+        with self._lock:
+            conns, self._conns = self._conns, []
+        for c in conns:
+            try:
+                c.close()
+            except OSError:
+                pass
+
+    def roundtrip(self, payload: bytes, timeout: float) -> bytes:
+        """One request/response over a pooled connection. Raises OSError
+        on any transport failure (the caller reroutes)."""
+        conn = self._get_conn(timeout)
+        try:
+            protocol.send_frame(conn, payload)
+            resp = protocol.recv_frame(conn)
+        except (OSError, ValueError):
+            conn.close()
+            raise
+        if resp is None:  # replica closed mid-request
+            conn.close()
+            raise ConnectionResetError(f"replica {self.id} closed connection")
+        self._put_conn(conn)
+        return resp
+
+
+class NoRoutableReplicaError(RuntimeError):
+    """Every replica is dead, draining, or not yet warm."""
+
+
+class Router:
+    """Request dispatcher + fleet-wide observability. The pool
+    (fleet/pool.py) owns replica lifecycle and calls
+    ``add_replica``/``mark_routable``/``mark_draining``/``remove_replica``;
+    the router only routes."""
+
+    EWMA_ALPHA = 0.2
+
+    def __init__(self, *, request_timeout_s: float = 60.0,
+                 recent_window: int = 4096):
+        self._replicas: dict[int, Replica] = {}
+        self._lock = threading.Lock()
+        self._rr = 0
+        self._next_id = 0
+        self.request_timeout_s = float(request_timeout_s)
+        self.registry = Registry()
+        self._lat = self.registry.histogram("fleet.latency_s")
+        # (t_done, latency_s) ring for the autoscaler's windowed p99
+        self._recent: list[tuple[float, float]] = []
+        self._recent_cap = recent_window
+        self._t0 = time.perf_counter()
+
+    # -- replica membership (pool-driven) ---------------------------------
+    def add_replica(self, host: str, port: int, *, proc=None,
+                    replica_id: int | None = None) -> Replica:
+        """Register a replica in the NOT-routable (warming) state — the
+        pool flips it routable only after the warm-up probe confirms every
+        bucket shape is compiled."""
+        with self._lock:
+            rid = self._next_id if replica_id is None else int(replica_id)
+            self._next_id = max(self._next_id, rid + 1)
+            rep = Replica(id=rid, host=host, port=int(port), proc=proc)
+            self._replicas[rid] = rep
+            return rep
+
+    def mark_routable(self, rid: int) -> None:
+        with self._lock:
+            self._replicas[rid].routable = True
+
+    def mark_draining(self, rid: int) -> None:
+        """Stop routing NEW requests to a replica; in-flight ones finish
+        (the drain-before-exit half of a draining restart)."""
+        with self._lock:
+            rep = self._replicas.get(rid)
+            if rep is not None:
+                rep.draining = True
+
+    def remove_replica(self, rid: int) -> Replica | None:
+        with self._lock:
+            rep = self._replicas.pop(rid, None)
+        if rep is not None:
+            rep.close_conns()
+        return rep
+
+    def replicas(self) -> list[Replica]:
+        with self._lock:
+            return list(self._replicas.values())
+
+    def get_replica(self, rid: int) -> Replica | None:
+        with self._lock:
+            return self._replicas.get(rid)
+
+    def n_routable(self) -> int:
+        with self._lock:
+            return sum(
+                1 for r in self._replicas.values()
+                if r.routable and not r.draining
+            )
+
+    # -- dispatch ----------------------------------------------------------
+    def _pick(self, exclude: set[int]) -> Replica | None:
+        with self._lock:
+            reps = list(self._replicas.values())
+            snaps = [
+                (r.snapshot() if r.id not in exclude else None) for r in reps
+            ]
+            self._rr += 1
+            idx = pick_replica(snaps, rr=self._rr)
+            return None if idx is None else reps[idx]
+
+    def _note_failure(self, rep: Replica) -> None:
+        """Transport failure: stop routing to it now; the pool's health
+        probe decides dead-vs-transient and restores or replaces it."""
+        with self._lock:
+            rep.routable = False
+        rep.close_conns()
+        self.registry.counter("fleet.replica_failures").inc(1)
+
+    def _observe(self, rep: Replica, lat_s: float) -> None:
+        now = time.perf_counter()
+        with self._lock:
+            rep.requests += 1
+            rep.ewma_ms = (
+                lat_s * 1e3 if rep.ewma_ms == 0.0
+                else (1 - self.EWMA_ALPHA) * rep.ewma_ms
+                + self.EWMA_ALPHA * lat_s * 1e3
+            )
+            self._recent.append((now, lat_s))
+            if len(self._recent) > self._recent_cap:
+                del self._recent[: self._recent_cap // 4]
+        self._lat.observe(lat_s)
+        self.registry.histogram(f"fleet.replica{rep.id}.latency_s").observe(
+            lat_s
+        )
+        self.registry.counter("fleet.requests").inc(1)
+
+    def dispatch(self, payload: bytes) -> bytes:
+        """Route one request payload; returns the response payload.
+
+        Transport failures reroute (idempotent requests); fleet-wide
+        saturation returns the last replica's retry-after rejection
+        VERBATIM; a fleet with nothing routable returns a router-level
+        error record in the same JSON shape."""
+        t0 = time.perf_counter()
+        tried: set[int] = set()
+        last_busy: bytes | None = None
+        while True:
+            rep = self._pick(tried)
+            if rep is None:
+                break
+            with self._lock:
+                rep.inflight += 1
+            try:
+                resp = rep.roundtrip(payload, self.request_timeout_s)
+            except (OSError, ValueError):
+                self._note_failure(rep)
+                self.registry.counter("fleet.rerouted").inc(1)
+                tried.add(rep.id)
+                continue
+            finally:
+                with self._lock:
+                    rep.inflight -= 1
+            if resp.startswith(_ERROR_PREFIX):
+                try:
+                    err = json.loads(resp).get("error")
+                except (ValueError, AttributeError):
+                    err = None
+                if err in _BUSY_ERRORS:
+                    # this replica is saturated/draining — try the rest,
+                    # and keep its rejection for verbatim passthrough
+                    last_busy = resp
+                    tried.add(rep.id)
+                    continue
+            self._observe(rep, time.perf_counter() - t0)
+            return resp
+        if last_busy is not None:
+            self.registry.counter("fleet.rejected").inc(1)
+            return last_busy
+        self.registry.counter("fleet.unroutable").inc(1)
+        return json.dumps(
+            {"error": "no_routable_replicas", "retry_after_ms": 1000.0}
+        ).encode()
+
+    # -- observability -----------------------------------------------------
+    def window_stats(self, window_s: float) -> dict:
+        """Latency percentiles over the trailing ``window_s`` plus total
+        queued work — the autoscaler's observation."""
+        cut = time.perf_counter() - window_s
+        with self._lock:
+            lats = sorted(lat for (t, lat) in self._recent if t >= cut)
+            queue_depth = sum(
+                r.inflight + int(r.stats.get("queue_depth", 0))
+                for r in self._replicas.values()
+                if r.routable and not r.draining
+            )
+        return {
+            "samples": len(lats),
+            "p50_ms": round(percentile(lats, 0.50) * 1e3, 3),
+            "p90_ms": round(percentile(lats, 0.90) * 1e3, 3),
+            "p99_ms": round(percentile(lats, 0.99) * 1e3, 3),
+            "queue_depth": queue_depth,
+        }
+
+    def _counter(self, name: str) -> int:
+        return int(self.registry.counter(name).value)
+
+    def stats(self) -> dict:
+        """Fleet-wide + per-replica snapshot (the router's own stats
+        control-frame response, and what the fleet bench reads)."""
+        lat = self._lat.values()
+        with self._lock:
+            reps = list(self._replicas.values())
+        per_replica = [
+            {
+                "replica": r.id,
+                "port": r.port,
+                "routable": bool(r.routable and not r.draining),
+                "draining": r.draining,
+                "inflight": r.inflight,
+                "queue_depth": int(r.stats.get("queue_depth", 0)),
+                "occupancy": float(r.stats.get("batch_occupancy", 0.0)),
+                "ewma_ms": round(r.ewma_ms, 3),
+                "requests": r.requests,
+                "jit_compiles": int(r.stats.get("jit_compiles", 0)),
+                "warm_jit_compiles": r.warm_jit_compiles,
+                "aot_compiles": int(r.stats.get("aot_compiles", 0)),
+            }
+            for r in reps
+        ]
+        window = max(time.perf_counter() - self._t0, 1e-9)
+        return {
+            "replicas": len(reps),
+            "routable": sum(1 for p in per_replica if p["routable"]),
+            "requests": self._counter("fleet.requests"),
+            "rejected": self._counter("fleet.rejected"),
+            "rerouted": self._counter("fleet.rerouted"),
+            "unroutable": self._counter("fleet.unroutable"),
+            "replica_failures": self._counter("fleet.replica_failures"),
+            "throughput_rps": round(
+                self._counter("fleet.requests") / window, 2
+            ),
+            "p50_ms": round(percentile(lat, 0.50) * 1e3, 3),
+            "p90_ms": round(percentile(lat, 0.90) * 1e3, 3),
+            "p99_ms": round(percentile(lat, 0.99) * 1e3, 3),
+            "per_replica": per_replica,
+        }
+
+    def emit_telemetry(self) -> None:
+        """One ``fleet.stats`` + one ``fleet.replica`` per replica into the
+        per-rank telemetry sink (no-op until setup_telemetry ran)."""
+        from distribuuuu_tpu.telemetry import spans
+
+        snap = self.stats()
+        per_replica = snap.pop("per_replica")
+        spans.emit_event("fleet.stats", **snap)
+        for p in per_replica:
+            spans.emit_event("fleet.replica", **p)
+
+    # -- the client-facing accept loop ------------------------------------
+    def _handle_conn(self, conn: socket.socket) -> None:
+        with conn:
+            while True:
+                try:
+                    payload = protocol.recv_frame(conn)
+                except (OSError, ValueError):
+                    return
+                if payload is None:
+                    return
+                ctrl = (
+                    protocol.parse_ctrl(payload)
+                    if payload.startswith(protocol.CTRL_MAGIC[:1]) else None
+                )
+                if ctrl is not None:
+                    if ctrl.get("op") == "stats":
+                        resp = json.dumps(self.stats()).encode()
+                    else:
+                        resp = json.dumps(
+                            {"error": f"unknown control op {ctrl.get('op')!r}"}
+                        ).encode()
+                else:
+                    resp = self.dispatch(payload)
+                try:
+                    protocol.send_frame(conn, resp)
+                except OSError:
+                    return
+
+    def serve(self, listener: socket.socket, should_stop,
+              poll_s: float = 0.25, emit_interval_s: float = 0.0) -> None:
+        """Accept loop: one handler thread per client connection (each
+        multiplexes that client's requests over the fleet). Polls
+        ``should_stop()`` between accepts — the SIGTERM drain flag in
+        ``serve_net.py --fleet``."""
+        listener.settimeout(poll_s)
+        handlers: list[threading.Thread] = []
+        last_emit = time.perf_counter()
+        try:
+            while not should_stop():
+                if (
+                    emit_interval_s
+                    and time.perf_counter() - last_emit >= emit_interval_s
+                ):
+                    self.emit_telemetry()
+                    last_emit = time.perf_counter()
+                try:
+                    conn, _addr = listener.accept()
+                except socket.timeout:
+                    continue
+                t = threading.Thread(
+                    target=self._handle_conn, args=(conn,), daemon=True
+                )
+                t.start()
+                handlers.append(t)
+        finally:
+            listener.close()
+            for t in handlers:
+                t.join(timeout=5.0)

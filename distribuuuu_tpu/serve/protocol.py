@@ -29,6 +29,7 @@ from __future__ import annotations
 
 import io
 import json
+import os
 import socket
 import struct
 import sys
@@ -42,6 +43,44 @@ from distribuuuu_tpu.serve.admission import EngineClosedError, QueueFullError
 
 _NPY_MAGIC = b"\x93NUMPY"
 MAX_FRAME = 64 << 20  # refuse absurd frames before allocating for them
+
+# Control frames: a payload starting with this magic is a JSON control
+# request, not an image. The fleet layer (serve/fleet/) uses op="stats" as
+# the replica health/load endpoint — the pool's warm-up gate and health
+# probes, and the router's queue-depth/occupancy reads, all ride the same
+# length-prefixed connection clients use. The leading NUL byte cannot
+# occur in any image or .npy payload, so detection is unambiguous.
+CTRL_MAGIC = b"\x00DTPUCTL1"
+
+
+def ctrl_request(op: str, **fields) -> bytes:
+    """Encode a control request payload (send it with ``send_frame``)."""
+    return CTRL_MAGIC + json.dumps({"op": op, **fields}).encode()
+
+
+def parse_ctrl(payload: bytes) -> dict | None:
+    """The decoded control request, or None for a data (image) payload."""
+    if not payload.startswith(CTRL_MAGIC):
+        return None
+    return json.loads(payload[len(CTRL_MAGIC):])
+
+
+def replica_stats(engine) -> dict:
+    """The replica-side stats snapshot a ``ctrl_request("stats")`` returns:
+    the engine's metrics/queue view plus the process-global ``jit.compiles``
+    counter (telemetry/runtime.py's compile listener) — how the fleet
+    asserts zero steady-state recompiles across every replica."""
+    from distribuuuu_tpu.telemetry import registry as telemetry_registry
+
+    reg = telemetry_registry.get_registry()
+    out = engine.stats()
+    out.update(
+        pid=os.getpid(),
+        accepting=engine._admission.is_open,
+        jit_compiles=int(reg.counter("jit.compiles").value),
+        aot_compiles=int(reg.counter("serve.aot_compiles").value),
+    )
+    return out
 
 
 # -- framing ----------------------------------------------------------------
@@ -126,6 +165,17 @@ def _handle_conn(engine, conn: socket.socket, transform, topk: int) -> None:
                 return
             if payload is None:
                 return
+            ctrl = parse_ctrl(payload) if payload.startswith(CTRL_MAGIC[:1]) else None
+            if ctrl is not None:
+                if ctrl.get("op") == "stats":
+                    resp = replica_stats(engine)
+                else:
+                    resp = {"error": f"unknown control op {ctrl.get('op')!r}"}
+                try:
+                    send_frame(conn, json.dumps(resp).encode())
+                except OSError:
+                    return
+                continue
             try:
                 fut = engine.submit(transform(payload))
                 logits = fut.result()

@@ -32,6 +32,16 @@ KINDS: dict[str, frozenset] = {
         {"requests", "rejected", "batches", "throughput_rps", "p50_ms",
          "p90_ms", "p99_ms", "batch_occupancy"}
     ),
+    # -- serving fleet (serve/fleet/: router + pool + autoscaler) --------
+    "fleet.stats": frozenset(
+        {"replicas", "routable", "requests", "rejected", "rerouted",
+         "p50_ms", "p90_ms", "p99_ms"}
+    ),
+    "fleet.replica": frozenset(
+        {"replica", "routable", "inflight", "queue_depth", "ewma_ms",
+         "requests"}
+    ),
+    "fleet.scale": frozenset({"action", "reason", "n_before", "n_after"}),
     # -- resilience (rank-local: mirrored to the per-rank sink) ----------
     "stall": frozenset({"age_s", "count"}),
     "data_error": frozenset({"index", "attempts", "error"}),

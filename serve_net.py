@@ -8,9 +8,22 @@ predictions through the dynamic micro-batching engine
 (distribuuuu_tpu/serve/) over a length-prefixed socket. SIGTERM drains
 gracefully: stop accepting, finish every in-flight request, exit.
 
+``--fleet N`` runs an N-replica serving FLEET instead of one engine
+(distribuuuu_tpu/serve/fleet/): this process becomes the router on
+``SERVE.HOST:PORT`` (least-loaded dispatch, idempotent retry, verbatim
+backpressure passthrough) and spawns N replicas — each a plain
+``serve_net.py`` on an ephemeral port — warm-up gated, health-checked,
+and autoscaled against the ``SERVE.FLEET`` policy. SIGTERM drains the
+whole fleet: stop accepting, drain every replica, exit.
+
 Usage:
     # socket service (SERVE.* config node controls batching/port):
     python serve_net.py --cfg config/resnet50.yaml MODEL.WEIGHTS path/to/ckpt
+
+    # an autoscaling 2..4-replica fleet behind one router port
+    # (--fleet before the KEY VALUE overrides — those are greedy):
+    python serve_net.py --cfg config/resnet50.yaml --fleet 2 \\
+        MODEL.WEIGHTS path/to/ckpt SERVE.FLEET.MAX_REPLICAS 4
 
     # one-shot batch mode (tests/CI): val-transformed .npy in, logits out
     python serve_net.py --cfg config/resnet50.yaml \\
@@ -18,6 +31,7 @@ Usage:
 """
 
 import argparse
+import os
 import sys
 
 import distribuuuu_tpu.config as config
@@ -31,6 +45,11 @@ def main(argv=None):
     parser.add_argument(
         "--cfg", dest="cfg_file", required=True, type=str,
         help="Config file location",
+    )
+    parser.add_argument(
+        "--fleet", type=int, default=0, metavar="N",
+        help="run an N-replica fleet (router + pool + autoscaler) instead "
+             "of a single engine; 0 = single-replica mode",
     )
     parser.add_argument(
         "--batch-input", default=None,
@@ -50,6 +69,9 @@ def main(argv=None):
     cfg.merge_from_list(args.opts)
     cfg.freeze()
 
+    if args.fleet:
+        return run_fleet(args.fleet)
+
     from distribuuuu_tpu import telemetry, trainer
     from distribuuuu_tpu.serve import admission, engine_from_cfg, protocol
     from distribuuuu_tpu.utils.jsonlog import setup_metrics_log
@@ -57,9 +79,13 @@ def main(argv=None):
 
     setup_logger()
     logger = get_logger()
-    # per-rank telemetry (TELEMETRY node): serving is single-process, so
-    # rank 0 — bucket AOT compiles land as kind="compile" records
-    telemetry.setup_from_cfg(cfg)
+    # per-rank telemetry (TELEMETRY node): a standalone replica is rank 0;
+    # a fleet replica gets its rank from the pool (DTPU_REPLICA_RANK), so
+    # N replicas sharing OUT_DIR write N distinct telemetry sinks — bucket
+    # AOT compiles land as kind="compile" records per replica
+    telemetry.setup_from_cfg(
+        cfg, rank=int(os.environ.get("DTPU_REPLICA_RANK", "0"))
+    )
     engine = engine_from_cfg()
     logger.info(
         "serving %s: buckets %s compiled (%d shapes), max_wait %.1f ms, "
@@ -89,6 +115,56 @@ def main(argv=None):
         listener.close()
         engine.drain()
     logger.info("drained; exiting")
+
+
+def run_fleet(n: int):
+    """The ``--fleet N`` entrypoint: this process is the router; replicas
+    are child ``serve_net.py`` processes spawned from a dump of the merged
+    config (so every CLI override reaches them), each with its own
+    telemetry rank. SIGTERM drains the fleet end to end."""
+    from distribuuuu_tpu import telemetry
+    from distribuuuu_tpu.serve import admission, protocol
+    from distribuuuu_tpu.serve.fleet import FleetService
+    from distribuuuu_tpu.utils.jsonlog import setup_metrics_log
+    from distribuuuu_tpu.utils.logger import get_logger, setup_logger
+
+    setup_logger()
+    logger = get_logger()
+    telemetry.setup_from_cfg(cfg, rank=0)  # replicas take ranks 1..N
+    setup_metrics_log(cfg.OUT_DIR)
+    fleet_dir = os.path.join(cfg.OUT_DIR, "fleet")
+    os.makedirs(fleet_dir, exist_ok=True)
+    cfg_path = os.path.join(fleet_dir, "replica_cfg.yaml")
+    with open(cfg_path, "w") as f:
+        f.write(cfg.dump())
+
+    svc = FleetService(cfg, n, cfg_path=cfg_path)
+    logger.info(
+        "fleet: spawning %d replica(s) of %s (budget %d..%d, autoscale %s)",
+        n, cfg.MODEL.ARCH, cfg.SERVE.FLEET.MIN_REPLICAS,
+        cfg.SERVE.FLEET.MAX_REPLICAS, cfg.SERVE.FLEET.AUTOSCALE,
+    )
+    svc.start(wait=True)
+    routable = svc.router.n_routable()
+    if not routable:
+        svc.shutdown()
+        raise RuntimeError(
+            "fleet: no replica survived warm-up — see "
+            f"{fleet_dir}/replica*.log"
+        )
+    admission.install_drain()  # SIGTERM → drain the whole fleet
+    listener = protocol.open_listener(cfg.SERVE.HOST, cfg.SERVE.PORT)
+    host, port = listener.getsockname()[:2]
+    logger.info(
+        "fleet: router listening on %s:%d over %d routable replica(s) "
+        "(SIGTERM drains gracefully)", host, port, routable,
+    )
+    try:
+        svc.serve(listener, should_stop=admission.drain_requested)
+    except KeyboardInterrupt:
+        listener.close()
+    svc.shutdown()
+    logger.info("fleet drained; exiting")
 
 
 if __name__ == "__main__":

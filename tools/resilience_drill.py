@@ -33,6 +33,14 @@ way an operator would verify a production incident:
                         checkpoint commits → the restart must CONTINUE
                         epoch 1 from the saved batch cursor (not batch 0)
                         and complete, trajectory-continuous
+  fleet_replica_kill    a 2-replica serving fleet (serve/fleet/) under
+                        continuous client load: first a DRAINING restart
+                        of one replica (router stops routing → SIGTERM
+                        drain chain → replacement), then a SIGKILL of a
+                        replica mid-load → the router reroutes the
+                        in-flight requests (idempotent retry) and the
+                        pool replaces the dead replica — ZERO failed
+                        client requests across both
 
 Writes ``RESILIENCE_r01.json`` (``--out``) with per-drill ok/detail and
 ``all_ok``. A fast subset of the same recovery paths gates tier-1 in
@@ -456,6 +464,142 @@ def drill_shards_midepoch_resume(work):
     return all(checks.values()), checks
 
 
+@_drill("fleet_replica_kill")
+def drill_fleet_replica_kill(work):
+    """Serving-fleet fault drill: 2 replicas under continuous closed-loop
+    client load survive (a) a draining restart and (b) a SIGKILL of one
+    replica with ZERO failed client requests — the router reroutes
+    (requests are idempotent), the pool's supervision replaces the dead
+    replica, and the fleet returns to full strength. Runs the router and
+    pool in THIS process (they are plain sockets/subprocess code); only
+    the replicas are real serve_net.py processes."""
+    import threading
+
+    import numpy as np
+
+    import distribuuuu_tpu.config as config
+    from distribuuuu_tpu.config import cfg
+    from distribuuuu_tpu.serve.fleet import FleetService
+
+    config.reset_cfg()
+    cfg.MODEL.ARCH = "resnet18"
+    cfg.MODEL.NUM_CLASSES = 10
+    cfg.MODEL.BN_GROUP = 8
+    cfg.DEVICE.COMPUTE_DTYPE = "float32"
+    cfg.DEVICE.PLATFORM = "cpu"
+    cfg.TRAIN.IM_SIZE = 16
+    cfg.TEST.IM_SIZE = 16
+    cfg.RNG_SEED = 0
+    cfg.DATA.DEVICE_NORMALIZE = False  # float32 payloads, no PIL per request
+    cfg.OUT_DIR = os.path.join(work, "out")
+    cfg.SERVE.MAX_BATCH = 4
+    cfg.SERVE.MAX_WAIT_MS = 5.0
+    cfg.SERVE.MAX_QUEUE = 64
+    cfg.SERVE.FLEET.AUTOSCALE = False  # fixed target; supervision replaces
+    cfg.SERVE.FLEET.MAX_REPLICAS = 3
+    cfg.SERVE.FLEET.HEALTH_PERIOD_S = 0.5
+    cfg.SERVE.FLEET.HEALTH_FAILS = 4
+    cfg_path = os.path.join(work, "fleet_cfg.yaml")
+    with open(cfg_path, "w") as f:
+        f.write(cfg.dump())
+
+    # float32 pre-transformed request payloads (protocol's direct path)
+    import io as _io
+
+    rng = np.random.default_rng(0)
+    payloads = []
+    for _ in range(16):
+        buf = _io.BytesIO()
+        np.save(buf, rng.standard_normal((16, 16, 3)).astype(np.float32))
+        payloads.append(buf.getvalue())
+
+    svc = FleetService(cfg, 2, cfg_path=cfg_path, out_dir=work)
+    checks = {}
+    stop_load = threading.Event()
+    tallies = {"ok": 0, "failed": 0, "backoff": 0}
+    lock = threading.Lock()
+
+    def client(ci):
+        i = ci
+        while not stop_load.is_set():
+            resp = svc.router.dispatch(payloads[i % len(payloads)])
+            if resp.startswith(b'{"error"'):
+                err = json.loads(resp).get("error")
+                if err in ("queue_full", "draining", "no_routable_replicas"):
+                    # the admission contract: back off and retry the SAME
+                    # idempotent request — not a failure
+                    with lock:
+                        tallies["backoff"] += 1
+                    time.sleep(0.02)
+                    continue
+                with lock:
+                    tallies["failed"] += 1
+            else:
+                with lock:
+                    tallies["ok"] += 1
+            i += 4
+
+    try:
+        svc.start(wait=True)
+        checks["fleet_warm"] = svc.router.n_routable() == 2
+        if not checks["fleet_warm"]:
+            return False, checks
+        clients = [
+            threading.Thread(target=client, args=(ci,), daemon=True)
+            for ci in range(4)
+        ]
+        for t in clients:
+            t.start()
+        time.sleep(2.0)
+
+        # phase A: draining restart under load (the deploy recipe)
+        victim_a = svc.router.replicas()[0]
+        checks["drain_restart_ok"] = svc.pool.restart_replica(
+            victim_a.id, wait=True
+        )
+        checks["restored_after_drain"] = svc.router.n_routable() == 2
+        time.sleep(2.0)
+
+        # phase B: SIGKILL a replica mid-load (the hard crash)
+        victim_b = next(
+            r for r in svc.router.replicas()
+            if r.routable and r.proc is not None
+        )
+        victim_b.proc.kill()
+        deadline = time.time() + cfg.SERVE.FLEET.WARMUP_TIMEOUT_S
+        while time.time() < deadline and not (
+            svc.router.n_routable() == 2
+            and victim_b.id not in
+            {r.id for r in svc.router.replicas()}
+        ):
+            time.sleep(0.25)
+        checks["replaced_after_kill"] = svc.router.n_routable() == 2
+        checks["dead_replica_removed"] = victim_b.id not in {
+            r.id for r in svc.router.replicas()
+        }
+        time.sleep(2.0)
+        stop_load.set()
+        for t in clients:
+            t.join(timeout=30)
+        svc.pool.health_check()  # refresh every replica's stats snapshot
+        snap = svc.router.stats()
+        checks["rerouted>=1"] = snap["rerouted"] >= 1
+        checks["served>100"] = tallies["ok"] > 100
+        checks["zero_failed_requests"] = tallies["failed"] == 0
+        checks["zero_steady_state_recompiles"] = all(
+            p["jit_compiles"] == p["warm_jit_compiles"]
+            for p in snap["per_replica"]
+        )
+        ok = all(checks.values())
+        return ok, {**checks, "served": tallies["ok"],
+                    "backoffs": tallies["backoff"],
+                    "rerouted": snap["rerouted"]}
+    finally:
+        stop_load.set()
+        svc.shutdown()
+        config.reset_cfg()
+
+
 def main():
     ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
     ap.add_argument("--out", default="RESILIENCE_r01.json")
@@ -473,6 +617,7 @@ def main():
         drill_nan_skip, drill_nan_rollback,
         drill_decode_error_retry, drill_decode_error_skip,
         drill_stall_watchdog, drill_shards_midepoch_resume,
+        drill_fleet_replica_kill,
     ]
     if not args.skip_multiprocess:
         drills.append(drill_killed_rank)
