@@ -459,6 +459,21 @@ _C.FAULTS.STALL_S = 0.0
 # mid-epoch checkpoint (with the shards data cursor) is written. -1 = off.
 _C.FAULTS.PREEMPT_EPOCH = 0
 _C.FAULTS.PREEMPT_AT_BATCH = -1
+# Trigger RECOMPILE_N real backend compiles (trivial jits at distinct
+# shapes — genuine kind="compile" events, nothing feeds the train step)
+# at (RECOMPILE_EPOCH, RECOMPILE_AT_BATCH): the mid-run recompile storm
+# a shape leak or bad bucket config causes, injectable so the monitor's
+# recompile-storm alert is provable (tools/soak.py). -1 = off.
+_C.FAULTS.RECOMPILE_EPOCH = 0
+_C.FAULTS.RECOMPILE_AT_BATCH = -1
+_C.FAULTS.RECOMPILE_N = 8
+# Sleep SLOWDOWN_MS at EVERY batch boundary of SLOWDOWN_EPOCH — a
+# sustained host-side throughput regression (thermal throttle, noisy
+# neighbor, degraded storage) that must trip the monitor's
+# throughput-regression rule without tripping the stall watchdog
+# (keep SLOWDOWN_MS well under TRAIN.STALL_TIMEOUT). 0 = off.
+_C.FAULTS.SLOWDOWN_EPOCH = 0
+_C.FAULTS.SLOWDOWN_MS = 0.0
 # Truncate shard file #TRUNCATE_SHARD of the dataset split to 60% of its
 # manifest size before the reader opens it (DATA.FORMAT=shards): kills the
 # index footer and the tail records — the reader must recover the index by

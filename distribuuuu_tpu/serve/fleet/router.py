@@ -411,7 +411,15 @@ class Router:
                 )
                 if ctrl is not None:
                     if ctrl.get("op") == "stats":
-                        resp = json.dumps(self.stats()).encode()
+                        snap = self.stats()
+                        # a stats request carrying window_s also gets the
+                        # trailing-window latency view (the autoscaler's
+                        # observation) — the live monitor's p99 source
+                        if ctrl.get("window_s"):
+                            snap["window"] = self.window_stats(
+                                float(ctrl["window_s"])
+                            )
+                        resp = json.dumps(snap).encode()
                     else:
                         resp = json.dumps(
                             {"error": f"unknown control op {ctrl.get('op')!r}"}

@@ -12,9 +12,14 @@ one per-rank event stream that merges onto one timebase:
     runtime.py   jit-compile listener + per-epoch device memory stats
     schema.py    the declared kind registry (static + dynamic checks)
     export.py    N rank files + timeline records -> Perfetto trace JSON
+    live.py      the LIVE plane (ISSUE 7): streaming tailer, windowed
+                 aggregates, alert-rule engine, Prometheus exposition —
+                 tools/monitor.py's engine and soak.py's referee
 
 Consumers: tools/run_report.py (run health + regression gate),
-tools/check_telemetry_schema.py (tier-1 schema check), Perfetto.
+tools/monitor.py (live dashboard + alerting), tools/soak.py (train+serve
+soak referee), tools/check_telemetry_schema.py (tier-1 schema check),
+Perfetto.
 
 Hard contract: telemetry is trajectory-neutral — enabled vs disabled
 runs produce bit-identical training states (tests/test_telemetry.py).

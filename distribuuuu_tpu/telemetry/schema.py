@@ -52,6 +52,24 @@ KINDS: dict[str, frozenset] = {
     "registry": frozenset({"v", "counters", "gauges", "histograms"}),
     "compile": frozenset({"event", "dur_s", "mono"}),
     "memstats": frozenset({"device", "bytes_in_use", "peak_bytes_in_use"}),
+    # -- live observability plane (telemetry/live.py, tools/monitor.py) --
+    # one windowed aggregate per monitor tick (MONITOR.jsonl)
+    "monitor.snapshot": frozenset(
+        {"v", "window_s", "steps", "straggler_skew", "events", "compiles",
+         "totals"}
+    ),
+    # a rule firing (alert-rule engine; dedup'd per excursion)
+    "alert": frozenset({"rule", "value", "threshold", "message"}),
+    # -- soak referee (soak.py / tools/soak.py) --------------------------
+    # one per soak interval: injected fault class vs raised alerts + gate
+    "soak.interval": frozenset(
+        {"interval", "name", "expected_alerts", "raised_alerts", "ok"}
+    ),
+    # the final verdict record mirrored into SOAK_*.json
+    "soak.verdict": frozenset(
+        {"ok", "intervals", "alerts_exact", "control_clean",
+         "gates_evaluated"}
+    ),
 }
 
 

@@ -787,6 +787,8 @@ def train_epoch(loader, mesh, state, train_step, epoch: int, logger,
                 faults.maybe_stall(epoch, abs_it)  # injection no-ops (FAULTS.*)
                 faults.maybe_kill(epoch, abs_it)
                 faults.maybe_preempt(epoch, abs_it)
+                faults.maybe_recompile(epoch, abs_it)
+                faults.maybe_slowdown(epoch, abs_it)
                 data_time.update(time.perf_counter() - end)
                 is_last = abs_it + 1 == num_batches
                 # copy into the preallocated fold slot NOW (spreads the host
@@ -870,6 +872,8 @@ def train_epoch(loader, mesh, state, train_step, epoch: int, logger,
                 faults.maybe_stall(epoch, abs_it)  # injection no-ops (FAULTS.*)
                 faults.maybe_kill(epoch, abs_it)
                 faults.maybe_preempt(epoch, abs_it)
+                faults.maybe_recompile(epoch, abs_it)
+                faults.maybe_slowdown(epoch, abs_it)
                 data_time.update(tl["get1"] - tl["get0"])
                 prof.begin(abs_it)
                 tl["step0"] = time.perf_counter()

@@ -28,7 +28,15 @@ survive into a reproducible, config-driven event, so tests and
                          case: mid-epoch save with the shards data cursor);
   truncated shard        ``FAULTS.TRUNCATE_SHARD`` — cut a record shard
                          (DATA.FORMAT=shards) to 60% before the reader
-                         opens it: index-footer recovery + record skips.
+                         opens it: index-footer recovery + record skips;
+  recompile storm        ``FAULTS.RECOMPILE_AT_BATCH/RECOMPILE_N`` —
+                         N real backend compiles mid-run (trivial jits
+                         at distinct shapes; the shape-leak signature
+                         tools/monitor.py's recompile-storm rule flags);
+  sustained slowdown     ``FAULTS.SLOWDOWN_EPOCH/SLOWDOWN_MS`` — sleep
+                         at every batch boundary of one epoch (the
+                         throughput regression the monitor's
+                         throughput-regression rule flags).
 
 Every hook is a no-op (one attribute read) unless ``FAULTS.ENABLED`` —
 zero overhead in production paths.
@@ -45,7 +53,8 @@ from distribuuuu_tpu.config import cfg
 __all__ = [
     "InjectedFault", "enabled", "nan_injection_step", "maybe_decode_error",
     "maybe_kill", "maybe_stall", "maybe_corrupt_checkpoint",
-    "maybe_preempt", "maybe_truncate_shard", "reset",
+    "maybe_preempt", "maybe_truncate_shard", "maybe_recompile",
+    "maybe_slowdown", "reset",
 ]
 
 
@@ -54,7 +63,7 @@ class InjectedFault(RuntimeError):
 
 
 _state: dict = {"decode_raised": set(), "preempted": False,
-                "truncated_shards": set()}
+                "truncated_shards": set(), "recompiled": False}
 
 
 def reset() -> None:
@@ -62,6 +71,7 @@ def reset() -> None:
     _state["decode_raised"] = set()
     _state["preempted"] = False
     _state["truncated_shards"] = set()
+    _state["recompiled"] = False
 
 
 def enabled() -> bool:
@@ -149,6 +159,45 @@ def maybe_truncate_shard(split_dir: str) -> None:
     if os.path.isfile(path) and os.path.getsize(path) == meta["size"]:
         with open(path, "r+b") as f:
             f.truncate(max(1, int(meta["size"]) * 6 // 10))
+
+
+def maybe_recompile(epoch: int, batch: int) -> None:
+    """Trigger ``FAULTS.RECOMPILE_N`` REAL backend compiles at the
+    configured batch boundary: trivial jits at N distinct shapes, so the
+    telemetry compile listener records genuine ``kind="compile"`` events
+    — the mid-run recompile storm a shape leak causes — while training
+    math is untouched (nothing here feeds the train step). One-shot per
+    process."""
+    if not enabled() or cfg.FAULTS.RECOMPILE_AT_BATCH < 0:
+        return
+    if _state["recompiled"]:
+        return
+    if (
+        epoch != int(cfg.FAULTS.RECOMPILE_EPOCH)
+        or batch != int(cfg.FAULTS.RECOMPILE_AT_BATCH)
+    ):
+        return
+    _state["recompiled"] = True
+    import jax
+    import numpy as np
+
+    for i in range(max(1, int(cfg.FAULTS.RECOMPILE_N))):
+        # a fresh jit wrapper + a fresh shape per iteration: every call
+        # is a cache miss, every miss is one real backend compile
+        jax.jit(lambda x: x + 1.0)(
+            np.zeros((i + 2,), np.float32)
+        ).block_until_ready()
+
+
+def maybe_slowdown(epoch: int, batch: int) -> None:
+    """Sleep ``FAULTS.SLOWDOWN_MS`` at EVERY batch boundary of the
+    configured epoch — a sustained throughput regression (vs the
+    one-shot ``maybe_stall``, which must trip the watchdog instead).
+    Keep it well under TRAIN.STALL_TIMEOUT."""
+    if not enabled() or cfg.FAULTS.SLOWDOWN_MS <= 0:
+        return
+    if epoch == int(cfg.FAULTS.SLOWDOWN_EPOCH):
+        time.sleep(float(cfg.FAULTS.SLOWDOWN_MS) / 1e3)
 
 
 def maybe_stall(epoch: int, batch: int) -> None:

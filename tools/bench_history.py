@@ -1,0 +1,143 @@
+"""Fold the per-round bench artifacts into ONE machine-readable
+trajectory: ``BENCH_INDEX.json``.
+
+Five rounds of ``BENCH_r*.json`` (single-chip training throughput) plus
+``BENCH_serve.json`` (serving latency/throughput frontier + fleet
+scaling) each have their own ad-hoc shape; answering "how has img/s
+moved across PRs" meant opening five files. This tool scans them all and
+emits one index:
+
+    {"bench_index": 1,
+     "series": {
+        "<metric>": [{"round": "r01", "source": "BENCH_r01.json",
+                      "value": ..., "unit": ...}, ...],
+     }}
+
+Each series is ordered by round, with file provenance per point — the
+bench trajectory as data. ``tools/run_report.py --compare
+BENCH_INDEX.json`` accepts the index directly (the LATEST point of a
+throughput series becomes the regression reference), so the gate always
+tracks the newest committed bench without editing the gate call.
+
+    python tools/bench_history.py                 # scan repo root
+    python tools/bench_history.py --out BENCH_INDEX.json
+"""
+
+from __future__ import annotations
+
+import argparse
+import glob
+import json
+import os
+import re
+import sys
+
+INDEX_SCHEMA = 1
+
+
+def _round_of(path: str) -> str:
+    m = re.search(r"_r(\d+)\.json$", os.path.basename(path))
+    return f"r{int(m.group(1)):02d}" if m else os.path.basename(path)
+
+
+def _point(series: dict, metric: str, rnd: str, source: str, value,
+           unit: str | None = None) -> None:
+    if value is None:
+        return
+    series.setdefault(metric, []).append({
+        "round": rnd, "source": source, "value": float(value),
+        **({"unit": unit} if unit else {}),
+    })
+
+
+def index_train_bench(path: str, series: dict) -> None:
+    """BENCH_r*.json: the ``parsed`` block is the metric."""
+    with open(path) as f:
+        doc = json.load(f)
+    parsed = doc.get("parsed") or {}
+    if "metric" in parsed and "value" in parsed:
+        _point(series, str(parsed["metric"]), _round_of(path),
+               os.path.basename(path), parsed["value"], parsed.get("unit"))
+        if parsed.get("vs_baseline") is not None:
+            _point(series, f"{parsed['metric']}_vs_baseline",
+                   _round_of(path), os.path.basename(path),
+                   parsed["vs_baseline"], "x")
+
+
+def index_serve_bench(path: str, series: dict) -> None:
+    """BENCH_serve.json: the headline frontier numbers + the fleet
+    scaling section (nested shape, flattened to named series)."""
+    with open(path) as f:
+        doc = json.load(f)
+    src = os.path.basename(path)
+    rnd = "serve"
+    _point(series, "serve_dynamic_vs_batch1_at_top_load", rnd, src,
+           doc.get("dynamic_vs_batch1_at_top_load"), "x")
+    _point(series, "serve_batch1_single_stream_ms", rnd, src,
+           doc.get("batch1_single_stream_ms"), "ms")
+    closed = doc.get("closed_loop") or []
+    dyn = [r for r in closed if r.get("mode") == "dynamic"]
+    if dyn:
+        top = max(dyn, key=lambda r: r.get("throughput_rps", 0.0))
+        _point(series, "serve_closed_loop_peak_rps", rnd, src,
+               top.get("throughput_rps"), "req/s")
+        _point(series, "serve_closed_loop_peak_p99_ms", rnd, src,
+               top.get("p99_ms"), "ms")
+    fleet = doc.get("fleet") or {}
+    for row in fleet.get("points") or []:
+        n = row.get("replicas")
+        if n is None:
+            continue
+        _point(series, f"fleet_saturation_rps_{n}_replicas", rnd, src,
+               row.get("saturation_rps"), "req/s")
+        _point(series, f"fleet_p99_ms_{n}_replicas", rnd, src,
+               row.get("p99_ms"), "ms")
+    if fleet.get("fleet2_over_fleet1") is not None:
+        _point(series, "fleet2_over_fleet1_scaling", rnd, src,
+               fleet["fleet2_over_fleet1"], "x")
+
+
+def build_index(root: str) -> dict:
+    series: dict[str, list] = {}
+    train_files = sorted(glob.glob(os.path.join(root, "BENCH_r*.json")))
+    for path in train_files:
+        index_train_bench(path, series)
+    serve_path = os.path.join(root, "BENCH_serve.json")
+    if os.path.exists(serve_path):
+        index_serve_bench(serve_path, series)
+    for pts in series.values():
+        pts.sort(key=lambda p: p["round"])
+    return {
+        "bench_index": INDEX_SCHEMA,
+        "generated_by": "tools/bench_history.py",
+        "sources": [os.path.basename(p) for p in train_files]
+        + (["BENCH_serve.json"] if os.path.exists(serve_path) else []),
+        "series": series,
+    }
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--root", default=os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))), help="directory holding BENCH_*.json")
+    ap.add_argument("--out", default=None,
+                    help="index destination (default {root}/BENCH_INDEX.json)")
+    args = ap.parse_args(argv)
+    index = build_index(args.root)
+    if not index["series"]:
+        print(f"bench_history: no BENCH_*.json under {args.root}")
+        return 1
+    out = args.out or os.path.join(args.root, "BENCH_INDEX.json")
+    with open(out, "w") as f:
+        json.dump(index, f, indent=1)
+    n_pts = sum(len(v) for v in index["series"].values())
+    print(f"bench_history: {len(index['series'])} series, {n_pts} points "
+          f"from {len(index['sources'])} files -> {out}")
+    for name, pts in sorted(index["series"].items()):
+        tail = " -> ".join(f"{p['value']:g}@{p['round']}" for p in pts)
+        print(f"  {name}: {tail}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,35 @@
+"""Live run monitor CLI — watch a training run (and optionally a serve
+fleet) while it happens, instead of waiting for tools/run_report.py.
+
+Tails every rank's telemetry sink incrementally (plus the primary
+metrics.jsonl), maintains streaming windowed aggregates (cross-rank step
+p50/p90/p99 + straggler skew, data-wait fraction, compile deltas,
+resilience events, checkpoint durations, live throughput, serve
+p99/queue/occupancy via the stats control frame), evaluates the
+declarative alert rules each interval, and renders a terminal dashboard.
+Fired alerts land as ``kind="alert"`` records in ``{run}/MONITOR.jsonl``.
+
+    # watch a live run with the default rules, 5s windows:
+    python tools/monitor.py out/
+
+    # + fleet probe + Prometheus scrape endpoint on :9100:
+    python tools/monitor.py out/ --serve 127.0.0.1:8765 \\
+        --prometheus-port 9100
+
+    # validate a rules file without running anything (CI):
+    python tools/monitor.py --dry --rules config/monitor_rules.yaml
+
+The engine lives in ``distribuuuu_tpu/telemetry/live.py`` (installable
+entry point: ``distribuuuu-monitor``); this file is the in-repo CLI.
+docs/RUNBOOK.md "Watching a live run and responding to alerts" maps each
+alert kind to its symptom and the knob that fixes it.
+"""
+
+import sys
+
+import _path  # noqa: F401  (repo root onto sys.path)
+
+from distribuuuu_tpu.telemetry.live import main
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -26,9 +26,11 @@ Metrics:
 * **recompiles** — ``kind="compile"`` count + wall seconds per rank.
 * **checkpoints** — save/restore span count, mean, max.
 
-``--compare BASELINE.json`` accepts a previous ``RUN_REPORT.json`` or a
+``--compare BASELINE.json`` accepts a previous ``RUN_REPORT.json``, a
 repo ``BENCH_*.json`` artifact (its ``parsed.value`` img/s becomes the
-throughput reference). Direction-aware thresholds: ``--tol-pct`` (global,
+throughput reference), or the ``BENCH_INDEX.json`` trajectory written by
+``tools/bench_history.py`` (the latest point of each throughput series —
+the gate tracks the newest committed bench automatically). Direction-aware thresholds: ``--tol-pct`` (global,
 default 10%) and repeatable ``--tol METRIC=PCT`` overrides; any metric
 worse than its tolerance FAILs and the exit code is 1 — the CI gate
 (tests/test_telemetry.py exercises both directions against the committed
@@ -223,10 +225,19 @@ def build_report(run_dir: str, phase: str = "train") -> dict:
 # ------------------------------------------------------------- comparison
 def comparable_metrics(doc: dict) -> dict:
     """Flatten a baseline/current document into the named comparison
-    metrics. Accepts a RUN_REPORT.json (ours) or a repo BENCH_*.json
+    metrics. Accepts a RUN_REPORT.json (ours), a repo BENCH_*.json
     artifact (``parsed.metric``/``value`` — img/s becomes the throughput
-    reference; its other fields have no counterpart here)."""
+    reference), or a BENCH_INDEX.json trajectory
+    (tools/bench_history.py — the LATEST point of each throughput
+    series, so the gate tracks the newest committed bench)."""
     out = {}
+    if doc.get("bench_index"):
+        for metric, points in (doc.get("series") or {}).items():
+            if not points or metric.endswith("_vs_baseline"):
+                continue  # ratios are derived, not a throughput reference
+            if "images_per_sec" in metric or "img_per_sec" in metric:
+                out["img_per_sec"] = float(points[-1]["value"])
+        return out
     if "step" in doc and isinstance(doc.get("step"), dict):
         for q in ("p50", "p90", "p99"):
             v = doc["step"].get(f"{q}_ms")

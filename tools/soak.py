@@ -1,0 +1,33 @@
+"""Train+serve soak referee CLI (ROADMAP open item #5).
+
+Runs the continuous train+serve co-location scenario end to end: shards-
+backed training intervals with deterministic ``FAULTS.*`` injection
+(nonfinite, stall, recompile storm, sustained slowdown), a serving fleet
+answering Poisson background traffic the whole time (checkpoints hot-
+reloaded as epochs complete, zero dropped requests), and the live
+monitor (tools/monitor.py's engine) refereeing every interval — then
+writes the machine-readable verdict:
+
+* every injected fault class raised EXACTLY its expected alert,
+* the clean control interval raised none,
+* run_report regression gates evaluated per interval (regression
+  injections are expected to FAIL theirs — the gate catching them is
+  the proof),
+* the monitored control run is bit-identical to an unmonitored rerun.
+
+    python tools/soak.py --out SOAK_r01.json   # the full matrix
+    python tools/soak.py --smoke               # control + nonfinite only
+    python tools/soak.py --dry                 # validate config, no run
+
+The harness lives in ``distribuuuu_tpu/soak.py`` (installable entry
+point: ``distribuuuu-soak``); this file is the in-repo CLI.
+"""
+
+import sys
+
+import _path  # noqa: F401  (repo root onto sys.path)
+
+from distribuuuu_tpu.soak import main
+
+if __name__ == "__main__":
+    sys.exit(main())
