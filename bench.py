@@ -17,6 +17,7 @@ from __future__ import annotations
 
 import json
 import os
+import sys
 import time
 
 BASELINE_IMG_PER_SEC_PER_CHIP = 400.0  # A100 fp32 DDP resnet50 (see docstring)
@@ -24,17 +25,24 @@ BASELINE_IMG_PER_SEC_PER_CHIP = 400.0  # A100 fp32 DDP resnet50 (see docstring)
 # ResNet-50 @224²: 4.09 GMACs fwd (torchvision count) × 2 FLOPs/MAC ≈ 8.2
 # GFLOP; fwd+bwd ≈ 3× fwd. Convention: FLOPs = 2·MACs (the standard MFU
 # convention — see PERF.md "Where the time goes" for the derivation).
+# Since r10 this hand constant is the CROSS-CHECK, not the source: the
+# mfu field comes from XLA's own cost_analysis of the step program
+# (telemetry/costmodel.py); the bench warns and records flops_drift_pct
+# when the two disagree by more than DRIFT_WARN_PCT — the signal that
+# this table rotted as the model changed.
 RESNET50_TRAIN_FLOPS_PER_IMG = 3 * 2 * 4.09e9
+DRIFT_WARN_PCT = 5.0
 
-# Peak dense bf16 TFLOP/s by device kind (for the mfu field).
+# Peak dense bf16 FLOP/s by device kind: ONE table for the whole repo,
+# owned by telemetry/costmodel.py (DEVICE_PEAKS — adds HBM bandwidth and
+# capacity columns for the roofline/headroom ledger). PEAK_BF16 keeps
+# the historical name/shape for existing callers.
+from distribuuuu_tpu.telemetry import costmodel  # noqa: E402
+
 PEAK_BF16 = {
-    "TPU v4": 275e12,
-    "TPU v5 lite": 197e12,
-    "TPU v5e": 197e12,
-    "TPU v5": 459e12,
-    "TPU v5p": 459e12,
-    "TPU v6 lite": 918e12,
-    "TPU v6e": 918e12,
+    kind: entry["flops"]
+    for kind, entry in costmodel.DEVICE_PEAKS.items()
+    if kind != "cpu"  # nominal CPU peak is for off-chip roofline tests
 }
 
 
@@ -129,6 +137,25 @@ def build_workload(fold: int = 4, per_chip_batch: int = 128):
         box["state"] = st
         return dt
 
+    # XLA cost-model ledger of this workload (lowering only re-traces —
+    # no extra compile): the measured flops the mfu field is sourced
+    # from, extracted BEFORE the warmup donates the state buffers. The
+    # probe is a PER-STEP program, not the folded one — XLA cost
+    # analysis counts a lax.scan body once regardless of trip count, so
+    # the folded program cannot source per-step flops
+    # (telemetry/costmodel.py has the same rule). ``cost`` is per step
+    # of ``batch`` images; None when the backend omits cost keys —
+    # main() falls back to the hand table, flagged analytic.
+    cost = None
+    try:
+        probe_step = trainer.make_train_step(model, optimizer, topk=5)
+        single = jax.tree.map(lambda x: x[0], gbatch)  # one (batch,...) step
+        cost = costmodel.normalize_cost(
+            probe_step.lower(box["state"], single).cost_analysis()
+        )
+    except Exception:
+        cost = None
+
     # compile + warmup
     window(1)
     window(3)
@@ -139,6 +166,7 @@ def build_workload(fold: int = 4, per_chip_batch: int = 128):
         "fold": fold,
         "per_chip_batch": per_chip_batch,
         "device_kind": jax.devices()[0].device_kind,
+        "cost": cost,  # ONE optimizer step of `batch` images (see above)
     }
     return window, meta
 
@@ -226,10 +254,35 @@ def main():
         "fold": fold,
         "per_chip_batch": per_chip_batch,
     }
-    if peak:
-        out["mfu"] = round(
-            img_per_sec_per_chip * RESNET50_TRAIN_FLOPS_PER_IMG / peak, 4
-        )
+    # mfu: measured flops (XLA cost ledger of the very step program the
+    # window timed) over the device peak; the hand table is demoted to a
+    # cross-check — flops_drift_pct > ±5% means it rotted (satellite:
+    # the table no longer silently drifts as models change).
+    cost = meta.get("cost")
+    flops_per_img = None
+    if cost and cost.get("flops"):
+        flops_per_img = cost["flops"] / batch  # cost is per step (meta)
+        out["flops_per_img"] = round(flops_per_img, 1)
+        out["mfu_source"] = "xla"
+        if os.environ.get("DISTRIBUUUU_BENCH_ARCH", "resnet50") == "resnet50":
+            drift = costmodel.drift_pct(
+                flops_per_img, RESNET50_TRAIN_FLOPS_PER_IMG
+            )
+            out["flops_drift_pct"] = round(drift, 2)
+            if abs(drift) > DRIFT_WARN_PCT:
+                print(
+                    f"# WARNING: hand FLOP table drifted {drift:+.1f}% from "
+                    f"the XLA cost model ({flops_per_img / 1e9:.2f} vs "
+                    f"{RESNET50_TRAIN_FLOPS_PER_IMG / 1e9:.2f} GFLOP/img) — "
+                    "update RESNET50_TRAIN_FLOPS_PER_IMG",
+                    file=sys.stderr,
+                )
+    elif os.environ.get("DISTRIBUUUU_BENCH_ARCH", "resnet50") == "resnet50":
+        # backend omitted cost keys: analytic fallback, flagged
+        flops_per_img = RESNET50_TRAIN_FLOPS_PER_IMG
+        out["mfu_source"] = "analytic"
+    if peak and flops_per_img:
+        out["mfu"] = round(img_per_sec_per_chip * flops_per_img / peak, 4)
 
     # eval path (VERDICT r5 item 5): the inference forward test_model and
     # the serving engine run — its img/s/chip is serving's per-replica

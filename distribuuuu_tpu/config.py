@@ -578,6 +578,19 @@ _C.TELEMETRY.COMPILE_EVENTS = True
 # Sample device.memory_stats() per epoch (kind="memstats"; TPU/GPU
 # backends — the CPU backend reports none and is skipped).
 _C.TELEMETRY.MEMSTATS = True
+# XLA cost-model ledger (telemetry/costmodel.py): once per step program,
+# lower the jitted step and emit kind="cost.step"/"cost.roofline"
+# records (flops, bytes accessed, roofline position) from XLA's own
+# cost_analysis — the source run_report's MFU section and the monitor's
+# mfu-regression rule read. Lowering only re-traces; no extra compile.
+_C.TELEMETRY.COSTMODEL = True
+# Additionally AOT-compile the lowered step for memory_analysis()
+# (kind="cost.memory": executable HBM footprint vs capacity → headroom %
+# and the hbm-headroom-low rule). Costs ONE extra backend compile per
+# distinct step program at startup — disable for compile-latency-
+# sensitive runs; the serving engine's bucket ledger is unaffected (it
+# reads executables it already built).
+_C.TELEMETRY.COSTMODEL_MEMORY = True
 
 # ------------------------------- profiler ------------------------------------
 # jax.profiler trace capture (TensorBoard/XProf format). When enabled, the

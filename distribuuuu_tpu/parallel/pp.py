@@ -144,8 +144,12 @@ def pipeline_apply(
             microbatches, mb_idx, axis=0, keepdims=False
         )
         x = jnp.where(s == 0, x0, incoming)
+        # attribution scopes: stage compute vs the ppermute hop land
+        # named in HLO op metadata, so a device trace splits pipeline
+        # compute from the stage→stage+1 communication (trace_report)
         if stage_aux:
-            y, aux = stage_fn(my_params, x)
+            with jax.named_scope("pp_stage"):
+                y, aux = stage_fn(my_params, x)
             # stage s processes microbatch t−s at tick t; anything else
             # (fill for s>t, drain re-runs on clamped inputs) is schedule
             # garbage and must not pollute the statistics
@@ -155,7 +159,8 @@ def pipeline_apply(
                 aux_acc, aux,
             )
         else:
-            y = stage_fn(my_params, x)
+            with jax.named_scope("pp_stage"):
+                y = stage_fn(my_params, x)
         # the last stage finished microbatch t-(S-1) at this tick
         out_idx = t - (S - 1)
         valid = jnp.logical_and(s == S - 1, out_idx >= 0)
@@ -169,7 +174,8 @@ def pipeline_apply(
         )
         # hop to the next stage (the wrap S-1 → 0 carries garbage that stage
         # 0 never reads — it always selects the microbatch path)
-        incoming = jax.lax.ppermute(y, axis, perm)
+        with jax.named_scope("pp_hop"):
+            incoming = jax.lax.ppermute(y, axis, perm)
         if stage_aux:
             return (incoming, outputs, aux_acc), None
         return (incoming, outputs), None
@@ -186,8 +192,9 @@ def pipeline_apply(
 
     # broadcast last-stage outputs to every pipe rank so downstream loss /
     # metrics code is position-independent
-    outputs = jnp.where(s == S - 1, outputs, jnp.zeros_like(outputs))
-    outputs = jax.lax.psum(outputs, axis)
+    with jax.named_scope("pp_gather_out"):
+        outputs = jnp.where(s == S - 1, outputs, jnp.zeros_like(outputs))
+        outputs = jax.lax.psum(outputs, axis)
     if stage_aux:
         return outputs, jax.tree.map(lambda a: a / M, aux_acc)
     return outputs

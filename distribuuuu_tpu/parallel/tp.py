@@ -64,7 +64,10 @@ def constrain_like(tree, template_tree, template_shardings):
             )
         return node
 
-    return jax.tree.map(constrain, tree, is_leaf=is_param_shaped)
+    # attribution scope: the resharding collectives GSPMD derives from
+    # these constraints show up named in HLO op metadata (trace_report)
+    with jax.named_scope("tp_constrain"):
+        return jax.tree.map(constrain, tree, is_leaf=is_param_shaped)
 
 
 def param_shardings(mesh: Mesh, abstract_variables) -> Any:

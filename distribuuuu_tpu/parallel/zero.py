@@ -129,6 +129,15 @@ def zero_shardings(mesh: Mesh, base_shardings: Any, abstract_tree: Any) -> Any:
     return jax.tree.map(_one, base_shardings, abstract_tree)
 
 
-def constrain(tree: Any, shardings: Any) -> Any:
-    """with_sharding_constraint over a matching tree (call inside jit)."""
-    return jax.tree.map(jax.lax.with_sharding_constraint, tree, shardings)
+def constrain(tree: Any, shardings: Any, scope: str = "zero_constrain") -> Any:
+    """with_sharding_constraint over a matching tree (call inside jit).
+
+    ``scope`` names the attribution scope (jax.named_scope) the
+    constraint — and therefore the collective GSPMD derives from it
+    (reduce-scatter for the grad layout, all-gather for the rest
+    layout) — carries in HLO op metadata, so trace_report / Perfetto can
+    split comms from compute (callers pass e.g. "zero_reduce_scatter")."""
+    with jax.named_scope(scope):
+        return jax.tree.map(
+            jax.lax.with_sharding_constraint, tree, shardings
+        )

@@ -148,6 +148,18 @@ class Engine:
         telemetry_registry.get_registry().counter(
             "serve.aot_compiles"
         ).inc(self.n_compiles)
+        # cost-model ledger per bucket (telemetry/costmodel.py): flops /
+        # bytes / HBM footprint of each serving shape, read straight off
+        # the executables compiled above — no extra compile. The serve
+        # half of run_report's MFU/headroom section.
+        if cfg.TELEMETRY.COSTMODEL:
+            from distribuuuu_tpu.telemetry import costmodel
+
+            for b in self.buckets:
+                costmodel.capture_compiled(
+                    self._compiled[b], label=f"serve_bucket_{b}",
+                    phase="serve", images=b, arch=cfg.MODEL.ARCH,
+                )
 
         self._cond = threading.Condition()
         self._pending: deque[_Request] = deque()

@@ -15,6 +15,10 @@ one per-rank event stream that merges onto one timebase:
     live.py      the LIVE plane (ISSUE 7): streaming tailer, windowed
                  aggregates, alert-rule engine, Prometheus exposition —
                  tools/monitor.py's engine and soak.py's referee
+    costmodel.py the attribution plane (ISSUE 8): XLA cost/memory
+                 analysis per step program -> cost.* ledger records,
+                 measured MFU, roofline position, HBM headroom — the
+                 shared DEVICE_PEAKS table bench.py reads
 
 Consumers: tools/run_report.py (run health + regression gate),
 tools/monitor.py (live dashboard + alerting), tools/soak.py (train+serve

@@ -68,6 +68,8 @@ RULE_KINDS = (
     "straggler-skew",
     "p99-breach",
     "throughput-regression",
+    "mfu-regression",
+    "hbm-headroom-low",
 )
 
 _RANK_RE = re.compile(r"rank(\d+)\.jsonl$")
@@ -233,6 +235,7 @@ class _RankWindow:
     def __init__(self):
         self.step_durs: list[float] = []
         self.fold_durs: list[float] = []  # already ÷ n (per-step seconds)
+        self.steps = 0  # true optimizer steps (a fold span counts its n)
         self.images = 0
         self.wait_s = 0.0
         self.span_t0 = None  # pipeline-track coverage for wait fraction
@@ -261,6 +264,11 @@ class LiveAggregator:
         self._ckpt_saves: list[float] = []
         self._ckpt_restores: list[float] = []
         self._have_rank_sinks = False
+        # cost-model ledger state (run-scope: a run emits each cost.*
+        # record once, at first dispatch — it must survive window resets)
+        self._flops_per_step: float | None = None
+        self._peak_flops: float | None = None
+        self._headroom_by_label: dict[str, float] = {}
         # run-scope tallies (survive window resets)
         self.totals = {
             "steps": 0, "images": 0, "compiles": 0,
@@ -300,6 +308,23 @@ class LiveAggregator:
             except (KeyError, TypeError, ValueError):
                 pass
             return
+        if kind == "cost.step":
+            # per-step flops + the resolved peak, for the live MFU read
+            # (mfu-regression). Phase-matched; the latest record wins (a
+            # resharded run re-emits its ledger).
+            if rec.get("phase") == self.phase and rec.get("flops"):
+                self._flops_per_step = float(rec["flops"])
+                pk = rec.get("peak_flops")
+                self._peak_flops = float(pk) if pk else None
+            return
+        if kind == "cost.memory":
+            # headroom is per-executable; the alert cares about the
+            # tightest one (min over labels) — hbm-headroom-low
+            if rec.get("headroom_pct") is not None:
+                self._headroom_by_label[str(rec.get("label"))] = float(
+                    rec["headroom_pct"]
+                )
+            return
         if kind != "span":
             return
         name = rec.get("name")
@@ -323,6 +348,7 @@ class LiveAggregator:
             )
         if name == "step":
             win.step_durs.append(dur)
+            win.steps += 1
             win.images += int(rec.get("n", 0))
             self.totals["steps"] += 1
             self.totals["images"] += int(rec.get("n", 0))
@@ -333,6 +359,7 @@ class LiveAggregator:
             # None and rate rules sit out via min_steps
             n = max(1, int(rec.get("n", 1)))
             win.fold_durs.append(dur / n)
+            win.steps += n
             self.totals["steps"] += n
         elif name == "wait":
             win.wait_s += dur
@@ -355,11 +382,13 @@ class LiveAggregator:
         pooled: list[float] = []
         per_rank_p50: dict[str, float] = {}
         images = 0
+        true_steps = 0  # optimizer steps (fold spans count their n)
         active_t0, active_t1 = None, None
         wait_fracs: list[float] = []
         for rank, win in sorted(self._win.items()):
             durs = win.step_durs or win.fold_durs
             images += win.images
+            true_steps += win.steps
             if durs:
                 pooled.extend(durs)
                 per_rank_p50[str(rank)] = round(
@@ -388,6 +417,23 @@ class LiveAggregator:
         img_per_sec = None
         if images and active_t1 is not None and active_t1 > active_t0:
             img_per_sec = round(images / (active_t1 - active_t0), 2)
+        # live measured MFU over the step-active span: XLA flops/step
+        # (cost.step ledger) × window steps ÷ span ÷ mesh peak — the
+        # mfu-regression rule's input. None until both a ledger record
+        # and a known device peak have been seen.
+        mfu = None
+        if (
+            self._flops_per_step and self._peak_flops and true_steps
+            and active_t1 is not None and active_t1 > active_t0
+        ):
+            mfu = round(
+                self._flops_per_step * true_steps
+                / (active_t1 - active_t0) / self._peak_flops, 4
+            )
+        headroom = (
+            round(min(self._headroom_by_label.values()), 2)
+            if self._headroom_by_label else None
+        )
         snap = {
             "v": SNAPSHOT_SCHEMA,
             "window_s": round(float(window_s), 3),
@@ -395,6 +441,8 @@ class LiveAggregator:
             "steps": len(pooled),
             "images": images,
             "img_per_sec": img_per_sec,
+            "mfu": mfu,
+            "hbm_headroom_pct": headroom,
             "step": _summary_ms(pooled),
             "per_rank_p50_ms": per_rank_p50,
             "straggler_skew": straggler,
@@ -505,8 +553,9 @@ class AlertRule:
     min_steps        evaluate rate/skew rules only when the window saw at
                      least this many steps (default 1; filters windows a
                      run barely touches)
-    baseline         throughput-regression only: the reference img/s;
-                     the rule breaches when the live rate falls below
+    baseline         throughput-regression / mfu-regression: the
+                     reference img/s (resp. MFU); the rule breaches when
+                     the live value falls below
                      ``baseline × (1 − threshold/100)``. Omitted ⇒ the
                      rule is declared but dormant.
     steady_only      recompile-storm only (default true): ignore windows
@@ -643,16 +692,33 @@ class RuleEngine:
             if snap["steps"] < rule.min_steps or snap["img_per_sec"] is None:
                 return None
             return float(snap["img_per_sec"])
+        if rule.kind == "mfu-regression":
+            # live MFU (cost.step flops × steps / span / peak) below
+            # baseline × (1 − threshold%); dormant until a baseline MFU
+            # is set (soak/bench calibrate it) AND the run has emitted
+            # its cost ledger + a known device peak (mfu non-None)
+            if rule.baseline is None:
+                return None
+            if snap["steps"] < rule.min_steps or snap.get("mfu") is None:
+                return None
+            return float(snap["mfu"])
+        if rule.kind == "hbm-headroom-low":
+            # tightest executable headroom %; None until a cost.memory
+            # record arrived (insufficient signal ≠ calm)
+            hr = snap.get("hbm_headroom_pct")
+            return None if hr is None else float(hr)
         return None
 
     def _breached(self, rule: AlertRule, value: float) -> bool:
-        if rule.kind == "throughput-regression":
+        if rule.kind in ("throughput-regression", "mfu-regression"):
             return value < rule.baseline * (1.0 - rule.threshold / 100.0)
+        if rule.kind == "hbm-headroom-low":
+            return value <= rule.threshold  # threshold is the floor %
         return value >= rule.threshold
 
     def _limit(self, rule: AlertRule) -> float:
         """The effective breach boundary, for the alert record."""
-        if rule.kind == "throughput-regression":
+        if rule.kind in ("throughput-regression", "mfu-regression"):
             return round(rule.baseline * (1.0 - rule.threshold / 100.0), 3)
         return rule.threshold
 
@@ -705,6 +771,13 @@ class RuleEngine:
             return (f"throughput {value:.1f} img/s fell below "
                     f"{limit:.1f} (baseline {rule.baseline:.1f} "
                     f"- {rule.threshold:.0f}%)")
+        if rule.kind == "mfu-regression":
+            return (f"measured MFU {value:.4f} fell below {limit:.4f} "
+                    f"(baseline {rule.baseline:.4f} "
+                    f"- {rule.threshold:.0f}%)")
+        if rule.kind == "hbm-headroom-low":
+            return (f"HBM headroom {value:.1f}% at or under the "
+                    f"{limit:g}% floor (tightest executable)")
         unit = {"p99-breach": " ms", "straggler-skew": "x"}.get(rule.kind, "")
         return f"{rule.kind}: {value:g}{unit} >= {limit:g}{unit}"
 
@@ -751,6 +824,14 @@ def render_prometheus(snap: dict, engine: RuleEngine | None = None) -> str:
     gauge("dtpu_img_per_sec",
           snap["img_per_sec"] if snap["img_per_sec"] is not None else 0.0,
           "live throughput over the step-active span of the last window")
+    # cost-model gauges appear once the run has emitted its ledger
+    # (conditional like the serve block — absent, not 0, before then)
+    if snap.get("mfu") is not None:
+        gauge("dtpu_mfu", snap["mfu"],
+              "measured MFU over the last window (XLA cost-model flops)")
+    if snap.get("hbm_headroom_pct") is not None:
+        gauge("dtpu_hbm_headroom_pct", snap["hbm_headroom_pct"],
+              "tightest executable HBM headroom percent")
     counter("dtpu_steps_total", snap["totals"]["steps"],
             "steps observed since the monitor attached")
     counter("dtpu_recompiles_total", snap["totals"]["compiles"],
@@ -948,6 +1029,8 @@ def format_dashboard(snap: dict, engine: RuleEngine,
         f"  skew {snap['straggler_skew']:<7g}"
         f" wait_frac {snap['data_wait_frac'] if snap['data_wait_frac'] is not None else 'n/a'}"
         f"  img/s {snap['img_per_sec'] if snap['img_per_sec'] is not None else 'n/a'}"
+        f"  mfu {snap.get('mfu') if snap.get('mfu') is not None else 'n/a'}"
+        f"  hbm {str(snap['hbm_headroom_pct']) + '%' if snap.get('hbm_headroom_pct') is not None else 'n/a'}"
         f"  compiles +{snap['compiles']['count']}"
         f" (total {snap['totals']['compiles']})",
         "  events   "

@@ -52,6 +52,24 @@ KINDS: dict[str, frozenset] = {
     "registry": frozenset({"v", "counters", "gauges", "histograms"}),
     "compile": frozenset({"event", "dur_s", "mono"}),
     "memstats": frozenset({"device", "bytes_in_use", "peak_bytes_in_use"}),
+    # -- XLA cost-model ledger (telemetry/costmodel.py) ------------------
+    # per-step flops/bytes from cost_analysis (source "xla") or the hand
+    # table (source "analytic"); peak_flops is the full-mesh peak so
+    # post-mortem consumers (run_report, monitor) need no jax
+    "cost.step": frozenset(
+        {"v", "label", "phase", "flops", "images", "steps_per_call",
+         "peak_flops", "source"}
+    ),
+    # executable HBM footprint vs device capacity (memory_analysis)
+    "cost.memory": frozenset(
+        {"v", "label", "phase", "total_bytes", "capacity_bytes",
+         "headroom_pct", "source"}
+    ),
+    # arithmetic intensity vs the device ridge point
+    "cost.roofline": frozenset(
+        {"v", "label", "phase", "arithmetic_intensity", "ridge_intensity",
+         "bound", "source"}
+    ),
     # -- live observability plane (telemetry/live.py, tools/monitor.py) --
     # one windowed aggregate per monitor tick (MONITOR.jsonl)
     "monitor.snapshot": frozenset(

@@ -49,6 +49,7 @@ from distribuuuu_tpu.parallel import (
 from distribuuuu_tpu.resilience import manifest as manifest_lib, supervisor
 from distribuuuu_tpu import telemetry
 from distribuuuu_tpu.telemetry import (
+    costmodel,
     runtime as telemetry_runtime,
     spans as telemetry_spans,
 )
@@ -327,15 +328,20 @@ def _train_step_body(model, optimizer, topk: int, accum_steps: int = 1,
     def apply_grads(state, grads, new_stats, metrics):
         if layout is not None:
             # ZeRO: reduce-scatter the grad into the sharded update
-            grads = zero.constrain(grads, layout["grads"])
-        updates, new_opt_state = optimizer.update(
-            grads, state.opt_state, state.params
-        )
-        new_params = optax.apply_updates(state.params, updates)
+            grads = zero.constrain(
+                grads, layout["grads"], scope="zero_reduce_scatter"
+            )
+        with jax.named_scope("optimizer_update"):
+            updates, new_opt_state = optimizer.update(
+                grads, state.opt_state, state.params
+            )
+            new_params = optax.apply_updates(state.params, updates)
         if layout is not None:
             # pin rest layouts (stage 1: params re-gathered to replicated;
             # stage 3: params stay data-sharded) — keeps donation stable
-            new_params = zero.constrain(new_params, layout["params"])
+            new_params = zero.constrain(
+                new_params, layout["params"], scope="zero_rest_layout"
+            )
             new_opt_state = tp.constrain_like(
                 new_opt_state, grads, layout["opt"]
             )
@@ -362,13 +368,18 @@ def _train_step_body(model, optimizer, topk: int, accum_steps: int = 1,
 
     def loss_fn(params, stats, images, labels, key, step):
         images = prep_images(images)
-        logits, mutated = model.apply(
-            {"params": params, "batch_stats": stats},
-            images,
-            train=True,
-            mutable=["batch_stats", "intermediates", "moe_stats"],
-            rngs={"dropout": key},
-        )
+        # attribution scope: the forward (and, through autodiff's
+        # transpose, its backward as transpose(fwd)/...) is nameable in
+        # HLO op metadata — trace_report / Perfetto split compute from
+        # the collective/update scopes below
+        with jax.named_scope("fwd"):
+            logits, mutated = model.apply(
+                {"params": params, "batch_stats": stats},
+                images,
+                train=True,
+                mutable=["batch_stats", "intermediates", "moe_stats"],
+                rngs={"dropout": key},
+            )
         loss = cross_entropy(logits, labels)
         aux = jax.tree.leaves(mutated.get("intermediates", {}))
         if aux and moe_aux_weight:
@@ -500,11 +511,12 @@ def make_eval_step(model, topk: int):
     prep_images = _make_image_prep()
 
     def eval_step(state: TrainState, batch):
-        logits = model.apply(
-            {"params": state.params, "batch_stats": state.batch_stats},
-            prep_images(batch["image"]),
-            train=False,
-        )
+        with jax.named_scope("eval_fwd"):
+            logits = model.apply(
+                {"params": state.params, "batch_stats": state.batch_stats},
+                prep_images(batch["image"]),
+                train=False,
+            )
         mask = batch["mask"]
         logp = jax.nn.log_softmax(
             logits.astype(head_dtype(logits.dtype)), axis=-1
@@ -612,6 +624,33 @@ def _emit_batch_spans(phase: str, epoch: int, batch: int, tl: dict) -> None:
 
 def _step_spans_on() -> bool:
     return telemetry_spans.enabled() and cfg.TELEMETRY.STEP_SPANS
+
+
+def _capture_step_cost(step_fn, state, batch, *, label: str, phase: str,
+                       steps_per_call: int = 1, with_memory: bool | None = None,
+                       memory_only: bool = False) -> None:
+    """XLA cost-model ledger for one step program (telemetry/costmodel.py):
+    at the FIRST dispatch — state not yet donated, the live (state, batch)
+    supply exact shapes/shardings — lower the jitted step and emit
+    cost.step / cost.memory / cost.roofline records. Once per label per
+    process (costmodel dedups); never raises."""
+    if not (telemetry_spans.enabled() and cfg.TELEMETRY.COSTMODEL):
+        return
+    # every leading dim of the image leaf is batch-like: (batch,...) /
+    # (fold, batch, ...) / (fold, accum, micro, ...) — their product is
+    # the images per compiled call
+    lead = batch["image"].shape[:-3]
+    images_per_call = 1
+    for d in lead:
+        images_per_call *= int(d)
+    if with_memory is None:
+        with_memory = cfg.TELEMETRY.COSTMODEL_MEMORY
+    costmodel.capture_step(
+        step_fn, (state, batch), label=label, phase=phase,
+        images=max(1, images_per_call // max(1, steps_per_call)),
+        steps_per_call=steps_per_call, arch=cfg.MODEL.ARCH,
+        with_memory=with_memory, memory_only=memory_only,
+    )
 
 
 def train_epoch(loader, mesh, state, train_step, epoch: int, logger,
@@ -821,6 +860,26 @@ def train_epoch(loader, mesh, state, train_step, epoch: int, logger,
                 if n == fold:
                     batch = put_stacked(stack_buf)
                     inflight[buf_idx] = batch
+                    if "train_step" not in costmodel._seen_labels:
+                        # flops from the PER-STEP program (XLA cost
+                        # analysis counts a lax.scan body once regardless
+                        # of trip count — the folded program cannot
+                        # source per-step flops); lower-only, no compile
+                        _capture_step_cost(
+                            train_step, state,
+                            put_batch(jax.tree.map(
+                                lambda buf: buf[0], stack_buf
+                            )),
+                            label="train_step", phase="train",
+                            with_memory=False,
+                        )
+                    # HBM footprint of the folded program actually
+                    # running (memory_analysis is per-executable — real)
+                    _capture_step_cost(
+                        scan_step, state, batch, label="train_fold",
+                        phase="train", steps_per_call=fold,
+                        memory_only=True,
+                    )
                     prof.begin(done)
                     state, metrics = scan_step(state, batch)
                     prof.end(done + fold - 1, state)
@@ -829,6 +888,10 @@ def train_epoch(loader, mesh, state, train_step, epoch: int, logger,
                     for i in range(n):
                         hb = jax.tree.map(lambda buf: buf[i], stack_buf)
                         b = put_batch(hb)
+                        _capture_step_cost(
+                            train_step, state, b, label="train_step",
+                            phase="train",
+                        )
                         prof.begin(done + i)
                         state, metrics = train_step(state, b)
                         prof.end(done + i, state)
@@ -875,6 +938,10 @@ def train_epoch(loader, mesh, state, train_step, epoch: int, logger,
                 faults.maybe_recompile(epoch, abs_it)
                 faults.maybe_slowdown(epoch, abs_it)
                 data_time.update(tl["get1"] - tl["get0"])
+                _capture_step_cost(
+                    train_step, state, batch, label="train_step",
+                    phase="train",
+                )
                 prof.begin(abs_it)
                 tl["step0"] = time.perf_counter()
                 state, metrics = train_step(state, batch)
@@ -928,6 +995,9 @@ def validate(loader, mesh, state, eval_step, epoch: int, logger):
     for it, batch, tl in device_prefetch(
         loader, functools.partial(sharding_lib.shard_batch, mesh), depth
     ):
+        _capture_step_cost(
+            eval_step, state, batch, label="eval_step", phase="eval"
+        )
         tl["step0"] = time.perf_counter()
         m = eval_step(state, batch)
         totals = (

@@ -1,0 +1,655 @@
+"""Performance attribution plane (telemetry/costmodel.py — ISSUE 8):
+XLA cost/memory extraction on the CPU backend, the analytic-table
+fallback, MFU parity between the XLA ledger and bench.py's hand table
+for resnet50, HBM-ledger arithmetic, named-scope presence in compiled
+HLO (trainer phases + parallel/{zero,tp,pp} collectives), the two new
+monitor rules through the real RuleEngine, the run_report MFU/roofline/
+headroom section + compare gate both directions, the trace_report
+off-chip parser, and the committed COSTMODEL_r01.json covering every
+shipped arch YAML.
+"""
+
+from __future__ import annotations
+
+import glob
+import json
+import os
+import sys
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+import yaml
+
+import distribuuuu_tpu.config as config
+from distribuuuu_tpu.config import cfg
+from distribuuuu_tpu.parallel import mesh as mesh_lib, pp, tp, zero
+from distribuuuu_tpu.telemetry import costmodel, live, schema, spans
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, os.path.join(REPO, "tools"))
+
+import bench_history  # noqa: E402  (tools/, needs the path insert above)
+import run_report  # noqa: E402
+import trace_report  # noqa: E402
+
+
+@pytest.fixture(autouse=True)
+def _fresh_costmodel():
+    costmodel.reset()
+    yield
+    costmodel.reset()
+    spans.close_telemetry()
+
+
+# ------------------------------------------------- extraction on CPU
+def _toy_jit():
+    def f(x):
+        return jnp.tanh(x @ x).sum()
+
+    return jax.jit(f), (jnp.ones((128, 128), jnp.float32),)
+
+
+def test_cost_extraction_cpu_backend():
+    """The CPU backend implements cost_analysis: flops/bytes of a known
+    matmul come back in the right ballpark (2·n³ flops)."""
+    fn, args = _toy_jit()
+    out = costmodel.analyze_jitted(fn, args, with_memory=False)
+    cost = out["cost"]
+    assert cost is not None and cost["flops"] >= 2 * 128**3
+    assert cost["bytes_accessed"] > 0
+    assert out["memory"] is None  # not requested — no compile happened
+
+
+def test_memory_extraction_cpu_backend():
+    """memory_analysis works on CPU too; total_bytes is the live model
+    args + outputs − aliased + temps + generated code."""
+    fn, args = _toy_jit()
+    out = costmodel.analyze_jitted(fn, args, with_memory=True)
+    mem = out["memory"]
+    assert mem is not None
+    assert mem["argument_bytes"] == 128 * 128 * 4
+    assert mem["total_bytes"] == (
+        mem["argument_bytes"] + mem["output_bytes"] - mem["alias_bytes"]
+        + mem["temp_bytes"] + mem["generated_code_bytes"]
+    )
+
+
+def test_capture_step_emits_schema_valid_records(tmp_path):
+    """The trainer hook path: records land in the per-rank sink, are
+    schema-valid, and the label dedup makes the second capture a no-op."""
+    spans.setup_telemetry(str(tmp_path), rank=0)
+    fn, args = _toy_jit()
+    led = costmodel.capture_step(
+        fn, args, label="toy", phase="train", images=4, with_memory=True
+    )
+    assert led is not None and led["step"]["source"] == "xla"
+    assert costmodel.capture_step(
+        fn, args, label="toy", phase="train", images=4
+    ) is None  # dedup
+    spans.close_telemetry()
+    recs = [
+        json.loads(line)
+        for line in open(tmp_path / "rank00000.jsonl")
+    ]
+    kinds = [r["kind"] for r in recs]
+    assert {"cost.step", "cost.memory", "cost.roofline"} <= set(kinds)
+    for r in recs:
+        schema.validate_record(r)
+
+
+# ------------------------------------------------- analytic fallback
+def test_analytic_fallback_flagged():
+    """A backend that omits cost keys degrades to the hand table,
+    flagged source="analytic" — and normalize_cost rejects flops-less
+    analyses rather than emitting zeros."""
+    assert costmodel.normalize_cost({"bytes accessed": 10.0}) is None
+    assert costmodel.normalize_cost(None) is None
+    assert costmodel.normalize_cost([]) is None
+    led = costmodel.build_ledger(
+        "train_step", "train", None, None, images=2, arch="resnet50",
+        peaks=costmodel.peaks_for(), n_devices=1,
+    )
+    s = led["step"]
+    assert s["source"] == "analytic"
+    assert s["flops"] == pytest.approx(3 * 2 * 4.09e9 * 2)
+    # eval fallback is 1× fwd, not 3×
+    led_e = costmodel.build_ledger(
+        "eval_step", "eval", None, None, images=2, arch="resnet50",
+        peaks=None, n_devices=1,
+    )
+    assert led_e["step"]["flops"] == pytest.approx(2 * 4.09e9 * 2)
+    # an arch outside the table: no flops, still a valid flagged record
+    led_u = costmodel.build_ledger(
+        "train_step", "train", None, None, images=2, arch="vit_tiny",
+        peaks=None, n_devices=1,
+    )
+    assert led_u["step"]["source"] == "analytic"
+    assert led_u["step"]["flops"] is None
+
+
+# ------------------------------------------------- ledger arithmetic
+def test_hbm_ledger_arithmetic():
+    mem = {"argument_bytes": 300, "output_bytes": 200, "alias_bytes": 200,
+           "temp_bytes": 600, "generated_code_bytes": 100,
+           "total_bytes": 1000}
+    peaks = {"kind": "fake", "flops": 100.0, "bytes_per_s": 10.0,
+             "capacity_bytes": 4000, "capacity_source": "table",
+             "nominal": False}
+    led = costmodel.build_ledger(
+        "train_step", "train", {"flops": 100.0, "bytes_accessed": 20.0,
+                                "transcendentals": 0.0},
+        mem, images=1, peaks=peaks, n_devices=1,
+    )
+    assert led["memory"]["headroom_pct"] == pytest.approx(75.0)
+    # intensity 5 vs ridge 10 -> memory-bound
+    roof = led["roofline"]
+    assert roof["arithmetic_intensity"] == pytest.approx(5.0)
+    assert roof["ridge_intensity"] == pytest.approx(10.0)
+    assert roof["bound"] == "memory"
+    # flip the ratio -> compute-bound
+    led2 = costmodel.build_ledger(
+        "train_step", "train", {"flops": 400.0, "bytes_accessed": 20.0,
+                                "transcendentals": 0.0},
+        None, images=1, peaks=peaks, n_devices=1,
+    )
+    assert led2["roofline"]["bound"] == "compute"
+    # no capacity -> headroom undefined, not 100%
+    led3 = costmodel.build_ledger(
+        "train_step", "train", None, mem, images=1,
+        peaks={**peaks, "capacity_bytes": None}, n_devices=1,
+    )
+    assert led3["memory"]["headroom_pct"] is None
+
+
+def test_mfu_and_drift_helpers():
+    assert costmodel.mfu_value(50.0, 1.0, 100.0) == pytest.approx(0.5)
+    assert costmodel.mfu_value(None, 1.0, 100.0) is None
+    assert costmodel.mfu_value(50.0, 0.0, 100.0) is None
+    assert costmodel.drift_pct(105.0, 100.0) == pytest.approx(5.0)
+    assert costmodel.drift_pct(95.0, 100.0) == pytest.approx(-5.0)
+    assert costmodel.drift_pct(1.0, 0.0) == 0.0
+
+
+def test_peak_table_shared_with_bench():
+    """ONE peak table: bench.py's PEAK_BF16 is a view of DEVICE_PEAKS
+    (flops column, TPU kinds), so the two can never drift apart."""
+    import bench
+
+    for kind, flops in bench.PEAK_BF16.items():
+        assert costmodel.DEVICE_PEAKS[kind]["flops"] == flops
+    assert "cpu" not in bench.PEAK_BF16  # nominal CPU peak stays off-chip
+    # every TPU entry carries the roofline columns
+    for kind, entry in costmodel.DEVICE_PEAKS.items():
+        assert entry["flops"] > 0 and entry["bytes_per_s"] > 0
+
+
+# ------------------------------------------------- resnet50 MFU parity
+def test_mfu_parity_resnet50_with_hand_table():
+    """XLA-measured train flops/img for the REAL resnet50 step program
+    agree with bench.py's hand table (3 × 2 × 4.09 GMACs) within 10% —
+    the cross-check bench.py now records as flops_drift_pct."""
+    import bench
+
+    from distribuuuu_tpu import trainer
+    from distribuuuu_tpu.parallel import sharding as sharding_lib
+    from distribuuuu_tpu.utils.optim import construct_optimizer
+
+    config.reset_cfg()
+    cfg.MODEL.ARCH = "resnet50"
+    cfg.MODEL.NUM_CLASSES = 1000
+    mesh = mesh_lib.build_mesh(data=1, model=1, seq=1, pipe=1,
+                               devices=[jax.devices()[0]])
+    model = trainer.build_model_from_cfg()
+    state = trainer.create_train_state(model, jax.random.key(0), mesh, 224)
+    step = trainer.make_train_step(model, construct_optimizer(), topk=5)
+    batch = sharding_lib.shard_batch(mesh, {
+        "image": np.zeros((2, 224, 224, 3), np.float32),
+        "label": np.zeros((2,), np.int32),
+        "mask": np.ones((2,), np.float32),
+    })
+    cost = costmodel.normalize_cost(
+        step.lower(state, batch).cost_analysis()
+    )
+    assert cost is not None
+    flops_per_img = cost["flops"] / 2
+    drift = costmodel.drift_pct(
+        flops_per_img, bench.RESNET50_TRAIN_FLOPS_PER_IMG
+    )
+    assert abs(drift) < 10.0, (
+        f"hand FLOP table drifted {drift:.1f}% from the XLA cost model "
+        f"({flops_per_img / 1e9:.2f} vs "
+        f"{bench.RESNET50_TRAIN_FLOPS_PER_IMG / 1e9:.2f} GFLOP/img)"
+    )
+
+
+# ------------------------------------------------- named scopes in HLO
+def _lowered_debug_asm(lowered) -> str:
+    """Lowered StableHLO with debug locations — where jax.named_scope
+    names live before optimization (the SPMD partitioner may later elide
+    a pure layout op, but the scope is present in the lowered program,
+    which is what the profiler's op_name metadata is derived from)."""
+    return lowered.compiler_ir(dialect="stablehlo").operation.get_asm(
+        enable_debug_info=True
+    )
+
+
+def test_named_scopes_zero_and_tp_in_lowered_hlo():
+    """The attribution scopes threaded through parallel/{zero,tp}.py
+    land in the lowered program's locations, so trace_report / Perfetto
+    can split the derived collectives from compute."""
+    mesh = mesh_lib.build_mesh()  # 8-device data mesh (conftest)
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    sh = NamedSharding(mesh, P("data"))
+    repl = NamedSharding(mesh, P())
+
+    def f(tree):
+        g = zero.constrain(tree, {"w": sh}, scope="zero_reduce_scatter")
+        g = zero.constrain(g, {"w": repl}, scope="zero_rest_layout")
+        pinned = tp.constrain_like({"m": g}, g, {"w": repl})
+        return jax.tree.map(lambda x: x.sum(), pinned)
+
+    asm = _lowered_debug_asm(
+        jax.jit(f).lower({"w": jnp.ones((16384,), jnp.float32)})
+    )
+    for scope in ("zero_reduce_scatter", "zero_rest_layout", "tp_constrain"):
+        assert scope in asm, f"scope {scope!r} missing from lowered HLO"
+
+
+def test_named_scopes_pp_in_compiled_hlo():
+    """pp_stage / pp_hop / pp_gather_out name the pipeline schedule's
+    compute, ppermute hop, and output broadcast — these wrap REAL ops
+    (ppermute/psum), so they survive into the COMPILED program's
+    op_name metadata too (the strings the device profiler attaches)."""
+    mesh = mesh_lib.build_mesh(data=1, model=1, seq=1, pipe=8)
+
+    def stage_fn(params, x):
+        return jnp.tanh(x * params[0])
+
+    apply = pp.pipelined(
+        stage_fn, mesh=mesh, num_microbatches=4, data_axis=None
+    )
+    params = jnp.ones((8, 1), jnp.float32)
+    batch = jnp.ones((8, 4), jnp.float32)
+    txt = jax.jit(apply).lower(params, batch).compile().as_text()
+    for scope in ("pp_stage", "pp_hop", "pp_gather_out"):
+        assert scope in txt, f"scope {scope!r} missing from compiled HLO"
+
+
+def test_named_scopes_trainer_phases_in_lowered_hlo():
+    """fwd / optimizer_update (train) and eval_fwd (eval) phase scopes
+    from the real step builders."""
+    from distribuuuu_tpu import trainer
+    from distribuuuu_tpu.parallel import sharding as sharding_lib
+    from distribuuuu_tpu.utils.optim import construct_optimizer
+
+    config.reset_cfg()
+    cfg.MODEL.ARCH = "resnet18"
+    cfg.MODEL.NUM_CLASSES = 4
+    cfg.MODEL.BN_GROUP = 2
+    cfg.DEVICE.COMPUTE_DTYPE = "float32"
+    mesh = mesh_lib.build_mesh(data=1, model=1, seq=1, pipe=1,
+                               devices=[jax.devices()[0]])
+    model = trainer.build_model_from_cfg()
+    state = trainer.create_train_state(model, jax.random.key(0), mesh, 16)
+    batch = sharding_lib.shard_batch(mesh, {
+        "image": np.zeros((2, 16, 16, 3), np.float32),
+        "label": np.zeros((2,), np.int32),
+        "mask": np.ones((2,), np.float32),
+    })
+    step = trainer.make_train_step(model, construct_optimizer(), topk=2)
+    asm = _lowered_debug_asm(step.lower(state, batch))
+    # autodiff decorates the scope: the forward shows as jvp(fwd), its
+    # backward as transpose(jvp(fwd)) — both attributable to "fwd"
+    assert "jvp(fwd)" in asm
+    assert "transpose(jvp(fwd))" in asm
+    assert "optimizer_update" in asm
+    eval_step = trainer.make_eval_step(model, topk=2)
+    assert "eval_fwd" in _lowered_debug_asm(eval_step.lower(state, batch))
+
+
+# ------------------------------------------------- monitor rules
+def _snap(*, steps=16, mfu=None, headroom=None):
+    return {
+        "v": 1, "window_s": 5.0, "ranks": 1, "steps": steps, "images": steps,
+        "img_per_sec": None, "mfu": mfu, "hbm_headroom_pct": headroom,
+        "step": {"count": steps, "mean_ms": 100.0, "p50_ms": 100.0,
+                 "p90_ms": 100.0, "p99_ms": 100.0, "max_ms": 100.0},
+        "per_rank_p50_ms": {"0": 100.0},
+        "straggler_skew": 1.0, "data_wait_frac": 0.05,
+        "compiles": {"count": 0, "wall_s": 0.0},
+        "events": {"stall": 0, "data_error": 0, "nonfinite": 0},
+        "ckpt": {"saves": 0, "save_max_s": 0.0, "restores": 0},
+        "serve": None,
+        "totals": {"steps": steps, "images": steps, "compiles": 0,
+                   "stall": 0, "data_error": 0, "nonfinite": 0},
+    }
+
+
+def test_mfu_regression_rule_fires_and_stays_quiet():
+    rule = live.AlertRule({
+        "kind": "mfu-regression", "threshold": 20.0, "baseline": 0.40,
+        "breach_windows": 2, "min_steps": 8,
+    })
+    eng = live.RuleEngine([rule], interval_s=5.0)
+    # clean windows at baseline: quiet
+    assert eng.evaluate(_snap(mfu=0.40)) == []
+    assert eng.evaluate(_snap(mfu=0.38)) == []  # above 0.32 floor
+    # sustained regression: fires once after breach_windows, then dedups
+    assert eng.evaluate(_snap(mfu=0.10)) == []  # breach 1/2
+    fired = eng.evaluate(_snap(mfu=0.10))
+    assert [a["rule"] for a in fired] == ["mfu-regression"]
+    assert fired[0]["threshold"] == pytest.approx(0.32)
+    assert "0.1" in fired[0]["message"]
+    assert eng.evaluate(_snap(mfu=0.10)) == []  # active: no re-fire
+    # a window with no ledger yet (mfu None) is insufficient signal,
+    # and too few steps sit the rule out
+    assert eng.evaluate(_snap(mfu=None)) == []
+    assert eng.evaluate(_snap(mfu=0.1, steps=2)) == []
+
+
+def test_mfu_regression_dormant_without_baseline():
+    eng = live.RuleEngine(
+        [live.AlertRule({"kind": "mfu-regression", "threshold": 20.0})],
+        interval_s=5.0,
+    )
+    for _ in range(3):
+        assert eng.evaluate(_snap(mfu=0.001)) == []
+
+
+def test_hbm_headroom_rule_fires_and_stays_quiet():
+    rule = live.AlertRule({"kind": "hbm-headroom-low", "threshold": 10.0})
+    eng = live.RuleEngine([rule], interval_s=5.0)
+    assert eng.evaluate(_snap(headroom=55.0)) == []  # plenty
+    assert eng.evaluate(_snap(headroom=None)) == []  # no ledger yet
+    fired = eng.evaluate(_snap(headroom=4.5))
+    assert [a["rule"] for a in fired] == ["hbm-headroom-low"]
+    assert "4.5" in fired[0]["message"]
+    assert eng.evaluate(_snap(headroom=4.0)) == []  # dedup while active
+
+
+def test_shipped_rules_file_declares_both():
+    rules = live.load_rules(os.path.join(REPO, "config",
+                                         "monitor_rules.yaml"))
+    kinds = {r.kind for r in rules}
+    assert {"mfu-regression", "hbm-headroom-low"} <= kinds
+    mfu = next(r for r in rules if r.kind == "mfu-regression")
+    assert mfu.baseline is None  # shipped dormant, like throughput
+
+
+def test_aggregator_folds_cost_records_into_snapshot():
+    """cost.step + step spans → live measured MFU; cost.memory → the
+    tightest headroom — through the real LiveAggregator."""
+    agg = live.LiveAggregator(phase="train")
+    cost = {"kind": "cost.step", "rank": 0, "t": 0.0, "v": 1,
+            "label": "train_step", "phase": "train", "flops": 50e9,
+            "images": 8, "steps_per_call": 1, "peak_flops": 1e12,
+            "source": "xla"}
+    mem = [
+        {"kind": "cost.memory", "rank": 0, "t": 0.0, "v": 1,
+         "label": "train_step", "phase": "train", "total_bytes": 100,
+         "capacity_bytes": 1000, "headroom_pct": 24.0, "source": "xla"},
+        {"kind": "cost.memory", "rank": 0, "t": 0.0, "v": 1,
+         "label": "eval_step", "phase": "eval", "total_bytes": 50,
+         "capacity_bytes": 1000, "headroom_pct": 80.0, "source": "xla"},
+    ]
+    steps = [
+        {"kind": "span", "rank": 0, "t": 0.0, "v": 1, "name": "step",
+         "t0": float(i), "dur": 1.0, "track": "pipeline", "phase": "train",
+         "n": 8}
+        for i in range(10)
+    ]
+    agg.consume([cost, *mem, *steps])
+    snap = agg.snapshot(10.0)
+    # 10 steps × 50 GFLOP over a 10 s active span vs 1 TFLOP/s peak
+    assert snap["mfu"] == pytest.approx(0.05, rel=1e-3)
+    assert snap["hbm_headroom_pct"] == pytest.approx(24.0)
+    # ledger state survives the window reset (records arrive once)
+    agg.consume(steps)
+    snap2 = agg.snapshot(10.0)
+    assert snap2["mfu"] == pytest.approx(0.05, rel=1e-3)
+    assert snap2["hbm_headroom_pct"] == pytest.approx(24.0)
+
+
+# ------------------------------------------------- run_report section
+def _write_run(tmp_path, *, flops=50e9, peak=1e12, headroom=42.0,
+               step_s=0.05, n_steps=20):
+    tdir = tmp_path / "telemetry"
+    tdir.mkdir(exist_ok=True)
+    recs = [
+        {"kind": "clock", "rank": 0, "t": 0.0, "unix": 1000.0, "mono": 0.0},
+        {"kind": "cost.step", "rank": 0, "t": 0.0, "v": 1,
+         "label": "train_step", "phase": "train", "flops": flops,
+         "bytes_accessed": flops / 5.0, "images": 8, "steps_per_call": 1,
+         "devices": 1, "device_kind": "cpu", "peak_flops": peak,
+         "source": "xla"},
+        {"kind": "cost.roofline", "rank": 0, "t": 0.0, "v": 1,
+         "label": "train_step", "phase": "train",
+         "arithmetic_intensity": 5.0, "ridge_intensity": 3.9,
+         "bound": "compute", "source": "xla"},
+        {"kind": "cost.memory", "rank": 0, "t": 0.0, "v": 1,
+         "label": "train_step", "phase": "train", "total_bytes": 580,
+         "capacity_bytes": 1000, "headroom_pct": headroom,
+         "capacity_source": "table", "source": "xla"},
+    ]
+    for i in range(n_steps):
+        recs.append({
+            "kind": "span", "rank": 0, "t": 0.0, "v": 1, "name": "step",
+            "t0": i * step_s, "dur": step_s, "track": "pipeline",
+            "phase": "train", "epoch": 1, "batch": i, "n": 8,
+        })
+    with open(tdir / "rank00000.jsonl", "w") as f:
+        for r in recs:
+            f.write(json.dumps(r) + "\n")
+    return str(tmp_path)
+
+
+def test_run_report_cost_section(tmp_path):
+    rep = run_report.build_report(_write_run(tmp_path))
+    cost = rep["cost"]
+    assert cost["source"] == "xla"
+    assert cost["flops_per_step"] == pytest.approx(50e9)
+    # mfu = flops / mean_step_s / peak = 50e9 / 0.05 / 1e12 = 1.0
+    assert cost["mfu"] == pytest.approx(1.0, rel=1e-3)
+    assert cost["roofline"]["bound"] == "compute"
+    assert cost["hbm"]["headroom_pct"] == pytest.approx(42.0)
+    assert "train_step" in cost["hbm"]["per_executable"]
+    # the comparison surface exposes both new metrics, higher-better
+    metrics = run_report.comparable_metrics(rep)
+    assert metrics["mfu"] == pytest.approx(1.0, rel=1e-3)
+    assert metrics["hbm_headroom_pct"] == pytest.approx(42.0)
+    assert "mfu" in run_report.HIGHER_BETTER
+    assert "hbm_headroom_pct" in run_report.HIGHER_BETTER
+
+
+def test_run_report_compare_gates_mfu_both_directions(tmp_path):
+    cur = run_report.build_report(_write_run(tmp_path))
+    better = {"step": {"p50_ms": 50.0}, "cost": {"mfu": 2.0,
+              "hbm": {"headroom_pct": 90.0}}}
+    worse = {"step": {"p50_ms": 50.0}, "cost": {"mfu": 0.5,
+             "hbm": {"headroom_pct": 10.0}}}
+    cmp_fail = run_report.compare(cur, better, tol_pct=10.0,
+                                  tol_overrides={})
+    rows = {r["metric"]: r for r in cmp_fail["rows"]}
+    assert not rows["mfu"]["ok"] and not rows["hbm_headroom_pct"]["ok"]
+    assert not cmp_fail["ok"]
+    cmp_pass = run_report.compare(cur, worse, tol_pct=10.0,
+                                  tol_overrides={})
+    rows = {r["metric"]: r for r in cmp_pass["rows"]}
+    assert rows["mfu"]["ok"] and rows["hbm_headroom_pct"]["ok"]
+
+
+def test_run_report_analytic_source_flagged(tmp_path):
+    """A run whose backend omitted cost keys still gets the section —
+    flagged analytic (acceptance: fallback visible, never silent)."""
+    run = _write_run(tmp_path)
+    path = os.path.join(run, "telemetry", "rank00000.jsonl")
+    recs = [json.loads(line) for line in open(path)]
+    for r in recs:
+        if r["kind"] == "cost.step":
+            r["source"] = "analytic"
+            r["bytes_accessed"] = None
+    with open(path, "w") as f:
+        for r in recs:
+            if r["kind"] != "cost.roofline":
+                f.write(json.dumps(r) + "\n")
+    rep = run_report.build_report(run)
+    assert rep["cost"]["source"] == "analytic"
+    assert rep["cost"]["mfu"] is not None  # table flops still give MFU
+
+
+# ------------------------------------------------- serve bucket ledger
+def test_engine_emits_bucket_ledger(tmp_path):
+    """Engine AOT startup emits one cost.step (+memory) per bucket,
+    read off the executables it compiled anyway."""
+    from distribuuuu_tpu import trainer
+    from distribuuuu_tpu.serve import Engine
+
+    config.reset_cfg()
+    cfg.MODEL.ARCH = "resnet18"
+    cfg.MODEL.NUM_CLASSES = 4
+    cfg.MODEL.BN_GROUP = 2
+    cfg.DEVICE.COMPUTE_DTYPE = "float32"
+    cfg.TRAIN.IM_SIZE = 8
+    spans.setup_telemetry(str(tmp_path), rank=0)
+    mesh = mesh_lib.build_mesh(data=1, model=1, seq=1, pipe=1,
+                               devices=[jax.devices()[0]])
+    model = trainer.build_model_from_cfg()
+    state = trainer.create_train_state(model, jax.random.key(0), mesh, 8)
+    variables = {"params": state.params, "batch_stats": state.batch_stats}
+    eng = Engine(model, variables, 8, max_batch=2, max_wait_ms=5.0,
+                 input_dtype=np.float32)
+    spans.close_telemetry()
+    recs = [json.loads(line) for line in open(tmp_path / "rank00000.jsonl")]
+    steps = [r for r in recs if r["kind"] == "cost.step"]
+    assert {r["label"] for r in steps} == {"serve_bucket_1",
+                                           "serve_bucket_2"}
+    assert all(r["phase"] == "serve" and r["source"] == "xla"
+               for r in steps)
+    mems = [r for r in recs if r["kind"] == "cost.memory"]
+    assert {r["label"] for r in mems} == {"serve_bucket_1",
+                                          "serve_bucket_2"}
+    for r in recs:
+        schema.validate_record(r)
+    eng.drain()
+
+
+# ------------------------------------------------- trace_report parser
+def _ev(line, name, op_name="", dur=1e6, b=0):
+    return {"line": line, "name": name, "op_name": op_name, "dur_ns": dur,
+            "bytes": b}
+
+
+def test_trace_report_summarize_events_off_chip():
+    """The --report parser over a synthetic plane: categories, scope
+    rollup, async/envelope exclusion, per-step normalization — no chip,
+    no tensorflow."""
+    events = [
+        _ev("XLA Ops", "fusion.1",
+            "jit(train)/fwd/conv_general_dilated", dur=4e6, b=1000),
+        _ev("XLA Ops", "fusion.2",
+            "jit(train)/transpose(jvp(fwd))/conv_general_dilated",
+            dur=6e6, b=2000),
+        _ev("XLA Ops", "all-reduce.1",
+            "jit(train)/zero_reduce_scatter/psum", dur=2e6),
+        _ev("XLA Ops", "fusion.3",
+            "jit(train)/optimizer_update/mul", dur=1e6),
+        _ev("async copy", "copy-start.1", dur=50e6),  # overlapped DMA
+        _ev("module line", "jit_train", dur=100e6),   # envelope
+        _ev("Steps", "step marker", dur=999e6),       # skipped line
+    ]
+    s = trace_report.summarize_events(events, steps=2, top=5)
+    assert s["busy_ms_per_step"] == pytest.approx((4 + 6 + 2 + 1) / 2)
+    cats = {(c["pass"], c["kind"]): c for c in s["categories"]}
+    assert cats[("fwd", "conv-chain")]["ms_per_step"] == pytest.approx(2.0)
+    assert cats[("bwd", "conv-chain")]["ms_per_step"] == pytest.approx(3.0)
+    assert cats[("fwd", "collective")]["ms_per_step"] == pytest.approx(1.0)
+    assert ("fwd", "async-dma") in cats  # bucketed apart, not busy time
+    scopes = {(r["pass"], r["scope"]): r["ms_per_step"]
+              for r in s["scopes"]}
+    assert scopes[("fwd", "zero_reduce_scatter")] == pytest.approx(1.0)
+    assert scopes[("fwd", "optimizer_update")] == pytest.approx(0.5)
+    assert scopes[("fwd", "fwd")] == pytest.approx(2.0)
+    assert scopes[("bwd", "fwd")] == pytest.approx(3.0)
+    assert s["top_ops"][0]["name"] == "fusion.2"
+
+
+def test_trace_report_classify_and_scope():
+    assert trace_report.classify_event(
+        "XLA Ops", "reduce-scatter.3", "x/y"
+    ) == ("fwd", "collective")
+    assert trace_report.classify_event(
+        "XLA Ops", "fusion.9", "a/transpose(jvp(f))/b"
+    )[0] == "bwd"
+    assert trace_report.scope_of("jit(x)/pp_stage/dot_general") == "pp_stage"
+    assert trace_report.scope_of("jit(x)/misc/dot_general") is None
+
+
+# ------------------------------------------------- committed artifact
+def test_costmodel_artifact_covers_every_arch_yaml():
+    """COSTMODEL_r01.json is the regeneration-pinned ledger: every
+    shipped arch YAML has a train+eval entry with XLA-sourced flops and
+    an HBM footprint, plus the serve-bucket section."""
+    path = os.path.join(REPO, "COSTMODEL_r01.json")
+    assert os.path.exists(path), "commit COSTMODEL_r01.json " \
+        "(python tools/costmodel_report.py)"
+    doc = json.load(open(path))
+    assert doc["costmodel"] == 1
+    shipped = set()
+    for ypath in sorted(glob.glob(os.path.join(REPO, "config", "*.yaml"))):
+        arch = (yaml.safe_load(open(ypath)).get("MODEL") or {}).get("ARCH")
+        if arch:
+            shipped.add(arch)
+    assert shipped <= set(doc["archs"]), (
+        f"ledger missing archs {sorted(shipped - set(doc['archs']))} — "
+        "regenerate with tools/costmodel_report.py"
+    )
+    for arch in shipped:
+        entry = doc["archs"][arch]
+        for phase in ("train", "eval"):
+            step = entry[phase]["step"]
+            assert step["source"] == "xla" and step["flops"] > 0, (
+                f"{arch}/{phase}: expected XLA-sourced flops"
+            )
+            assert entry[phase]["memory"]["total_bytes"] > 0
+            assert entry[phase]["memory"]["headroom_pct"] is not None
+    assert doc["serve"]["buckets"], "serve bucket ledger missing"
+    for b, led in doc["serve"]["buckets"].items():
+        assert led["step"]["flops"] > 0
+        assert led["step"]["images"] == int(b)
+
+
+def test_bench_index_folds_costmodel_series(tmp_path):
+    """bench_history indexes COSTMODEL_r*.json into the gated
+    train_step_mfu / train_step_hbm_headroom_pct series, and
+    run_report's bench-index mapping picks their latest points up."""
+    doc = {
+        "costmodel": 1,
+        "archs": {"resnet50": {"train": {
+            "mfu": 0.31,
+            "step": {"flops": 49e9},
+            "memory": {"headroom_pct": 88.5},
+        }}},
+    }
+    with open(tmp_path / "COSTMODEL_r01.json", "w") as f:
+        json.dump(doc, f)
+    index = bench_history.build_index(str(tmp_path))
+    assert index["series"]["train_step_mfu"][-1]["value"] == 0.31
+    assert (
+        index["series"]["train_step_hbm_headroom_pct"][-1]["value"] == 88.5
+    )
+    assert "COSTMODEL_r01.json" in index["sources"]
+    mapped = run_report.comparable_metrics(index)
+    assert mapped["mfu"] == 0.31
+    assert mapped["hbm_headroom_pct"] == 88.5
+
+
+def test_committed_bench_index_carries_cost_series():
+    """The committed BENCH_INDEX.json was regenerated after the ledger
+    landed (the landing-without-reindex failure mode the regeneration
+    pin exists for)."""
+    index = json.load(open(os.path.join(REPO, "BENCH_INDEX.json")))
+    assert "train_step_mfu" in index["series"]
+    assert "train_step_hbm_headroom_pct" in index["series"]

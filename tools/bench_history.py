@@ -1,11 +1,12 @@
 """Fold the per-round bench artifacts into ONE machine-readable
 trajectory: ``BENCH_INDEX.json``.
 
-Five rounds of ``BENCH_r*.json`` (single-chip training throughput) plus
+Five rounds of ``BENCH_r*.json`` (single-chip training throughput),
 ``BENCH_serve.json`` (serving latency/throughput frontier + fleet
-scaling) each have their own ad-hoc shape; answering "how has img/s
-moved across PRs" meant opening five files. This tool scans them all and
-emits one index:
+scaling), and ``COSTMODEL_r*.json`` (the XLA cost-model ledger: measured
+MFU + HBM headroom, tools/costmodel_report.py) each have their own
+ad-hoc shape; answering "how has img/s moved across PRs" meant opening
+five files. This tool scans them all and emits one index:
 
     {"bench_index": 1,
      "series": {
@@ -62,6 +63,31 @@ def index_train_bench(path: str, series: dict) -> None:
             _point(series, f"{parsed['metric']}_vs_baseline",
                    _round_of(path), os.path.basename(path),
                    parsed["vs_baseline"], "x")
+        if parsed.get("mfu") is not None:
+            # bench-measured MFU on the bench hardware (since r10 sourced
+            # from the XLA cost ledger, mfu_source "xla")
+            _point(series, f"{parsed['metric']}_mfu", _round_of(path),
+                   os.path.basename(path), parsed["mfu"], "mfu")
+
+
+def index_costmodel(path: str, series: dict) -> None:
+    """COSTMODEL_r*.json (tools/costmodel_report.py): the bench arch's
+    train-step MFU and HBM headroom become gated series —
+    ``run_report --compare BENCH_INDEX.json`` treats their latest points
+    like the throughput reference (both higher-better)."""
+    with open(path) as f:
+        doc = json.load(f)
+    rnd, src = _round_of(path), os.path.basename(path)
+    r50 = (doc.get("archs") or {}).get("resnet50") or {}
+    train = r50.get("train") or {}
+    _point(series, "train_step_mfu", rnd, src, train.get("mfu"), "mfu")
+    mem = train.get("memory") or {}
+    _point(series, "train_step_hbm_headroom_pct", rnd, src,
+           mem.get("headroom_pct"), "%")
+    step = train.get("step") or {}
+    if step.get("flops"):
+        _point(series, "train_step_gflops", rnd, src,
+               step["flops"] / 1e9, "GFLOP")
 
 
 def index_serve_bench(path: str, series: dict) -> None:
@@ -102,6 +128,9 @@ def build_index(root: str) -> dict:
     train_files = sorted(glob.glob(os.path.join(root, "BENCH_r*.json")))
     for path in train_files:
         index_train_bench(path, series)
+    cost_files = sorted(glob.glob(os.path.join(root, "COSTMODEL_r*.json")))
+    for path in cost_files:
+        index_costmodel(path, series)
     serve_path = os.path.join(root, "BENCH_serve.json")
     if os.path.exists(serve_path):
         index_serve_bench(serve_path, series)
@@ -110,7 +139,7 @@ def build_index(root: str) -> dict:
     return {
         "bench_index": INDEX_SCHEMA,
         "generated_by": "tools/bench_history.py",
-        "sources": [os.path.basename(p) for p in train_files]
+        "sources": [os.path.basename(p) for p in train_files + cost_files]
         + (["BENCH_serve.json"] if os.path.exists(serve_path) else []),
         "series": series,
     }

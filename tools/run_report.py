@@ -57,7 +57,7 @@ LOWER_BETTER = (
     "step_ms_p50", "step_ms_p90", "step_ms_p99", "data_wait_frac",
     "straggler_skew", "recompiles", "ckpt_save_max_s",
 )
-HIGHER_BETTER = ("img_per_sec",)
+HIGHER_BETTER = ("img_per_sec", "mfu", "hbm_headroom_pct")
 
 
 def _load_ranks(run_dir: str) -> dict[int, list[dict]]:
@@ -119,6 +119,76 @@ def _wait_frac_from_spans(recs: list[dict], phase: str) -> float | None:
     wall = max(t1 - t0, 1e-9)
     wait = sum(float(r["dur"]) for r in pipeline if r.get("name") == "wait")
     return wait / wall
+
+
+def _cost_section(ranks: dict[int, list[dict]], phase: str,
+                  mean_step_s: float | None) -> dict | None:
+    """The MFU / roofline / HBM-headroom section from the cost-model
+    ledger records (telemetry/costmodel.py emits them once per step
+    program; the latest phase-matching record wins). Measured MFU =
+    XLA flops/step ÷ measured mean step time ÷ mesh peak — the peak was
+    resolved at capture time, so this stays jax-free post-mortem.
+    ``source`` is "xla" or the flagged "analytic" fallback."""
+    step_rec = roof_rec = None
+    mem_recs: dict[str, dict] = {}
+    for recs in ranks.values():
+        for r in recs:
+            kind = r.get("kind")
+            if kind == "cost.step" and r.get("phase") == phase:
+                step_rec = r
+            elif kind == "cost.roofline" and r.get("phase") == phase:
+                roof_rec = r
+            elif kind == "cost.memory":
+                mem_recs[str(r.get("label"))] = r
+    if step_rec is None and not mem_recs:
+        return None
+    out = {
+        "source": step_rec.get("source") if step_rec else None,
+        "flops_per_step": step_rec.get("flops") if step_rec else None,
+        "bytes_per_step": step_rec.get("bytes_accessed") if step_rec else None,
+        "images_per_step": step_rec.get("images") if step_rec else None,
+        "device_kind": step_rec.get("device_kind") if step_rec else None,
+        "peak_flops": step_rec.get("peak_flops") if step_rec else None,
+        "mfu": None,
+        "roofline": None,
+        "hbm": None,
+    }
+    if (
+        step_rec and step_rec.get("flops") and step_rec.get("peak_flops")
+        and mean_step_s
+    ):
+        out["mfu"] = round(
+            float(step_rec["flops"]) / mean_step_s
+            / float(step_rec["peak_flops"]), 4
+        )
+    if roof_rec is not None:
+        out["roofline"] = {
+            "arithmetic_intensity": roof_rec.get("arithmetic_intensity"),
+            "ridge_intensity": roof_rec.get("ridge_intensity"),
+            "bound": roof_rec.get("bound"),
+            "nominal_peaks": roof_rec.get("nominal_peaks"),
+        }
+    if mem_recs:
+        per_label = {
+            label: {
+                "total_bytes": r.get("total_bytes"),
+                "capacity_bytes": r.get("capacity_bytes"),
+                "headroom_pct": r.get("headroom_pct"),
+            }
+            for label, r in sorted(mem_recs.items())
+        }
+        headrooms = [
+            v["headroom_pct"] for v in per_label.values()
+            if v["headroom_pct"] is not None
+        ]
+        out["hbm"] = {
+            "per_executable": per_label,
+            "headroom_pct": min(headrooms) if headrooms else None,
+            "capacity_source": next(iter(mem_recs.values())).get(
+                "capacity_source"
+            ),
+        }
+    return out
 
 
 def _count_events(ranks: dict[int, list[dict]], metrics: list[dict]) -> dict:
@@ -204,17 +274,22 @@ def build_report(run_dir: str, phase: str = "train") -> dict:
         ckpt.update(restores=len(restores),
                     restore_mean_s=round(sum(restores) / len(restores), 3))
 
+    step_summary = _summary_ms(pooled)
+    mean_step_s = (
+        step_summary["mean_ms"] / 1e3 if step_summary["count"] else None
+    )
     report = {
         "schema": REPORT_SCHEMA,
         "run_dir": os.path.abspath(run_dir),
         "phase": phase,
         "n_ranks": len(ranks),
         "step_source": source,
-        "step": _summary_ms(pooled),
+        "step": step_summary,
         "per_rank_step": per_rank,
         "straggler_skew": straggler,
         "data_wait_frac": data_wait_frac,
         "img_per_sec": img_per_sec,
+        "cost": _cost_section(ranks, phase, mean_step_s),
         "events": _count_events(ranks, metrics),
         "recompiles": compiles,
         "checkpoint": ckpt,
@@ -235,8 +310,18 @@ def comparable_metrics(doc: dict) -> dict:
         for metric, points in (doc.get("series") or {}).items():
             if not points or metric.endswith("_vs_baseline"):
                 continue  # ratios are derived, not a throughput reference
-            if "images_per_sec" in metric or "img_per_sec" in metric:
+            if (
+                ("images_per_sec" in metric or "img_per_sec" in metric)
+                and not metric.endswith("_mfu")  # bench MFU series: a
+                # ratio riding the throughput metric's name, not img/s
+            ):
                 out["img_per_sec"] = float(points[-1]["value"])
+            # the cost-model series (tools/bench_history.py folds them in
+            # from COSTMODEL_r*.json / bench mfu) gate like throughput
+            elif metric == "train_step_mfu":
+                out["mfu"] = float(points[-1]["value"])
+            elif metric == "train_step_hbm_headroom_pct":
+                out["hbm_headroom_pct"] = float(points[-1]["value"])
         return out
     if "step" in doc and isinstance(doc.get("step"), dict):
         for q in ("p50", "p90", "p99"):
@@ -255,6 +340,12 @@ def comparable_metrics(doc: dict) -> dict:
         ck = doc.get("checkpoint", {})
         if ck.get("saves"):
             out["ckpt_save_max_s"] = float(ck["save_max_s"])
+        cost = doc.get("cost") or {}
+        if cost.get("mfu") is not None:
+            out["mfu"] = float(cost["mfu"])
+        hbm = cost.get("hbm") or {}
+        if hbm.get("headroom_pct") is not None:
+            out["hbm_headroom_pct"] = float(hbm["headroom_pct"])
     parsed = doc.get("parsed")
     if parsed and "value" in parsed:
         metric = str(parsed.get("metric", ""))
@@ -310,6 +401,47 @@ def _print_report(rep: dict) -> None:
     ips = rep["img_per_sec"]
     print(f"data_wait_frac: {'n/a' if dwf is None else dwf}"
           + (f"   img_per_sec: {ips}" if ips else ""))
+    cost = rep.get("cost")
+    if cost:
+        flops = cost.get("flops_per_step")
+        mfu = cost.get("mfu")
+        src = cost.get("source") or "n/a"
+        print(
+            "cost model"
+            + (f" [{src}]" if src else "")
+            + (f": {flops / 1e9:.2f} GFLOP/step" if flops else ": flops n/a")
+            + (f"  mfu {mfu:.4f}" if mfu is not None else "  mfu n/a")
+            + (f"  peak {cost['peak_flops'] / 1e12:.1f} TFLOP/s"
+               f" ({cost.get('device_kind')})"
+               if cost.get("peak_flops") else "")
+        )
+        roof = cost.get("roofline")
+        if roof and roof.get("arithmetic_intensity") is not None:
+            nominal = " (nominal peaks)" if roof.get("nominal_peaks") else ""
+            ridge = roof.get("ridge_intensity")
+            print(
+                f"roofline: intensity {roof['arithmetic_intensity']:.1f} "
+                f"flop/byte vs ridge "
+                + (f"{ridge:.1f}" if ridge is not None else "n/a")
+                + f" -> {roof.get('bound') or 'n/a'}-bound{nominal}"
+            )
+        hbm = cost.get("hbm")
+        if hbm:
+            hr = hbm.get("headroom_pct")
+            print(
+                "hbm ledger: headroom "
+                + (f"{hr:.1f}%" if hr is not None else "n/a")
+                + f" (tightest of {len(hbm['per_executable'])} "
+                f"executable(s), capacity per {hbm.get('capacity_source')})"
+            )
+            for label, row in hbm["per_executable"].items():
+                tb, cap = row["total_bytes"], row["capacity_bytes"]
+                print(
+                    f"  {label:<18} {tb / 2**20:10.1f} MiB"
+                    + (f" / {cap / 2**30:.1f} GiB"
+                       f"  ({row['headroom_pct']:.1f}% free)"
+                       if cap and row["headroom_pct"] is not None else "")
+                )
     ev = rep["events"]
     print(f"resilience events: stall={ev['stall']} "
           f"data_error={ev['data_error']} nonfinite={ev['nonfinite']}")

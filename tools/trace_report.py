@@ -10,11 +10,23 @@ Two modes:
 
 Attribution uses the JAX op_name metadata the profiler attaches to every
 HLO op: ``transpose(jvp(...))`` marks backward ops; the flax module path
-(``.../BatchNorm_0/...``) marks which layer produced them. Event stats
+(``.../BatchNorm_0/...``) marks which layer produced them — and since
+r10 the ``jax.named_scope`` attribution scopes threaded through the
+trainer and parallel layers (``fwd`` / ``optimizer_update`` /
+``zero_reduce_scatter`` / ``zero_rest_layout`` / ``tp_constrain`` /
+``pp_stage`` / ``pp_hop`` / ``pp_gather_out``) appear in the same
+op_name path, so compute splits from collectives by name. Event stats
 carry ``bytes_accessed`` where the compiler recorded them.
 
+The parser is two layers so it is unit-testable OFF-chip
+(tests/test_costmodel.py feeds synthetic events): ``summarize_events``
+is pure python over generic event dicts
+``{"line", "name", "op_name", "bytes", "dur_ns"}``; the xplane protobuf
+adapter (``xplane_planes`` — the only tensorflow import) converts a
+captured .xplane.pb into those dicts.
+
     python tools/trace_report.py --capture --steps 3 --batch 128
-    python tools/trace_report.py --report
+    python tools/trace_report.py --report --json-out trace_summary.json
 """
 
 from __future__ import annotations
@@ -22,11 +34,21 @@ from __future__ import annotations
 import argparse
 import collections
 import glob
+import json
 import os
 
 import _path  # noqa: F401  (repo root onto sys.path)
 
 TRACE_DIR = "/tmp/r50_trace"
+
+# named_scope attribution scopes the repo threads through step programs
+# (trainer phases + parallel/{zero,tp,pp} collectives): an op whose
+# op_name path contains one is rolled up under it in the scopes table
+ATTRIBUTION_SCOPES = (
+    "zero_reduce_scatter", "zero_rest_layout", "tp_constrain",
+    "pp_stage", "pp_hop", "pp_gather_out", "optimizer_update",
+    "eval_fwd", "fwd",
+)
 
 
 def capture(steps: int, batch: int, arch: str):
@@ -77,45 +99,141 @@ def newest_xplane() -> str:
     return max(files, key=os.path.getmtime)
 
 
-def report(steps: int, top: int):
+def classify_event(line: str, name: str, op_name: str) -> tuple[str, str]:
+    """(pass, kind) for one trace event — the categorization rules,
+    factored out so they are testable without a chip. Lines are hardware
+    queues: async copy-start spans OVERLAP compute (they are the
+    latency-hiding DMA) and are bucketed apart so they don't masquerade
+    as busy time."""
+    lname = line.lower()
+    bwd = "transpose(jvp" in op_name or "/vjp" in op_name
+    if "async" in lname or "-start" in name:
+        kind = "async-dma"  # overlapped lifetime; NOT busy time
+    elif name.startswith("jit_") or "module" in lname:
+        kind = "step-envelope"
+    elif "conv_general_dilated" in op_name:
+        # conv fusions carry fused BN-stat / ReLU / BN-grad
+        # epilogues — classify by the producing op, the event
+        # name is just "fusion.N"/"convert_reduce_fusion.N"
+        kind = "conv-chain"
+    elif "select-and-scatter" in name:
+        kind = "maxpool-bwd"
+    elif "copy-done" in name or "slice-done" in name:
+        kind = "dma-wait"  # synchronous tail visible in-line
+    elif "/add" in op_name and "fusion" in name:
+        kind = "residual-add"
+    elif "fusion" in name:
+        kind = "other-fusion"
+    elif ("all-reduce" in name or "all-gather" in name
+          or "reduce-scatter" in name or "collective-permute" in name):
+        kind = "collective"
+    else:
+        kind = "misc"
+    return ("bwd" if bwd else "fwd", kind)
+
+
+def scope_of(op_name: str) -> str | None:
+    """First attribution scope appearing in the op_name path (the
+    named_scope names land as path components), else None. Autodiff
+    decorates the component — the forward under ``jax.value_and_grad``
+    shows as ``jvp(fwd)``, its backward as ``transpose(jvp(fwd))`` —
+    so components are unwrapped before matching."""
+    for part in op_name.split("/"):
+        core = (
+            part.replace("transpose(", "").replace("jvp(", "")
+            .replace("vjp(", "").rstrip(")")
+        )
+        if core in ATTRIBUTION_SCOPES:
+            return core
+    return None
+
+
+def summarize_events(events, steps: int, top: int = 25) -> dict:
+    """Pure summary of one plane's events (each
+    ``{"line", "name", "op_name", "bytes", "dur_ns"}``): per-line
+    totals, per-(pass, kind) category times/bytes, per-scope rollup
+    (named_scope attribution), and the top compute ops — everything the
+    printed report and --json-out contain. ``steps`` normalizes to
+    per-step."""
+    steps = max(1, int(steps))
+    cat_ns: collections.Counter = collections.Counter()
+    cat_bytes: collections.Counter = collections.Counter()
+    scope_ns: collections.Counter = collections.Counter()
+    op_ns: collections.Counter = collections.Counter()
+    op_info: dict = {}
+    line_ns: collections.Counter = collections.Counter()
+    total_ns = 0.0
+    for ev in events:
+        line = str(ev.get("line", ""))
+        if "step" in line.lower():  # step-markers line double-counts
+            continue
+        name = str(ev.get("name", ""))
+        op_name = str(ev.get("op_name", ""))
+        dur = float(ev.get("dur_ns", 0.0))
+        bytes_acc = int(ev.get("bytes", 0) or 0)
+        line_ns[line] += dur
+        key = classify_event(line, name, op_name)
+        cat_ns[key] += dur
+        cat_bytes[key] += bytes_acc
+        if key[1] not in ("async-dma", "step-envelope"):
+            op_ns[name] += dur
+            op_info[name] = (op_name, bytes_acc)
+            total_ns += dur
+            scope = scope_of(op_name)
+            if scope is not None:
+                scope_ns[(key[0], scope)] += dur
+    ms = 1e6 * steps  # ns totals -> ms/step
+    return {
+        "steps": steps,
+        "busy_ms_per_step": round(total_ns / ms, 3),
+        "lines": {
+            ln: round(v / ms, 3)
+            for ln, v in sorted(line_ns.items(), key=lambda kv: -kv[1])
+        },
+        "categories": [
+            {
+                "pass": key[0], "kind": key[1],
+                "ms_per_step": round(cat_ns[key] / ms, 3),
+                "gb_per_step": round(cat_bytes[key] / 1e9 / steps, 3),
+            }
+            for key in sorted(cat_ns, key=cat_ns.get, reverse=True)
+        ],
+        "scopes": [
+            {
+                "pass": key[0], "scope": key[1],
+                "ms_per_step": round(scope_ns[key] / ms, 3),
+            }
+            for key in sorted(scope_ns, key=scope_ns.get, reverse=True)
+        ],
+        "top_ops": [
+            {
+                "name": name,
+                "op_name": op_info[name][0],
+                "ms_per_step": round(op_ns[name] / ms, 3),
+                "mb": round(op_info[name][1] / 1e6, 1),
+            }
+            for name in sorted(op_ns, key=op_ns.get, reverse=True)[:top]
+        ],
+    }
+
+
+def xplane_planes(path: str):
+    """Yield ``(plane_name, events)`` per device plane of a .xplane.pb —
+    the ONLY tensorflow-touching code; everything downstream is pure."""
     from tensorflow.tsl.profiler.protobuf import xplane_pb2
 
-    steps_file = os.path.join(TRACE_DIR, "steps.txt")
-    if os.path.exists(steps_file):
-        with open(steps_file) as f:
-            steps = int(f.read().strip())
     xs = xplane_pb2.XSpace()
-    with open(newest_xplane(), "rb") as f:
+    with open(path, "rb") as f:
         xs.ParseFromString(f.read())
-
     for plane in xs.planes:
-        pname = plane.name.lower()
-        if "tpu" not in pname:
-            continue
-        if not plane.lines:
+        if "tpu" not in plane.name.lower() or not plane.lines:
             continue
         evm = plane.event_metadata
         stm = plane.stat_metadata
-        # per (line, bwd?, category) totals and per-op rollup. Lines are
-        # hardware queues: the XLA-ops line is the serialized compute
-        # timeline; module lines carry the step envelope; async copy-start
-        # spans OVERLAP compute (they are the latency-hiding DMA) and are
-        # bucketed apart so they don't masquerade as busy time.
-        cat_ns: dict = collections.Counter()
-        cat_bytes: dict = collections.Counter()
-        op_ns: dict = collections.Counter()
-        op_info: dict = {}
-        line_ns: dict = collections.Counter()
-        total_ns = 0
+        events = []
         for line in plane.lines:
-            lname = line.name.lower()
-            if "step" in lname:  # step-markers line double-counts
-                continue
             for ev in line.events:
-                line_ns[line.name] += ev.duration_ps / 1e3
                 md = evm[ev.metadata_id]
-                dur = ev.duration_ps / 1e3  # ns
-                name = md.name
                 op_name = ""
                 bytes_acc = 0
                 for st in list(ev.stats) + list(md.stats):
@@ -130,55 +248,58 @@ def report(steps: int, top: int):
                             op_name = v
                     elif sname == "bytes_accessed":
                         bytes_acc = st.uint64_value or st.int64_value
-                bwd = "transpose(jvp" in op_name or "/vjp" in op_name
-                if "async" in lname or "-start" in name:
-                    kind = "async-dma"  # overlapped lifetime; NOT busy time
-                elif name.startswith("jit_") or "module" in lname:
-                    kind = "step-envelope"
-                elif "conv_general_dilated" in op_name:
-                    # conv fusions carry fused BN-stat / ReLU / BN-grad
-                    # epilogues — classify by the producing op, the event
-                    # name is just "fusion.N"/"convert_reduce_fusion.N"
-                    kind = "conv-chain"
-                elif "select-and-scatter" in name:
-                    kind = "maxpool-bwd"
-                elif "copy-done" in name or "slice-done" in name:
-                    kind = "dma-wait"  # synchronous tail visible in-line
-                elif "/add" in op_name and "fusion" in name:
-                    kind = "residual-add"
-                elif "fusion" in name:
-                    kind = "other-fusion"
-                elif "all-reduce" in name or "all-gather" in name:
-                    kind = "collective"
-                else:
-                    kind = "misc"
-                key = ("bwd" if bwd else "fwd", kind)
-                cat_ns[key] += dur
-                cat_bytes[key] += bytes_acc
-                if kind not in ("async-dma", "step-envelope"):
-                    op_ns[name] += dur
-                    op_info[name] = (op_name, bytes_acc)
-                    total_ns += dur
+                events.append({
+                    "line": line.name,
+                    "name": md.name,
+                    "op_name": op_name,
+                    "bytes": bytes_acc,
+                    "dur_ns": ev.duration_ps / 1e3,
+                })
+        yield plane.name, events
 
-        if total_ns == 0:
+
+def print_summary(plane_name: str, summary: dict, top: int) -> None:
+    steps = summary["steps"]
+    print(f"== plane: {plane_name} ==")
+    for ln, v in summary["lines"].items():
+        print(f"  line {ln!r}: {v:.2f} ms/step")
+    print(f"  busy (non-async, non-envelope): "
+          f"{summary['busy_ms_per_step']:.2f} ms/step over {steps} steps")
+    for row in summary["categories"]:
+        print(
+            f"  {row['pass']:>3s} {row['kind']:<13s} "
+            f"{row['ms_per_step']:8.2f} ms/step  "
+            f"{row['gb_per_step']:7.2f} GB/step"
+        )
+    if summary["scopes"]:
+        print("  -- attribution scopes (jax.named_scope) --")
+        for row in summary["scopes"]:
+            print(f"  {row['pass']:>3s} {row['scope']:<20s} "
+                  f"{row['ms_per_step']:8.2f} ms/step")
+    print(f"  -- top {top} ops (compute only) --")
+    for row in summary["top_ops"]:
+        print(
+            f"  {row['ms_per_step']:8.2f} ms  {row['mb']:8.1f} MB  "
+            f"{row['name']:<24s} {row['op_name'][:80]}"
+        )
+
+
+def report(steps: int, top: int, json_out: str | None = None):
+    steps_file = os.path.join(TRACE_DIR, "steps.txt")
+    if os.path.exists(steps_file):
+        with open(steps_file) as f:
+            steps = int(f.read().strip())
+    doc = {"trace": newest_xplane(), "planes": {}}
+    for plane_name, events in xplane_planes(doc["trace"]):
+        summary = summarize_events(events, steps, top)
+        if summary["busy_ms_per_step"] == 0:
             continue
-        print(f"== plane: {plane.name} ==")
-        for ln in sorted(line_ns, key=line_ns.get, reverse=True):
-            print(f"  line {ln!r}: {line_ns[ln] / 1e6 / steps:.2f} ms/step")
-        print(f"  busy (non-async, non-envelope): "
-              f"{total_ns / 1e6 / steps:.2f} ms/step over {steps} steps")
-        for key in sorted(cat_ns, key=cat_ns.get, reverse=True):
-            print(
-                f"  {key[0]:>3s} {key[1]:<13s} {cat_ns[key] / 1e6 / steps:8.2f} "
-                f"ms/step  {cat_bytes[key] / 1e9 / steps:7.2f} GB/step"
-            )
-        print(f"  -- top {top} ops (compute only) --")
-        for name in sorted(op_ns, key=op_ns.get, reverse=True)[:top]:
-            opn, b = op_info[name]
-            print(
-                f"  {op_ns[name] / 1e6 / steps:8.2f} ms  {b / 1e6:8.1f} MB  "
-                f"{name:<24s} {opn[:80]}"
-            )
+        doc["planes"][plane_name] = summary
+        print_summary(plane_name, summary, top)
+    if json_out:
+        with open(json_out, "w") as f:
+            json.dump(doc, f, indent=1)
+        print(f"summary -> {json_out}")
 
 
 def main():
@@ -189,11 +310,13 @@ def main():
     ap.add_argument("--batch", type=int, default=128)
     ap.add_argument("--arch", default="resnet50")
     ap.add_argument("--top", type=int, default=25)
+    ap.add_argument("--json-out", default=None, metavar="OUT.json",
+                    help="also write the structured per-plane summary")
     args = ap.parse_args()
     if args.capture:
         capture(args.steps, args.batch, args.arch)
     if args.report or not args.capture:
-        report(args.steps, args.top)
+        report(args.steps, args.top, json_out=args.json_out)
 
 
 if __name__ == "__main__":
