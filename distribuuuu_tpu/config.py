@@ -370,11 +370,19 @@ _C.DEVICE.S2D_STEM = False
 
 _C.MESH = CfgNode()
 # Logical mesh axis sizes; -1 means "all remaining devices" on that axis.
-# Axes: data (DP), model (TP), seq (SP/CP), pipe (PP — parallel/pp.py).
+# Axes: data (DP), model (TP), seq (SP/CP), pipe (PP — parallel/pp.py),
+# expert (EP — a dedicated MoE dispatch axis, so expert parallelism can
+# compose with tensor parallelism on a 3-axis dp×tp×ep mesh instead of
+# riding the model axis). Any stanza is validated/classified up front by
+# the partition-layer topology registry (parallel/partition/topology.py).
 _C.MESH.DATA = -1
 _C.MESH.MODEL = 1
 _C.MESH.SEQ = 1
 _C.MESH.PIPE = 1
+# Expert-parallel axis for the *_moe archs. 1 (default) keeps the legacy
+# behavior where expert tensors ride the ``model`` axis; >1 dedicates
+# this axis to MoE dispatch (must divide MODEL.MOE.NUM_EXPERTS).
+_C.MESH.EXPERT = 1
 # GPipe microbatches per step when PIPE > 1 (parallel/pp.py schedule);
 # 0 → 2 × PIPE. The per-data-shard batch must divide by it.
 _C.MESH.MICROBATCH = 0

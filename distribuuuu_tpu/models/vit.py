@@ -104,16 +104,20 @@ class MoeMlp(nn.Module):
     axes_bound: bool = False
     # >0: the expert tensors this module RECEIVES hold only this many
     # (this rank's) experts — the PP×EP sharded-entry layout, where the
-    # pipeline shard_map's in_specs split the expert dim over ``model``
-    # (ADVICE r3 #1: O(E/n) per-device param memory, not O(E)). The gate
-    # and the routing space stay global (num_experts). 0 = full tensors.
+    # pipeline shard_map's in_specs split the expert dim over the MoE
+    # axis (ADVICE r3 #1: O(E/n) per-device param memory, not O(E)). The
+    # gate and the routing space stay global (num_experts). 0 = full.
     experts_local: int = 0
+    # Mesh axis the expert tensors/dispatch ride: "model" (the legacy
+    # layout — EP time-shares the TP axis) or "expert" (the dedicated
+    # axis, MESH.EXPERT>1 — EP composes with TP on a dp×tp×ep mesh).
+    moe_axis: str = "model"
 
     @nn.compact
     def __call__(self, x, train: bool = False):
         from distribuuuu_tpu.ops import moe as moe_ops
-        from distribuuuu_tpu.parallel.tp import MODEL_AXIS
 
+        MODEL_AXIS = self.moe_axis
         E = self.num_experts
         EL = self.experts_local or E
         d, f = self.dim, self.hidden
@@ -362,6 +366,7 @@ class Block(nn.Module):
     moe_capacity_factor: float = 2.0
     moe_axes_bound: bool = False  # inside a pipeline stage's shard_map
     moe_experts_local: int = 0  # PP×EP sharded entry (MoeMlp.experts_local)
+    moe_axis: str = "model"  # mesh axis EP rides (MoeMlp.moe_axis)
 
     @nn.compact
     def __call__(self, x, train: bool = False):
@@ -379,6 +384,7 @@ class Block(nn.Module):
                 capacity_factor=self.moe_capacity_factor,
                 axes_bound=self.moe_axes_bound,
                 experts_local=self.moe_experts_local,
+                moe_axis=self.moe_axis,
             )
         else:
             ffn = Mlp(
@@ -445,6 +451,7 @@ class ViT(_ViTCommon):
     moe_every: int = 2
     moe_impl: str = "partial"
     moe_capacity_factor: float = 2.0
+    moe_axis: str = "model"  # mesh axis EP rides (MoeMlp.moe_axis)
 
     @nn.compact
     def __call__(self, x, train: bool = False):
@@ -463,6 +470,7 @@ class ViT(_ViTCommon):
                 moe_experts=moe, moe_top_k=self.moe_top_k,
                 moe_impl=self.moe_impl,
                 moe_capacity_factor=self.moe_capacity_factor,
+                moe_axis=self.moe_axis,
             )(x, train=train)
         return self._head(x)
 
@@ -485,6 +493,7 @@ class ViTStage(nn.Module):
     moe_impl: str = "partial"
     moe_capacity_factor: float = 2.0
     moe_experts_local: int = 0  # PP×EP sharded entry (MoeMlp.experts_local)
+    moe_axis: str = "model"  # mesh axis EP rides (MoeMlp.moe_axis)
 
     @nn.compact
     def __call__(self, x, train: bool = False):
@@ -507,6 +516,7 @@ class ViTStage(nn.Module):
                 moe_capacity_factor=self.moe_capacity_factor,
                 moe_axes_bound=True,
                 moe_experts_local=self.moe_experts_local,
+                moe_axis=self.moe_axis,
             )(x, train=train)
         return x
 
@@ -562,6 +572,7 @@ class PipelinedViT(_ViTCommon):
     moe_every: int = 2
     moe_impl: str = "partial"
     moe_capacity_factor: float = 2.0
+    moe_axis: str = "model"  # mesh axis EP rides (MoeMlp.moe_axis)
 
     def _stage_module(self, experts_local: int = 0):
         if self.depth % self.pipe_stages:
@@ -609,6 +620,7 @@ class PipelinedViT(_ViTCommon):
             moe_every=self.moe_every, moe_impl=self.moe_impl,
             moe_capacity_factor=self.moe_capacity_factor,
             moe_experts_local=experts_local,
+            moe_axis=self.moe_axis,
         )
 
     def _stage_param_specs(self, stage_mod):
@@ -621,7 +633,7 @@ class PipelinedViT(_ViTCommon):
         no TP collectives inside)."""
         from jax.sharding import PartitionSpec as P
 
-        from distribuuuu_tpu.parallel.tp import MODEL_AXIS
+        moe_axis = self.moe_axis
 
         dummy = jnp.zeros((1, 8, self.dim), jnp.float32)
         template = jax.eval_shape(
@@ -634,9 +646,9 @@ class PipelinedViT(_ViTCommon):
             if (
                 isinstance(t, nn.Partitioned)
                 and t.names
-                and t.names[0] == MODEL_AXIS
+                and t.names[0] == moe_axis
             ):
-                return P("pipe", MODEL_AXIS)
+                return P("pipe", moe_axis)
             return P("pipe")
 
         return jax.tree.map(
@@ -742,7 +754,7 @@ class PipelinedViT(_ViTCommon):
             # ``model`` in the shard_map in_specs and give the stage a
             # module declaring the LOCAL expert count — O(E/n) per-device
             # param memory; the inline MoE paths skip their slice
-            ep_n = mesh.shape.get("model", 1)
+            ep_n = mesh.shape.get(self.moe_axis, 1)
             sharded_ep = (
                 self.moe_experts > 0
                 and ep_n > 1

@@ -10,11 +10,15 @@ called by user code: they are compiled into the step function by XLA from
 sharding annotations and ride ICI within a slice / DCN across slices.
 
 Mesh axes (configured by ``cfg.MESH``):
-  - ``data``  — data parallelism (batch sharding; DDP equivalent)
-  - ``model`` — tensor/model parallelism (params/heads sharding)
-  - ``seq``   — sequence/context parallelism (ring attention)
+  - ``data``   — data parallelism (batch sharding; DDP equivalent)
+  - ``model``  — tensor/model parallelism (params/heads sharding)
+  - ``seq``    — sequence/context parallelism (ring attention)
+  - ``pipe``   — GPipe pipeline parallelism (parallel/pp.py)
+  - ``expert`` — dedicated MoE dispatch axis (composes EP with TP)
 The reference only exercises data parallelism; the extra axes are
-first-class so larger workloads shard without restructuring.
+first-class so larger workloads shard without restructuring. Any stanza
+is validated/classified by the partition-layer topology registry
+(parallel/partition/topology.py) before a mesh is built.
 """
 
 from __future__ import annotations
@@ -30,7 +34,7 @@ from jax.sharding import Mesh
 _initialized = False
 _DEFAULT_COORD_PORT = 29566  # matches the reference's default port (utils.py:35)
 
-MESH_AXES = ("data", "model", "seq", "pipe")
+MESH_AXES = ("data", "model", "seq", "pipe", "expert")
 
 
 def _slurm_env():
@@ -197,32 +201,47 @@ def _data_groups_of_mesh(mesh) -> tuple[int, int]:
     return distinct.index(mine), len(distinct)
 
 
+def resolve_axis_sizes(
+    sizes: list[int] | tuple[int, ...], n_devices: int
+) -> list[int]:
+    """Resolve ``-1``/``0`` wildcard entries against ``n_devices``.
+
+    ``-1`` (and ``0``, accepted everywhere a size-1 axis is meant) on
+    exactly one axis means "all remaining devices". The resolved product
+    must equal the device count. Shared by mesh construction and the
+    partition-layer topology registry, so stanza validation and the mesh
+    actually built can never disagree on the resolved shape."""
+    sizes = [1 if s == 0 else s for s in sizes]
+    n_auto = sum(1 for s in sizes if s == -1)
+    if n_auto > 1:
+        raise ValueError(f"At most one mesh axis may be -1, got {sizes}")
+    fixed = int(np.prod([s for s in sizes if s != -1]))
+    if fixed <= 0 or n_devices % fixed != 0:
+        raise ValueError(
+            f"Mesh axes {sizes} do not divide device count {n_devices}"
+        )
+    sizes = [n_devices // fixed if s == -1 else s for s in sizes]
+    if int(np.prod(sizes)) != n_devices:
+        raise ValueError(
+            f"Mesh {dict(zip(MESH_AXES, sizes))} uses {int(np.prod(sizes))} "
+            f"devices but {n_devices} are available"
+        )
+    return sizes
+
+
 def build_mesh(
-    data: int = -1, model: int = 1, seq: int = 1, pipe: int = 1, devices=None
+    data: int = -1, model: int = 1, seq: int = 1, pipe: int = 1,
+    expert: int = 1, devices=None
 ) -> Mesh:
-    """Build the global device mesh with axes ``(data, model, seq, pipe)``.
+    """Build the global device mesh with axes
+    ``(data, model, seq, pipe, expert)``.
 
     ``-1`` on exactly one axis means "all remaining devices". The total must
     divide the device count evenly. With defaults this is pure data
     parallelism over every chip — the reference's DDP topology.
     """
     devices = jax.devices() if devices is None else devices
-    n = len(devices)
-    sizes = [data, model, seq, pipe]
-    n_auto = sum(1 for s in sizes if s == -1)
-    if n_auto > 1:
-        raise ValueError(f"At most one mesh axis may be -1, got {sizes}")
-    fixed = int(np.prod([s for s in sizes if s != -1]))
-    if n % fixed != 0:
-        raise ValueError(
-            f"Mesh axes {sizes} do not divide device count {n}"
-        )
-    sizes = [n // fixed if s == -1 else s for s in sizes]
-    if int(np.prod(sizes)) != n:
-        raise ValueError(
-            f"Mesh {dict(zip(MESH_AXES, sizes))} uses {int(np.prod(sizes))} "
-            f"devices but {n} are available"
-        )
+    sizes = resolve_axis_sizes([data, model, seq, pipe, expert], len(devices))
     dev_array = np.asarray(devices).reshape(sizes)
     return Mesh(dev_array, MESH_AXES)
 
@@ -234,5 +253,6 @@ def mesh_from_cfg(cfg, devices=None) -> Mesh:
         model=cfg.MESH.MODEL,
         seq=cfg.MESH.SEQ,
         pipe=cfg.MESH.PIPE,
+        expert=cfg.MESH.get("EXPERT", 1),
         devices=devices,
     )

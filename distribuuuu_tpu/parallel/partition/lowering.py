@@ -1,0 +1,390 @@
+"""The one lowering: specs → compiled train/eval/folded steps.
+
+This is where the per-leaf declarations (partition/specs.py) and the
+validated topology (partition/topology.py) become executable programs.
+There is ONE step body for every point of the mesh space — dp, dp×tp,
+PP, ZeRO-1/3, MoE over the model or the dedicated expert axis, and the
+compositions that previously had no code path (ZeRO-3 under PP, a
+dp×tp×ep mesh with ZeRO-1). A topology changes WHICH constraints the
+body applies, never which code runs:
+
+  * the batch rides the declared ``data`` spec (specs.BATCH_TABLE);
+  * params/opt/grads rest in the ``state_layout`` trees; with a ZeRO
+    stage the gradient is constrained to the sharded layout right before
+    the optimizer update (GSPMD satisfies it with a reduce-scatter fused
+    with the cross-replica mean) and outputs are pinned back to the rest
+    layout so buffer donation stays stable;
+  * every spec-induced collective carries a ``jax.named_scope`` naming
+    the mesh axes it runs over (``zero_reduce_scatter@data``, …) so
+    trace_report / Perfetto / cost.* records attribute comm per axis on
+    this path too (the PP hop scopes live in parallel/pp.py).
+
+The step builders here ARE the trainer's — ``trainer.make_train_step``
+et al. re-export them — so the hot-loop math is defined once and the
+legacy call sites (tests, tools, serve) keep working unchanged.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Any
+
+import flax.struct
+import jax
+import jax.numpy as jnp
+import optax
+
+from distribuuuu_tpu.config import cfg
+from distribuuuu_tpu.models.layers import head_dtype
+from distribuuuu_tpu.parallel import sharding as sharding_lib, tp, zero
+from distribuuuu_tpu.parallel.partition import specs as specs_lib
+from distribuuuu_tpu.resilience import supervisor
+from distribuuuu_tpu.utils import faults
+from distribuuuu_tpu.utils.metrics import accuracy, cross_entropy
+
+
+@flax.struct.dataclass
+class TrainState:
+    params: Any
+    batch_stats: Any
+    opt_state: Any
+    step: Any  # scalar int32 — drives per-step RNG folding (dropout etc.)
+    key: Any  # base PRNG key (not checkpointed; re-derived from RNG_SEED)
+
+
+def make_image_prep():
+    """In-graph half of ``DATA.DEVICE_NORMALIZE`` (captured at step-build
+    time): the loader ships raw uint8, the step normalizes in fp32 —
+    identical formula/order to the host path (data/transforms.py).
+
+    Dtype-gated at trace time (r4, when the flag became default-True):
+    only uint8 batches are normalized. Float batches are ALREADY
+    normalized — by the host pipeline, or synthetic (bench.py, tests) —
+    and must pass through untouched, else flipping the default would have
+    silently re-normalized every float-feeding caller."""
+    if not cfg.DATA.DEVICE_NORMALIZE:
+        return lambda images: images
+    from distribuuuu_tpu.data.transforms import normalize_in_graph
+
+    def prep(images):
+        if images.dtype == jnp.uint8:
+            return normalize_in_graph(images)
+        return images
+
+    return prep
+
+
+def _collective_scopes(layout) -> tuple[str, str]:
+    """Attribution scope names for the two spec-induced state collectives
+    — reduce-scatter into the grads layout, all-gather back to the rest
+    layout — suffixed with the mesh axes they run over (``@data``), so
+    trace_report rollups and Perfetto split comm per axis. ``None``
+    layout never reaches these."""
+    axes = ",".join(specs_lib.added_axes(layout)) or "data"
+    return f"zero_reduce_scatter@{axes}", f"zero_rest_layout@{axes}"
+
+
+def train_step_body(model, optimizer, topk: int, accum_steps: int = 1,
+                    layout=None):
+    """The pure step function shared by the per-step and folded paths.
+
+    ``layout`` (a ``specs.state_layout`` dict) is required when
+    ``MESH.ZERO`` is on: the gradient is constrained to the ZeRO layout
+    right before the optimizer update — GSPMD satisfies it with a
+    reduce-scatter, fusing the cross-replica grad mean with the shard
+    slicing — and the outputs are pinned back to the state's rest layout
+    so buffer donation stays stable across steps. ``None`` (the default)
+    adds no constraints: GSPMD propagates the replicated DDP layout
+    exactly as before. Building a step WITHOUT a layout while
+    ``MESH.ZERO`` is set is refused — the state (create_train_state)
+    would rest ZeRO-sharded while the step neither reduce-scatters grads
+    nor pins outputs back, silently skipping buffer donation and
+    measuring a layout that is neither DDP nor ZeRO.
+
+    ``accum_steps > 1`` runs that many sequential micro-batches, summing
+    gradients in-graph before ONE optimizer update (config:
+    ``TRAIN.GRAD_ACCUM_STEPS``). The batch must arrive pre-split as
+    ``(accum, micro_batch, ...)`` with the micro_batch dim sharded on
+    ``data`` (sharding.shard_micro_batch) — splitting on the host is a
+    zero-copy view, whereas an in-graph reshape of the data-sharded batch
+    dim would make GSPMD redistribute the whole batch over ICI every step.
+    Gradients are exact (the mean-CE micro-grads average to the full-batch
+    grad); BN stats are per-micro-batch — torch-DDP-with-accumulation
+    semantics. HBM holds one micro-batch of activations at a time.
+    """
+    if layout is None and cfg.MESH.ZERO:
+        raise ValueError(
+            f"MESH.ZERO={cfg.MESH.ZERO} requires the step to be built with "
+            "the ZeRO state layout (pass layout=state_layout(...)): the "
+            "state rests ZeRO-sharded, and a layout-less step would neither "
+            "reduce-scatter grads nor pin rest layouts — a silent "
+            "neither-DDP-nor-ZeRO configuration."
+        )
+
+    # Non-finite loss guard (resilience/supervisor.py), compiled into the
+    # step: metrics always carry a ``nonfinite`` flag; under "skip" the
+    # poisoned update is discarded in-graph (pre-step state selected).
+    nonfinite_policy = supervisor.validate_policy(str(cfg.TRAIN.NONFINITE))
+
+    if layout is not None:
+        rs_scope, ag_scope = _collective_scopes(layout)
+
+    def apply_grads(state, grads, new_stats, metrics):
+        if layout is not None:
+            # ZeRO: reduce-scatter the grad into the sharded update
+            grads = zero.constrain(grads, layout["grads"], scope=rs_scope)
+        with jax.named_scope("optimizer_update"):
+            updates, new_opt_state = optimizer.update(
+                grads, state.opt_state, state.params
+            )
+            new_params = optax.apply_updates(state.params, updates)
+        if layout is not None:
+            # pin rest layouts (stage 1: params re-gathered to replicated;
+            # stage 3: params stay data-sharded) — keeps donation stable
+            new_params = zero.constrain(
+                new_params, layout["params"], scope=ag_scope
+            )
+            new_opt_state = tp.constrain_like(
+                new_opt_state, grads, layout["opt"]
+            )
+        new_state = TrainState(
+            params=new_params,
+            batch_stats=new_stats,
+            opt_state=new_opt_state,
+            step=state.step + 1,
+            key=state.key,
+        )
+        return supervisor.guard_nonfinite(
+            state, new_state, metrics, nonfinite_policy
+        )
+
+    # λ for the MoE load-balancing aux (models/vit.MoeMlp sows per-block
+    # values into ``intermediates``); captured at step-build time. Zero
+    # overhead for dense archs: the collection stays empty.
+    moe_aux_weight = float(cfg.MODEL.MOE.AUX_WEIGHT)
+    prep_images = make_image_prep()
+    # FAULTS.NAN_STEP (utils/faults.py): trace-time gate — None (the
+    # common case) compiles nothing in; an int multiplies the loss by
+    # where(step==k, NaN, 1), poisoning loss AND grads at exactly step k.
+    nan_step = faults.nan_injection_step()
+
+    def loss_fn(params, stats, images, labels, key, step):
+        images = prep_images(images)
+        # attribution scope: the forward (and, through autodiff's
+        # transpose, its backward as transpose(fwd)/...) is nameable in
+        # HLO op metadata — trace_report / Perfetto split compute from
+        # the collective/update scopes below
+        with jax.named_scope("fwd"):
+            logits, mutated = model.apply(
+                {"params": params, "batch_stats": stats},
+                images,
+                train=True,
+                mutable=["batch_stats", "intermediates", "moe_stats"],
+                rngs={"dropout": key},
+            )
+        loss = cross_entropy(logits, labels)
+        aux = jax.tree.leaves(mutated.get("intermediates", {}))
+        if aux and moe_aux_weight:
+            loss = loss + moe_aux_weight * sum(aux) / len(aux)
+        if nan_step is not None:
+            loss = loss * jnp.where(
+                step == nan_step, jnp.float32(jnp.nan), jnp.float32(1.0)
+            )
+        # dispatch-MoE observability: per-block dropped-assignment
+        # fractions (models/vit.MoeMlp sows the sum; empty for dense and
+        # partial-MoE models — zero overhead there)
+        dstats = jax.tree.leaves(mutated.get("moe_stats", {}))
+        dropped = sum(dstats) / len(dstats) if dstats else None
+        return loss, (logits, mutated.get("batch_stats", {}), dropped)
+
+    grad_fn = jax.value_and_grad(loss_fn, has_aux=True)
+
+    def step_metrics(loss, logits, labels, dropped):
+        acc1, acck = accuracy(logits, labels, topk=(1, topk))
+        metrics = {"loss": loss, "top1": acc1, "topk": acck}
+        if dropped is not None:
+            metrics["moe_dropped"] = dropped
+        return metrics
+
+    def train_step(state: TrainState, batch):
+        step_key = jax.random.fold_in(state.key, state.step)
+        (loss, (logits, new_stats, dropped)), grads = grad_fn(
+            state.params, state.batch_stats, batch["image"], batch["label"],
+            step_key, state.step,
+        )
+        return apply_grads(
+            state, grads, new_stats,
+            step_metrics(loss, logits, batch["label"], dropped),
+        )
+
+    def accum_train_step(state: TrainState, micro):
+        step_key = jax.random.fold_in(state.key, state.step)
+        if micro["image"].shape[0] != accum_steps:
+            raise ValueError(
+                f"accum train step wants a pre-split (accum={accum_steps}, "
+                f"micro_batch, ...) input, got leading dim "
+                f"{micro['image'].shape[0]} — use sharding.shard_micro_batch"
+            )
+
+        def body(carry, mb):
+            stats, gsum, i = carry
+            mkey = jax.random.fold_in(step_key, i)
+            (loss, (logits, new_stats, dropped)), grads = grad_fn(
+                state.params, stats, mb["image"], mb["label"], mkey,
+                state.step,
+            )
+            gsum = jax.tree.map(jnp.add, gsum, grads)
+            return (new_stats, gsum, i + 1), step_metrics(
+                loss, logits, mb["label"], dropped
+            )
+
+        zeros = jax.tree.map(jnp.zeros_like, state.params)
+        if layout is not None:
+            # sharded accumulation buffer: each micro-grad reduce-scatters
+            # into it (ZeRO-2 semantics during accumulation — the standing
+            # grad-sum holds 1/N per rank)
+            zeros = zero.constrain(zeros, layout["grads"])
+        (new_stats, gsum, _), micro_metrics = jax.lax.scan(
+            body, (state.batch_stats, zeros, jnp.int32(0)), micro,
+            length=accum_steps,
+        )
+        grads = jax.tree.map(lambda g: g / accum_steps, gsum)
+        metrics = jax.tree.map(jnp.mean, micro_metrics)
+        return apply_grads(state, grads, new_stats, metrics)
+
+    return accum_train_step if accum_steps > 1 else train_step
+
+
+def make_train_step(model, optimizer, topk: int, accum_steps: int = 1,
+                    layout=None):
+    """Compile-once train step: fwd + CE loss + bwd + SGD + metrics
+    (≙ the hot loop body, ref: trainer.py:37-58)."""
+    return jax.jit(
+        train_step_body(model, optimizer, topk, accum_steps, layout=layout),
+        donate_argnums=0,
+    )
+
+
+def make_scan_train_step(model, optimizer, topk: int, fold: int,
+                         accum_steps: int = 1, layout=None):
+    """``fold`` optimizer steps in ONE compiled call via ``lax.scan``.
+
+    Same math as ``fold`` sequential ``make_train_step`` calls (same body,
+    same per-step RNG folding via ``state.step``; results agree up to XLA
+    fusion-order float drift). The difference is dispatch: one host→device
+    launch per ``fold`` steps, so the per-step host overhead (~4 ms on
+    tunneled transports, PERF.md) amortizes away.
+    Takes a stacked batch pytree with leading dim ``fold`` (leaf shape
+    ``(fold, batch, ...)``) and returns stacked per-step metrics ``(fold,)``.
+    """
+    body = train_step_body(model, optimizer, topk, accum_steps, layout=layout)
+
+    def scan_steps(state: TrainState, stacked_batch):
+        return jax.lax.scan(body, state, stacked_batch, length=fold)
+
+    return jax.jit(scan_steps, donate_argnums=0)
+
+
+def make_eval_step(model, topk: int):
+    """Masked eval step: per-batch metric sums + valid count
+    (≙ validate body, ref: trainer.py:77-89)."""
+    prep_images = make_image_prep()
+
+    def eval_step(state: TrainState, batch):
+        with jax.named_scope("eval_fwd"):
+            logits = model.apply(
+                {"params": state.params, "batch_stats": state.batch_stats},
+                prep_images(batch["image"]),
+                train=False,
+            )
+        mask = batch["mask"]
+        logp = jax.nn.log_softmax(
+            logits.astype(head_dtype(logits.dtype)), axis=-1
+        )
+        nll = -jnp.take_along_axis(logp, batch["label"][:, None], axis=-1)[:, 0]
+        _, pred = jax.lax.top_k(logits, topk)  # topk pre-clamped (effective_topk)
+        hits = pred == batch["label"][:, None]
+        c1 = (hits[:, :1].any(axis=1) * mask).sum()
+        ck = (hits.any(axis=1) * mask).sum()
+        return {
+            "loss_sum": (nll * mask).sum(),
+            "correct1": c1,
+            "correctk": ck,
+            "count": mask.sum(),
+        }
+
+    return jax.jit(eval_step)
+
+
+# ------------------------------------------------------------- the entry
+
+
+@dataclass
+class Lowered:
+    """Everything the epoch loop needs for one validated topology — built
+    from specs alone, no topology case analysis left at the call site."""
+
+    mesh: Any
+    topology: Any
+    layout: dict           # {"params","opt","grads"} NamedSharding trees
+    step_layout: dict | None  # layout when a ZeRO stage is on, else None
+    train_step: Any
+    eval_step: Any
+    scan_step: Any = None  # folded step when fold > 1
+    accum: int = 1
+    fold: int = 1
+    model: Any = None
+
+    def init_state(self, key, im_size: int):
+        """Fresh TrainState resting in this topology's layout."""
+        from distribuuuu_tpu import trainer
+
+        return trainer.create_train_state(
+            self.model, key, self.mesh, im_size, layout=self.layout
+        )
+
+    def put_batch(self, host_batch):
+        """Place one host batch per the declared batch specs (accum-aware)."""
+        if self.accum > 1:
+            return sharding_lib.shard_micro_batch(
+                self.mesh, host_batch, self.accum
+            )
+        return sharding_lib.shard_batch(self.mesh, host_batch)
+
+    def put_stacked(self, host_stacked):
+        """Place a fold-stacked host batch per the declared batch specs."""
+        if self.accum > 1:
+            return sharding_lib.shard_stacked_micro_batch(
+                self.mesh, host_stacked, self.accum
+            )
+        return sharding_lib.shard_stacked_batch(self.mesh, host_stacked)
+
+
+def lower(model, optimizer, topk: int, *, mesh, topology, im_size: int,
+          fold: int = 1, accum: int = 1) -> Lowered:
+    """Build the train/eval(/folded) step for ANY validated topology from
+    the declared specs — the single code path the trainer's per-topology
+    case analysis collapsed into.
+
+    The layout comes from ``specs.state_layout`` (base declarations +
+    ZeRO transform per ``topology.zero``); the step body applies the
+    layout constraints exactly when a stage is on, so stage-0 programs
+    are bit-identical to the pre-partition trainer's.
+    """
+    layout = specs_lib.state_layout(model, mesh, im_size, topology.zero)
+    step_layout = layout if topology.zero else None
+    train_step = make_train_step(
+        model, optimizer, topk, accum_steps=accum, layout=step_layout
+    )
+    scan_step = None
+    if fold > 1:
+        scan_step = make_scan_train_step(
+            model, optimizer, topk, fold, accum_steps=accum,
+            layout=step_layout,
+        )
+    return Lowered(
+        mesh=mesh, topology=topology, layout=layout, step_layout=step_layout,
+        train_step=train_step, eval_step=make_eval_step(model, topk),
+        scan_step=scan_step, accum=max(1, accum), fold=max(1, fold),
+        model=model,
+    )

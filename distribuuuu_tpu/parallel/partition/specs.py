@@ -1,0 +1,303 @@
+"""The spec layer: per-leaf PartitionSpecs, declared and transformed.
+
+Every parallelism form this framework ships reduces to a per-leaf
+``PartitionSpec`` over the one device mesh (axes
+``data/model/seq/pipe/expert`` — parallel/mesh.MESH_AXES):
+
+  * TP / PP / EP placement is DECLARED at the parameter: flax
+    ``nn.with_partitioning`` metadata names the mesh axes per dim
+    (models/*.py, models/vit.PipelinedViT ``init_stages``). ``base_specs``
+    reads those annotations back as the base spec tree.
+  * ZeRO stage 1/3 is a spec TRANSFORM over the base: ``data`` added on
+    the best divisible free dim per leaf (parallel/zero.add_data_axis) —
+    optimizer state + grads at stage 1, params too at stage 3.
+  * batch / activation placement comes from a path-pattern rules table
+    (``BATCH_TABLE``): leading dim over ``data``, the layout every
+    topology shares.
+
+``state_layout`` is the single resolver the lowering and the trainer
+place state with; the spec algebra below (validate / collapse /
+canonicalize) is what the stanza gate (tests/test_mesh_stanzas.py)
+compares declared layouts against compiled shardings with — a spec that
+names a size-1 axis collapses to replication, so dp-only meshes and
+dp×tp meshes flow through identical declarations.
+"""
+
+from __future__ import annotations
+
+import re
+from dataclasses import dataclass
+from typing import Any
+
+import jax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+
+class UnknownLeafError(KeyError):
+    """A strict spec table was asked for a leaf no rule covers."""
+
+
+class SpecConflictError(ValueError):
+    """A per-leaf spec names the same mesh axis on more than one dim (or
+    more axes than the leaf has dims)."""
+
+
+# ----------------------------------------------------------- spec algebra
+
+
+def _entry_names(entry) -> tuple[str, ...]:
+    """Axis names of one spec entry: None → (), 'x' → ('x',), tuples pass."""
+    if entry is None:
+        return ()
+    if isinstance(entry, str):
+        return (entry,)
+    return tuple(entry)
+
+
+def spec_axes(spec: P | None) -> tuple[str, ...]:
+    """Every mesh axis named anywhere in ``spec`` (order of appearance)."""
+    out: list[str] = []
+    for entry in tuple(spec) if spec is not None else ():
+        for name in _entry_names(entry):
+            if name not in out:
+                out.append(name)
+    return tuple(out)
+
+
+def validate_leaf_spec(
+    path: str, spec: P | None, shape: tuple[int, ...],
+    axis_sizes: dict[str, int],
+) -> None:
+    """Refuse malformed per-leaf specs BEFORE they reach GSPMD.
+
+    Checks: (a) no mesh axis appears on more than one dim (GSPMD's
+    error for that is a cryptic HLO dump); (b) the spec does not name
+    more dims than the leaf has; (c) every named axis exists on the
+    mesh. Raises :class:`SpecConflictError` with the leaf path.
+
+    Deliberately NOT checked: per-dim divisibility — GSPMD pads a dim
+    that does not divide evenly (e.g. a 10-class head kernel on a
+    4-way model axis), which is legal and was always accepted; the ZeRO
+    transform separately adds ``data`` only where it divides
+    (parallel/zero.add_data_axis).
+    """
+    entries = tuple(spec) if spec is not None else ()
+    if len(entries) > len(shape):
+        raise SpecConflictError(
+            f"leaf {path}: spec {spec} names {len(entries)} dims but the "
+            f"leaf has rank {len(shape)}"
+        )
+    seen: dict[str, int] = {}
+    for dim, entry in enumerate(entries):
+        for name in _entry_names(entry):
+            if name not in axis_sizes:
+                raise SpecConflictError(
+                    f"leaf {path}: spec {spec} names mesh axis {name!r} "
+                    f"which does not exist on the mesh "
+                    f"(axes: {sorted(axis_sizes)})"
+                )
+            if name in seen:
+                raise SpecConflictError(
+                    f"leaf {path}: spec {spec} names mesh axis {name!r} on "
+                    f"both dim {seen[name]} and dim {dim} — an axis may "
+                    "shard at most one dim of a leaf"
+                )
+            seen[name] = dim
+
+
+def collapse_unit_axes(spec: P | None, axis_sizes: dict[str, int]) -> P:
+    """Drop axes of size 1 from ``spec`` — a size-1 axis shards nothing,
+    so the canonical form of its spec is replication on that dim. This is
+    what lets ONE declaration serve every mesh: the TP annotation
+    ``P(None, 'model')`` IS replication on a dp-only mesh."""
+    entries = []
+    for entry in tuple(spec) if spec is not None else ():
+        names = tuple(
+            n for n in _entry_names(entry) if axis_sizes.get(n, 1) > 1
+        )
+        if not names:
+            entries.append(None)
+        elif len(names) == 1:
+            entries.append(names[0])
+        else:
+            entries.append(names)
+    return P(*entries)
+
+
+def canonicalize(spec: P | None, axis_sizes: dict[str, int]) -> P:
+    """Canonical spec: unit axes collapsed, trailing ``None`` stripped —
+    the equality the stanza gate compares declared vs compiled specs
+    under (``P('data')`` ≡ ``P('data', None)`` ≡ ``P(('data',), None)``)."""
+    entries = list(tuple(collapse_unit_axes(spec, axis_sizes)))
+    while entries and entries[-1] is None:
+        entries.pop()
+    return P(*entries)
+
+
+# ------------------------------------------------------------ rules table
+
+
+@dataclass(frozen=True)
+class SpecRule:
+    """One path-pattern rule: leaves whose path matches ``pattern``
+    (``re.search``) get ``spec``."""
+
+    pattern: str
+    spec: P
+
+
+class SpecTable:
+    """Ordered path-pattern → PartitionSpec rules covering a tree.
+
+    ``strict=True`` refuses unknown leaves (:class:`UnknownLeafError`)
+    instead of defaulting — the mode the stanza gate runs in, so a new
+    batch key or renamed param cannot silently fall back to replication.
+    """
+
+    def __init__(self, rules=(), default: P | None = P(), strict: bool = False):
+        self.rules = tuple(rules)
+        self.default = default
+        self.strict = strict
+
+    def spec_for(self, path: str, shape: tuple[int, ...] | None = None) -> P:
+        for rule in self.rules:
+            if re.search(rule.pattern, path):
+                return rule.spec
+        if self.strict:
+            raise UnknownLeafError(
+                f"no spec rule covers leaf {path!r} (strict table; rules: "
+                f"{[r.pattern for r in self.rules]})"
+            )
+        return self.default
+
+    def tree_specs(self, tree: Any) -> Any:
+        """Spec tree for ``tree``: one ``spec_for`` per leaf path."""
+        flat = jax.tree_util.tree_flatten_with_path(tree)
+        return jax.tree.unflatten(
+            flat[1],
+            [
+                self.spec_for(jax.tree_util.keystr(path), getattr(leaf, "shape", None))
+                for path, leaf in flat[0]
+            ],
+        )
+
+
+# The batch layout every topology shares: the leading (batch) dim of every
+# loader key is split over ``data``; everything else about a batch leaf is
+# replicated. Declared here (not hard-coded at the device_put site) so the
+# lowering, the sweep, and the stanza gate all read the same table.
+BATCH_TABLE = SpecTable(
+    rules=(
+        SpecRule(r"(^|[/'\[\.])image", P("data")),
+        SpecRule(r"(^|[/'\[\.])label", P("data")),
+        SpecRule(r"(^|[/'\[\.])mask", P("data")),
+    ),
+    default=None,  # unknown batch keys are refused in strict mode
+    strict=True,
+)
+
+# Activations between layers: batch dim over ``data`` (GSPMD propagates it
+# through the whole program from the batch placement; this constant is the
+# declaration tools and docs reference).
+ACTIVATION_SPEC = P("data")
+
+
+def batch_spec(key: str, *, leading_dims: int = 0) -> P:
+    """Spec for batch leaf ``key`` with ``leading_dims`` extra leading
+    dims (fold / accum stacking) before the batch dim."""
+    base = BATCH_TABLE.spec_for(key)
+    return P(*([None] * leading_dims + list(tuple(base))))
+
+
+# --------------------------------------------------------- state layouts
+
+
+def base_specs(abstract_variables) -> Any:
+    """The DECLARED base spec tree of a (possibly flax-boxed) variables
+    tree: the ``nn.with_partitioning`` annotation for boxed leaves,
+    ``P()`` (replicated) for plain ones. This is the per-leaf declaration
+    every transform below starts from."""
+    import flax.linen as nn
+
+    return nn.get_partition_spec(abstract_variables)
+
+
+def abstract_state(model, im_size: int):
+    """``jax.eval_shape`` of ``model.init`` on the standard dummy input —
+    the shape/annotation source for every layout derivation (never runs
+    compute)."""
+    import functools
+
+    import jax.numpy as jnp
+
+    dummy = jnp.ones((2, im_size, im_size, 3), jnp.float32)
+    return jax.eval_shape(
+        functools.partial(model.init, train=False), jax.random.key(0), dummy
+    )
+
+
+def state_layout(model, mesh: Mesh, im_size: int, zero_stage: int) -> dict:
+    """Resolved NamedSharding trees for the full train state:
+    ``{"params", "opt", "grads"}`` — param-shaped trees.
+
+    The single source the lowering AND the trainer place state with:
+      stage 0  all three are the declared base layout (params replicated
+               over ``data``, TP/PP annotations where present — the DDP
+               topology);
+      stage 1  ``opt``/``grads`` move to the ZeRO layout (``data`` added
+               per leaf where divisible — parallel/zero.add_data_axis);
+      stage 3  ``params`` too (FSDP): rest-sharded, gathered at use. On a
+               pipelined model the gather happens at the stage shard_map
+               boundary (GSPMD derives it from the in_specs), which is
+               what makes ZeRO-3 × PP a layout, not a refusal.
+
+    Every derived leaf spec is validated (:func:`validate_leaf_spec`)
+    before it can reach GSPMD.
+    """
+    import flax
+
+    from distribuuuu_tpu.parallel import tp, zero
+
+    abstract = abstract_state(model, im_size)
+    base = tp.param_shardings(mesh, abstract)["params"]
+    axis_sizes = {k: int(v) for k, v in dict(mesh.shape).items()}
+    stage = int(zero_stage)
+    if not stage:
+        layout = {"params": base, "opt": base, "grads": base}
+    else:
+        abstract_params = flax.linen.meta.unbox(abstract)["params"]
+        zsh = zero.zero_shardings(mesh, base, abstract_params)
+        layout = {
+            "params": zsh if stage == 3 else base,
+            "opt": zsh,
+            "grads": zsh,
+        }
+    # refuse malformed derivations before GSPMD sees them
+    shapes = flax.linen.meta.unbox(abstract)["params"]
+    for key in ("params", "opt", "grads"):
+        flat = jax.tree_util.tree_flatten_with_path(layout[key])[0]
+        shape_flat = jax.tree_util.tree_flatten_with_path(shapes)[0]
+        for (path, sh), (_, leaf) in zip(flat, shape_flat):
+            validate_leaf_spec(
+                jax.tree_util.keystr(path), sh.spec, tuple(leaf.shape),
+                axis_sizes,
+            )
+    return layout
+
+
+def added_axes(layout: dict) -> tuple[str, ...]:
+    """Mesh axes the ZeRO transform ADDED to the grads layout relative to
+    the params-base declaration — the axes the spec-induced
+    reduce-scatter/all-gather collectives run over (attribution scope
+    names and cost records carry them)."""
+    grads = {
+        ax
+        for leaf in jax.tree.leaves(layout["grads"])
+        for ax in spec_axes(leaf.spec)
+    }
+    params = {
+        ax
+        for leaf in jax.tree.leaves(layout["params"])
+        for ax in spec_axes(leaf.spec)
+    }
+    return tuple(sorted(grads - params)) or tuple(sorted(grads & {"data"}))

@@ -100,12 +100,29 @@ def _mesh_axes_of(tree) -> dict:
     return {}
 
 
+def _partition_record() -> dict | None:
+    """The partition-layer layout record (Topology.describe): resolved
+    axes, ZeRO stage, feature set, class name. Best-effort — a stanza
+    that no longer validates (config drifted after the save) must not
+    take the SAVE path down; classification handles absence."""
+    try:
+        from distribuuuu_tpu.parallel.partition import topology as topo_lib
+
+        return topo_lib.from_cfg(cfg).describe()
+    except Exception:
+        return None
+
+
 def world_topology(payload=None) -> dict:
     return {
         "processes": jax.process_count(),
         "devices": jax.device_count(),
         "mesh": _mesh_axes_of(payload) if payload is not None else {},
         "zero": int(cfg.MESH.ZERO),
+        # r11: the partition-layer layout classification rides along so
+        # elastic resume reports WHICH axes/stage moved, not just that
+        # the world changed (parallel/partition/topology.py)
+        "partition": _partition_record(),
     }
 
 
@@ -225,6 +242,18 @@ def classify_topology(man: dict, live_spec: dict | None = None) -> tuple[str, st
         for k in ("processes", "devices", "zero")
         if saved_topo.get(k) != live_topo.get(k)
     ]
+    # partition-layer classification (r11): axis-by-axis layout
+    # transition detail — every transition is reshardable (arrays
+    # re-place leaf by leaf; ZeRO shards reassemble through canonical
+    # leaf order), the classification's value is naming what moved
+    if saved_topo.get("partition") and live_topo.get("partition"):
+        from distribuuuu_tpu.parallel.partition import topology as topo_lib
+
+        pkind, pdetail = topo_lib.classify_transition(
+            saved_topo.get("partition"), live_topo.get("partition")
+        )
+        if pkind != "exact":
+            diffs.append(pdetail)
     return ("reshardable", "; ".join(diffs)) if diffs else ("exact", "")
 
 

@@ -30,7 +30,6 @@ import flax.struct
 import jax
 import jax.numpy as jnp
 import numpy as np
-import optax
 
 from distribuuuu_tpu import models
 from distribuuuu_tpu.config import cfg
@@ -44,7 +43,21 @@ from distribuuuu_tpu.parallel import (
     mesh as mesh_lib,
     sharding as sharding_lib,
     tp,
-    zero,
+)
+from distribuuuu_tpu.parallel.partition import (
+    lowering as partition_lowering,
+    specs as partition_specs,
+    topology as partition_topology,
+)
+# The step builders and TrainState live in the partition lowering
+# (parallel/partition/lowering.py) — ONE step body for every topology;
+# re-exported here so the long-standing call sites (tests, tools, serve)
+# keep their spelling.
+from distribuuuu_tpu.parallel.partition.lowering import (  # noqa: F401
+    TrainState,
+    make_eval_step,
+    make_scan_train_step,
+    make_train_step,
 )
 from distribuuuu_tpu.resilience import manifest as manifest_lib, supervisor
 from distribuuuu_tpu import telemetry
@@ -63,65 +76,26 @@ from distribuuuu_tpu.utils.jsonlog import (
 )
 from distribuuuu_tpu.utils.logger import get_logger, setup_logger
 from distribuuuu_tpu.utils.meters import AverageMeter, construct_meters
-from distribuuuu_tpu.utils.metrics import accuracy, count_parameters, cross_entropy
+from distribuuuu_tpu.utils.metrics import count_parameters
 from distribuuuu_tpu.utils.optim import construct_optimizer, set_lr
 from distribuuuu_tpu.utils.schedules import get_epoch_lr
 from distribuuuu_tpu.utils.seed import setup_env, setup_seed
 
 
-@flax.struct.dataclass
-class TrainState:
-    params: Any
-    batch_stats: Any
-    opt_state: Any
-    step: Any  # scalar int32 — drives per-step RNG folding (dropout etc.)
-    key: Any  # base PRNG key (not checkpointed; re-derived from RNG_SEED)
-
-
 def check_trainer_mesh():
-    """Refuse mesh axes the configured arch cannot use — GSPMD would
-    silently replicate the whole computation over an unused axis (N×
-    redundant work) rather than erroring."""
+    """Validate the configured MESH stanza BEFORE any expensive
+    init/compile.
+
+    Delegates to the partition-layer topology registry
+    (parallel/partition/topology.py): one capability table serves the
+    trainer, the dryrun sweep, and the YAML stanza gate, and its errors
+    are capability-derived — a stanza is refused because a named rule is
+    broken, never because a code path happens to be missing. Compositions
+    the old scattered refusals blocked without cause (ZeRO-3 under PP; a
+    dp×tp×ep mesh) now validate and lower.
+    """
     supervisor.validate_policy(cfg.TRAIN.NONFINITE)
-    if cfg.MESH.ZERO not in (0, 1, 3):
-        raise ValueError(
-            f"MESH.ZERO={cfg.MESH.ZERO}: stages are 0 (off), 1 (optimizer "
-            "state sharded over data), 3 (params too — FSDP); stage 2 is "
-            "subsumed by 1 in a fused jit step (parallel/zero.py)"
-        )
-    if cfg.MESH.ZERO == 3 and cfg.MESH.PIPE not in (0, 1):
-        raise ValueError(
-            f"MESH.ZERO=3 with MESH.PIPE={cfg.MESH.PIPE}: FSDP-sharded "
-            "params cannot enter the pipeline stage shard_map, whose "
-            "in_specs describe the pipe/model layout only — use MESH.ZERO=1 "
-            "(optimizer-state sharding composes with PP) or a non-pipe mesh"
-        )
-    if cfg.MESH.PIPE not in (0, 1):
-        if not cfg.MODEL.ARCH.startswith("vit"):
-            raise ValueError(
-                f"MESH.PIPE={cfg.MESH.PIPE}: only the ViT archs satisfy the "
-                "uniform-stage pipeline contract (parallel/pp.py); a CNN's "
-                "shrinking stage pyramid does not — use MESH.DATA/MODEL "
-                "for those archs"
-            )
-        # PP×MoE (r4): both strategies run inline on the bound axes inside
-        # stages, and the balancing aux + dispatch drop fraction are
-        # collected through the pipeline's stage-aux channel — no special
-        # casing needed here (models/vit.PipelinedViT, parallel/pp.py)
-        if cfg.MESH.SEQ not in (0, 1, -1):
-            raise ValueError(
-                f"MESH.PIPE={cfg.MESH.PIPE} with MESH.SEQ={cfg.MESH.SEQ}: "
-                "sequence-SHARDED (ring/ulysses) attention does not compose "
-                "with the pipe axis — PP shards depth, SP shards tokens; "
-                "per-device flash/blockwise attention inside stages is "
-                "supported instead (DEVICE.ATTN_IMPL flash)"
-            )
-    if cfg.MESH.SEQ not in (0, 1, -1) and not cfg.MODEL.ARCH.startswith("vit"):
-        raise ValueError(
-            f"MESH.SEQ={cfg.MESH.SEQ}: only the ViT archs route attention "
-            "over the seq axis; CNN archs have no sequence dimension to "
-            "shard (the axis would be silently replicated)"
-        )
+    return partition_topology.from_cfg(cfg)
 
 
 def bn_group_from_cfg() -> int:
@@ -135,9 +109,17 @@ def bn_group_from_cfg() -> int:
     return cfg.MODEL.BN_GROUP or cfg.TRAIN.BATCH_SIZE
 
 
-def build_model_from_cfg():
+def build_model_from_cfg(topology=None):
     """Build the configured arch (≙ models.build_model + timm fallback,
-    ref: trainer.py:117-128 — the zoo here is closed, no fallback needed)."""
+    ref: trainer.py:117-128 — the zoo here is closed, no fallback needed).
+
+    Mesh-dependent construction (ring attention, pipeline stages, MoE
+    axis/mesh threading) reads the RESOLVED topology
+    (parallel/partition/topology.py) rather than raw ``cfg.MESH``
+    integers, so ``-1`` wildcards and the dedicated ``expert`` axis
+    resolve identically here and in the lowering."""
+    if topology is None:
+        topology = partition_topology.from_cfg(cfg)
     kwargs = dict(
         num_classes=cfg.MODEL.NUM_CLASSES,
         dtype=resolve_dtype(cfg.DEVICE.COMPUTE_DTYPE),
@@ -169,12 +151,12 @@ def build_model_from_cfg():
         kwargs["fmap_size"] = (fmap, fmap)
         kwargs["attn_impl"] = cfg.DEVICE.ATTN_IMPL
     if cfg.MODEL.ARCH.startswith("vit"):
-        # MESH.SEQ>1 means sequence-sharded attention: route through ring
-        # attention over the seq axis. On a single chip,
+        # seq axis populated means sequence-sharded attention: route
+        # through ring attention over the seq axis. On a single chip,
         # DEVICE.ATTN_IMPL=blockwise selects O(L·chunk)-memory exact
         # attention (ops.ring_attention.blockwise_attention) for
         # high-resolution inputs. Dense XLA attention otherwise.
-        if cfg.MESH.SEQ not in (0, 1, -1):
+        if topology.seq > 1:
             kwargs["attn_impl"] = "ring"
             kwargs["mesh"] = mesh_lib.mesh_from_cfg(cfg)
         elif cfg.DEVICE.ATTN_IMPL in ("blockwise", "flash"):
@@ -194,21 +176,23 @@ def build_model_from_cfg():
                 "accept 'auto', 'xla' (dense), 'flash' (Pallas kernel), "
                 "'blockwise', or MESH.SEQ>1 for ring attention"
             )
-        if cfg.MESH.PIPE not in (0, 1):
-            # GPipe pipeline over the pipe axis (models/vit.PipelinedViT);
-            # the mesh resolves PIPE=-1 ("remaining devices") to a size
-            pipe_mesh = mesh_lib.mesh_from_cfg(cfg)
-            kwargs["pipe_stages"] = dict(pipe_mesh.shape)["pipe"]
+        if topology.pipe > 1:
+            # GPipe pipeline over the pipe axis (models/vit.PipelinedViT)
+            kwargs["pipe_stages"] = topology.pipe
             kwargs["pipe_microbatches"] = cfg.MESH.MICROBATCH
-            kwargs["mesh"] = pipe_mesh
+            kwargs["mesh"] = mesh_lib.mesh_from_cfg(cfg)
         if cfg.MODEL.ARCH.endswith("_moe"):
-            # expert parallelism over the model axis (models/vit.MoeMlp)
+            # expert parallelism: tensors/dispatch ride the dedicated
+            # ``expert`` axis when MESH.EXPERT > 1 (composes with TP on a
+            # 3-axis dp×tp×ep mesh), the ``model`` axis otherwise (the
+            # legacy layout — EP and TP time-share one axis)
             kwargs["moe_experts"] = cfg.MODEL.MOE.NUM_EXPERTS
             kwargs["moe_top_k"] = cfg.MODEL.MOE.TOP_K
             kwargs["moe_every"] = cfg.MODEL.MOE.EVERY
             kwargs["moe_impl"] = cfg.MODEL.MOE.IMPL
             kwargs["moe_capacity_factor"] = cfg.MODEL.MOE.CAPACITY_FACTOR
-            if cfg.MESH.MODEL not in (0, 1):
+            kwargs["moe_axis"] = topology.moe_axis()
+            if topology.expert > 1 or topology.model > 1:
                 kwargs["mesh"] = mesh_lib.mesh_from_cfg(cfg)
     return models.build_model(cfg.MODEL.ARCH, **kwargs)
 
@@ -256,284 +240,12 @@ def create_train_state(model, key, mesh, im_size: int, layout=None) -> TrainStat
 
 
 def _state_layout(model, mesh, im_size: int) -> dict:
-    """Resolved NamedSharding trees for the configured layout regime.
-
-    Returns ``{"params", "opt", "grads"}`` — param-shaped trees. With
-    ``MESH.ZERO`` off all three are the TP/PP-annotated base layout
-    (params replicated over ``data``, the DDP topology). Stage 1 moves
-    ``opt``/``grads`` to the ZeRO layout (``data`` added per leaf,
-    parallel/zero.py); stage 3 moves ``params`` too (FSDP)."""
-    import functools
-
-    dummy = jnp.ones((2, im_size, im_size, 3), jnp.float32)
-    abstract = jax.eval_shape(
-        functools.partial(model.init, train=False),
-        jax.random.key(0), dummy,
-    )
-    base = tp.param_shardings(mesh, abstract)["params"]
-    stage = cfg.MESH.ZERO
-    if not stage:
-        return {"params": base, "opt": base, "grads": base}
-    abstract_params = flax.linen.meta.unbox(abstract)["params"]
-    zsh = zero.zero_shardings(mesh, base, abstract_params)
-    return {
-        "params": zsh if stage == 3 else base,
-        "opt": zsh,
-        "grads": zsh,
-    }
-
-
-def _train_step_body(model, optimizer, topk: int, accum_steps: int = 1,
-                     layout=None):
-    """The pure step function shared by the per-step and folded paths.
-
-    ``layout`` (a ``_state_layout`` dict) is required when ``MESH.ZERO`` is
-    on: the gradient is constrained to the ZeRO layout right before the
-    optimizer update — GSPMD satisfies it with a reduce-scatter, fusing the
-    cross-replica grad mean with the shard slicing — and the outputs are
-    pinned back to the state's rest layout so buffer donation stays stable
-    across steps. ``None`` (the default) adds no constraints: GSPMD
-    propagates the replicated DDP layout exactly as before. Building a
-    step WITHOUT a layout while ``MESH.ZERO`` is set is refused — the
-    state (create_train_state) would rest ZeRO-sharded while the step
-    neither reduce-scatters grads nor pins outputs back, silently
-    skipping buffer donation and measuring a layout that is neither DDP
-    nor ZeRO.
-
-    ``accum_steps > 1`` runs that many sequential micro-batches, summing
-    gradients in-graph before ONE optimizer update (config:
-    ``TRAIN.GRAD_ACCUM_STEPS``). The batch must arrive pre-split as
-    ``(accum, micro_batch, ...)`` with the micro_batch dim sharded on
-    ``data`` (sharding.shard_micro_batch) — splitting on the host is a
-    zero-copy view, whereas an in-graph reshape of the data-sharded batch
-    dim would make GSPMD redistribute the whole batch over ICI every step.
-    Gradients are exact (the mean-CE micro-grads average to the full-batch
-    grad); BN stats are per-micro-batch — torch-DDP-with-accumulation
-    semantics. HBM holds one micro-batch of activations at a time.
-    """
-    if layout is None and cfg.MESH.ZERO:
-        raise ValueError(
-            f"MESH.ZERO={cfg.MESH.ZERO} requires the step to be built with "
-            "the ZeRO state layout (pass layout=_state_layout(...)): the "
-            "state rests ZeRO-sharded, and a layout-less step would neither "
-            "reduce-scatter grads nor pin rest layouts — a silent "
-            "neither-DDP-nor-ZeRO configuration."
-        )
-
-    # Non-finite loss guard (resilience/supervisor.py), compiled into the
-    # step: metrics always carry a ``nonfinite`` flag; under "skip" the
-    # poisoned update is discarded in-graph (pre-step state selected).
-    nonfinite_policy = supervisor.validate_policy(str(cfg.TRAIN.NONFINITE))
-
-    def apply_grads(state, grads, new_stats, metrics):
-        if layout is not None:
-            # ZeRO: reduce-scatter the grad into the sharded update
-            grads = zero.constrain(
-                grads, layout["grads"], scope="zero_reduce_scatter"
-            )
-        with jax.named_scope("optimizer_update"):
-            updates, new_opt_state = optimizer.update(
-                grads, state.opt_state, state.params
-            )
-            new_params = optax.apply_updates(state.params, updates)
-        if layout is not None:
-            # pin rest layouts (stage 1: params re-gathered to replicated;
-            # stage 3: params stay data-sharded) — keeps donation stable
-            new_params = zero.constrain(
-                new_params, layout["params"], scope="zero_rest_layout"
-            )
-            new_opt_state = tp.constrain_like(
-                new_opt_state, grads, layout["opt"]
-            )
-        new_state = TrainState(
-            params=new_params,
-            batch_stats=new_stats,
-            opt_state=new_opt_state,
-            step=state.step + 1,
-            key=state.key,
-        )
-        return supervisor.guard_nonfinite(
-            state, new_state, metrics, nonfinite_policy
-        )
-
-    # λ for the MoE load-balancing aux (models/vit.MoeMlp sows per-block
-    # values into ``intermediates``); captured at step-build time. Zero
-    # overhead for dense archs: the collection stays empty.
-    moe_aux_weight = float(cfg.MODEL.MOE.AUX_WEIGHT)
-    prep_images = _make_image_prep()
-    # FAULTS.NAN_STEP (utils/faults.py): trace-time gate — None (the
-    # common case) compiles nothing in; an int multiplies the loss by
-    # where(step==k, NaN, 1), poisoning loss AND grads at exactly step k.
-    nan_step = faults.nan_injection_step()
-
-    def loss_fn(params, stats, images, labels, key, step):
-        images = prep_images(images)
-        # attribution scope: the forward (and, through autodiff's
-        # transpose, its backward as transpose(fwd)/...) is nameable in
-        # HLO op metadata — trace_report / Perfetto split compute from
-        # the collective/update scopes below
-        with jax.named_scope("fwd"):
-            logits, mutated = model.apply(
-                {"params": params, "batch_stats": stats},
-                images,
-                train=True,
-                mutable=["batch_stats", "intermediates", "moe_stats"],
-                rngs={"dropout": key},
-            )
-        loss = cross_entropy(logits, labels)
-        aux = jax.tree.leaves(mutated.get("intermediates", {}))
-        if aux and moe_aux_weight:
-            loss = loss + moe_aux_weight * sum(aux) / len(aux)
-        if nan_step is not None:
-            loss = loss * jnp.where(
-                step == nan_step, jnp.float32(jnp.nan), jnp.float32(1.0)
-            )
-        # dispatch-MoE observability: per-block dropped-assignment
-        # fractions (models/vit.MoeMlp sows the sum; empty for dense and
-        # partial-MoE models — zero overhead there)
-        dstats = jax.tree.leaves(mutated.get("moe_stats", {}))
-        dropped = sum(dstats) / len(dstats) if dstats else None
-        return loss, (logits, mutated.get("batch_stats", {}), dropped)
-
-    grad_fn = jax.value_and_grad(loss_fn, has_aux=True)
-
-    def step_metrics(loss, logits, labels, dropped):
-        acc1, acck = accuracy(logits, labels, topk=(1, topk))
-        metrics = {"loss": loss, "top1": acc1, "topk": acck}
-        if dropped is not None:
-            metrics["moe_dropped"] = dropped
-        return metrics
-
-    def train_step(state: TrainState, batch):
-        step_key = jax.random.fold_in(state.key, state.step)
-        (loss, (logits, new_stats, dropped)), grads = grad_fn(
-            state.params, state.batch_stats, batch["image"], batch["label"],
-            step_key, state.step,
-        )
-        return apply_grads(
-            state, grads, new_stats,
-            step_metrics(loss, logits, batch["label"], dropped),
-        )
-
-    def accum_train_step(state: TrainState, micro):
-        step_key = jax.random.fold_in(state.key, state.step)
-        if micro["image"].shape[0] != accum_steps:
-            raise ValueError(
-                f"accum train step wants a pre-split (accum={accum_steps}, "
-                f"micro_batch, ...) input, got leading dim "
-                f"{micro['image'].shape[0]} — use sharding.shard_micro_batch"
-            )
-
-        def body(carry, mb):
-            stats, gsum, i = carry
-            mkey = jax.random.fold_in(step_key, i)
-            (loss, (logits, new_stats, dropped)), grads = grad_fn(
-                state.params, stats, mb["image"], mb["label"], mkey,
-                state.step,
-            )
-            gsum = jax.tree.map(jnp.add, gsum, grads)
-            return (new_stats, gsum, i + 1), step_metrics(
-                loss, logits, mb["label"], dropped
-            )
-
-        zeros = jax.tree.map(jnp.zeros_like, state.params)
-        if layout is not None:
-            # sharded accumulation buffer: each micro-grad reduce-scatters
-            # into it (ZeRO-2 semantics during accumulation — the standing
-            # grad-sum holds 1/N per rank)
-            zeros = zero.constrain(zeros, layout["grads"])
-        (new_stats, gsum, _), micro_metrics = jax.lax.scan(
-            body, (state.batch_stats, zeros, jnp.int32(0)), micro,
-            length=accum_steps,
-        )
-        grads = jax.tree.map(lambda g: g / accum_steps, gsum)
-        metrics = jax.tree.map(jnp.mean, micro_metrics)
-        return apply_grads(state, grads, new_stats, metrics)
-
-    return accum_train_step if accum_steps > 1 else train_step
-
-
-def make_train_step(model, optimizer, topk: int, accum_steps: int = 1,
-                    layout=None):
-    """Compile-once train step: fwd + CE loss + bwd + SGD + metrics
-    (≙ the hot loop body, ref: trainer.py:37-58)."""
-    return jax.jit(
-        _train_step_body(model, optimizer, topk, accum_steps, layout=layout),
-        donate_argnums=0,
-    )
-
-
-def make_scan_train_step(model, optimizer, topk: int, fold: int,
-                         accum_steps: int = 1, layout=None):
-    """``fold`` optimizer steps in ONE compiled call via ``lax.scan``.
-
-    Same math as ``fold`` sequential ``make_train_step`` calls (same body,
-    same per-step RNG folding via ``state.step``; results agree up to XLA
-    fusion-order float drift). The difference is dispatch: one host→device
-    launch per ``fold`` steps, so the per-step host overhead (~4 ms on
-    tunneled transports, PERF.md) amortizes away.
-    Takes a stacked batch pytree with leading dim ``fold`` (leaf shape
-    ``(fold, batch, ...)``) and returns stacked per-step metrics ``(fold,)``.
-    """
-    body = _train_step_body(model, optimizer, topk, accum_steps, layout=layout)
-
-    def scan_steps(state: TrainState, stacked_batch):
-        return jax.lax.scan(body, state, stacked_batch, length=fold)
-
-    return jax.jit(scan_steps, donate_argnums=0)
-
-
-def _make_image_prep():
-    """In-graph half of ``DATA.DEVICE_NORMALIZE`` (captured at step-build
-    time): the loader ships raw uint8, the step normalizes in fp32 —
-    identical formula/order to the host path (data/transforms.py).
-
-    Dtype-gated at trace time (r4, when the flag became default-True):
-    only uint8 batches are normalized. Float batches are ALREADY
-    normalized — by the host pipeline, or synthetic (bench.py, tests) —
-    and must pass through untouched, else flipping the default would have
-    silently re-normalized every float-feeding caller."""
-    if not cfg.DATA.DEVICE_NORMALIZE:
-        return lambda images: images
-    from distribuuuu_tpu.data.transforms import normalize_in_graph
-
-    def prep(images):
-        if images.dtype == jnp.uint8:
-            return normalize_in_graph(images)
-        return images
-
-    return prep
-
-
-def make_eval_step(model, topk: int):
-    """Masked eval step: per-batch metric sums + valid count
-    (≙ validate body, ref: trainer.py:77-89)."""
-    prep_images = _make_image_prep()
-
-    def eval_step(state: TrainState, batch):
-        with jax.named_scope("eval_fwd"):
-            logits = model.apply(
-                {"params": state.params, "batch_stats": state.batch_stats},
-                prep_images(batch["image"]),
-                train=False,
-            )
-        mask = batch["mask"]
-        logp = jax.nn.log_softmax(
-            logits.astype(head_dtype(logits.dtype)), axis=-1
-        )
-        nll = -jnp.take_along_axis(logp, batch["label"][:, None], axis=-1)[:, 0]
-        _, pred = jax.lax.top_k(logits, topk)  # topk pre-clamped (effective_topk)
-        hits = pred == batch["label"][:, None]
-        c1 = (hits[:, :1].any(axis=1) * mask).sum()
-        ck = (hits.any(axis=1) * mask).sum()
-        return {
-            "loss_sum": (nll * mask).sum(),
-            "correct1": c1,
-            "correctk": ck,
-            "count": mask.sum(),
-        }
-
-    return jax.jit(eval_step)
+    """Resolved NamedSharding trees for the configured layout regime:
+    ``{"params", "opt", "grads"}`` — param-shaped trees, from the
+    partition spec layer (parallel/partition/specs.state_layout: base
+    declarations + the ZeRO transform per ``cfg.MESH.ZERO``, every
+    derived leaf spec validated before GSPMD sees it)."""
+    return partition_specs.state_layout(model, mesh, im_size, cfg.MESH.ZERO)
 
 
 def effective_topk() -> int:
@@ -1329,7 +1041,7 @@ def train_model():
     mesh_lib.apply_backend_flags(cfg.DEVICE.DETERMINISTIC or cfg.CUDNN.DETERMINISTIC)
     mesh_lib.apply_platform(cfg.DEVICE.PLATFORM)
     mesh_lib.setup_distributed()
-    check_trainer_mesh()
+    topo = check_trainer_mesh()
     setup_env()
     logger = setup_logger()
     setup_metrics_log(cfg.OUT_DIR, primary=mesh_lib.is_primary())
@@ -1338,35 +1050,38 @@ def train_model():
     # every process, unlike the primary-only metrics.jsonl above
     telemetry.setup_from_cfg(cfg, rank=jax.process_index())
     mesh = mesh_lib.mesh_from_cfg(cfg)
+    # cost.* records carry the resolved mesh/topology so post-mortem
+    # consumers attribute comm volume per mesh axis (ISSUE 9 satellite)
+    costmodel.set_mesh_extras(
+        {"mesh": topo.axes, "topology": topo.class_name()}
+    )
     key = setup_seed()
 
     accum = max(1, cfg.TRAIN.GRAD_ACCUM_STEPS)
     check_batch_geometry(mesh)
 
-    model = build_model_from_cfg()
-    layout = _state_layout(model, mesh, cfg.TRAIN.IM_SIZE)
+    # ONE lowering for every topology (parallel/partition/lowering.py):
+    # dp / dp×tp / PP / ZeRO-1/3 / EP and their compositions all build
+    # from the declared specs — no per-topology step assembly left here.
+    model = build_model_from_cfg(topo)
+    lowered = partition_lowering.lower(
+        model, construct_optimizer(), effective_topk(), mesh=mesh,
+        topology=topo, im_size=cfg.TRAIN.IM_SIZE,
+        fold=max(1, cfg.TRAIN.STEPS_PER_CALL), accum=accum,
+    )
+    layout = lowered.layout
     state = create_train_state(model, key, mesh, cfg.TRAIN.IM_SIZE, layout=layout)
     m_params, mb = count_parameters(state.params)
     logger.info(
-        "model %s: %.3fM params (%.2f MB fp32), mesh %s",
-        cfg.MODEL.ARCH, m_params, mb, dict(mesh.shape),
+        "model %s: %.3fM params (%.2f MB fp32), mesh %s [%s]",
+        cfg.MODEL.ARCH, m_params, mb, dict(mesh.shape), topo.class_name(),
     )
 
-    optimizer = construct_optimizer()
     train_loader = construct_train_loader()
     val_loader = construct_val_loader()
-    step_layout = layout if cfg.MESH.ZERO else None
-    train_step = make_train_step(
-        model, optimizer, effective_topk(), accum_steps=accum,
-        layout=step_layout,
-    )
-    scan_step = None
-    if cfg.TRAIN.STEPS_PER_CALL > 1:
-        scan_step = make_scan_train_step(
-            model, optimizer, effective_topk(), cfg.TRAIN.STEPS_PER_CALL,
-            accum_steps=accum, layout=step_layout,
-        )
-    eval_step = make_eval_step(model, effective_topk())
+    train_step = lowered.train_step
+    scan_step = lowered.scan_step
+    eval_step = lowered.eval_step
 
     start_epoch, best_acc1, pending_eval = 0, 0.0, None
     resumed = False
@@ -1563,14 +1278,17 @@ def test_model():
     mesh_lib.apply_backend_flags(cfg.DEVICE.DETERMINISTIC or cfg.CUDNN.DETERMINISTIC)
     mesh_lib.apply_platform(cfg.DEVICE.PLATFORM)
     mesh_lib.setup_distributed()
-    check_trainer_mesh()
+    topo = check_trainer_mesh()
     logger = setup_logger()
     telemetry.setup_from_cfg(cfg, rank=jax.process_index())
     mesh = mesh_lib.mesh_from_cfg(cfg)
+    costmodel.set_mesh_extras(
+        {"mesh": topo.axes, "topology": topo.class_name()}
+    )
     # eval-only checks (GPipe eval divisibility), before the compile — a
     # train-invalid config must not block a pure evaluation (ADVICE r3 #2)
     check_batch_geometry(mesh, eval_only=True)
-    model = build_model_from_cfg()
+    model = build_model_from_cfg(topo)
     key = jax.random.key(cfg.RNG_SEED or 0)
     state = create_train_state(model, key, mesh, cfg.TRAIN.IM_SIZE)
     if cfg.MODEL.WEIGHTS:

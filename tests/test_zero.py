@@ -134,12 +134,16 @@ def test_zero_stage_validation():
     cfg.MESH.ZERO = 2
     with pytest.raises(ValueError, match="stage 2 is"):
         trainer.check_trainer_mesh()
+    # ZeRO-3 under PP was refused before the partition layer (r11); it is
+    # now a supported LAYOUT — FSDP params gather at the stage shard_map
+    # boundary (in_specs), the backward reduce-scatters. The stanza must
+    # validate and classify with both features.
     config.reset_cfg()
     cfg.MESH.ZERO = 3
     cfg.MESH.PIPE = 2
     cfg.MODEL.ARCH = "vit_tiny"
-    with pytest.raises(ValueError, match="FSDP-sharded"):
-        trainer.check_trainer_mesh()
+    topo = trainer.check_trainer_mesh()
+    assert {"pp", "zero3"} <= set(topo.describe()["features"])
 
 
 # ------------------------------------------------------------- layout level

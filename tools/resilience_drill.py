@@ -267,6 +267,44 @@ def drill_decode_error_skip(work):
     return all(checks.values()), checks
 
 
+@_drill("partition_elastic")
+def drill_partition_elastic(work):
+    """Partition-layer elastic resume (r11): save on a dp=4·tp=2 ZeRO-1
+    mesh, resume on dp=2·tp=4 (same 8 virtual devices — orbax cannot
+    materialize a save onto a SMALLER device set, so elasticity is mesh-
+    SHAPE elasticity, as in the PR 3 drills). The restart must classify
+    the transition through the partition topology record in the manifest
+    (named per-axis diffs: data 4→2, model 2→4), re-place every array
+    onto the live layout — ZeRO-1 optimizer shards reassembled across
+    the dp resize, TP-annotated kernels resharded 2→4-way — and
+    complete."""
+    out = os.path.join(work, "out")
+    rc, log = _run_worker(
+        work, out,
+        ("OPTIM.MAX_EPOCH", 1, "MESH.DATA", 4, "MESH.MODEL", 2,
+         "MESH.ZERO", 1),
+        tag="save", env_extra={"DTPU_DRILL_NDEV": "8"},
+    )
+    if rc != 0:
+        return False, f"save run failed rc={rc}: {log[-500:]}"
+    rc, log = _run_worker(
+        work, out,
+        ("OPTIM.MAX_EPOCH", 2, "MESH.DATA", 2, "MESH.MODEL", 4,
+         "MESH.ZERO", 1),
+        tag="resume", env_extra={"DTPU_DRILL_NDEV": "8"},
+    )
+    checks = {
+        "resume_rc==0": rc == 0,
+        "elastic_classified": "elastic resume" in log,
+        "partition_detail": "partition layout" in log
+        and "data 4→2" in log and "model 2→4" in log,
+        "resumed_from_epoch0": "resumed from" in log and "ckpt_ep_000" in log,
+        "completed": "DRILL_DONE" in log,
+        "epoch1_saved": "ckpt_ep_001" in _ckpts(out),
+    }
+    return all(checks.values()), checks
+
+
 @_drill("stall_watchdog")
 def drill_stall_watchdog(work):
     out = os.path.join(work, "out")
@@ -616,7 +654,8 @@ def main():
         drill_truncated_checkpoint, drill_partial_checkpoint,
         drill_nan_skip, drill_nan_rollback,
         drill_decode_error_retry, drill_decode_error_skip,
-        drill_stall_watchdog, drill_shards_midepoch_resume,
+        drill_stall_watchdog, drill_partition_elastic,
+        drill_shards_midepoch_resume,
         drill_fleet_replica_kill,
     ]
     if not args.skip_multiprocess:

@@ -137,13 +137,21 @@ def scope_of(op_name: str) -> str | None:
     named_scope names land as path components), else None. Autodiff
     decorates the component — the forward under ``jax.value_and_grad``
     shows as ``jvp(fwd)``, its backward as ``transpose(jvp(fwd))`` —
-    so components are unwrapped before matching."""
+    so components are unwrapped before matching.
+
+    The partition lowering suffixes its spec-induced collective scopes
+    with the mesh axes they run over (``zero_reduce_scatter@data``,
+    r11): those roll up under the FULL axis-qualified name, so the
+    scopes table attributes comm per mesh axis."""
     for part in op_name.split("/"):
         core = (
             part.replace("transpose(", "").replace("jvp(", "")
             .replace("vjp(", "").rstrip(")")
         )
         if core in ATTRIBUTION_SCOPES:
+            return core
+        base = core.split("@", 1)[0]
+        if "@" in core and base in ATTRIBUTION_SCOPES:
             return core
     return None
 
