@@ -1,0 +1,46 @@
+"""Async execution plane (ISSUE 10, ROADMAP open item #4): take
+checkpoint save, eval, and (re)compilation off the trainer's critical
+path without giving up one bit of the crash-consistency and determinism
+story.
+
+Three coordinated pieces, each a module here:
+
+    committer.py      async checkpoint commit — the trainer blocks only
+                      for a device→host snapshot of the state tree; a
+                      background committer thread writes the orbax
+                      payload and commits MANIFEST.json strictly LAST
+                      (the PR 3 atomic-manifest protocol survives: a
+                      process killed mid-async-save leaves a dir that
+                      find_last_valid_checkpoint quarantines and walks
+                      back over). Join barriers before the next save, at
+                      preemption, and at exit; at most one commit in
+                      flight (bounded snapshot memory).
+    evalloop.py       concurrent eval — validate() runs against an
+                      on-device epoch-boundary snapshot on a worker
+                      thread while the next train epoch dispatches;
+                      results (and the best-acc bookkeeping + log
+                      records) join at the following boundary.
+    compile_cache.py  persistent compilation cache — JAX's on-disk
+                      executable cache behind the COMPILE_CACHE config
+                      node, with hit/miss counters: a warm restart skips
+                      the compile storm, and a cache hit is counted as a
+                      hit, not a compile (telemetry/runtime.py).
+
+Hard contracts (tests/test_asyncplane.py): the manifest is written
+strictly after every payload byte; async-everything on ≡ fully-sync run
+bit-identical (checkpoint state trees and eval metrics); concurrent-eval
+results ≡ sync validate() results.
+
+Grounding: "Exploring the limits of Concurrency in ML Training on
+Google TPUs" (arXiv:2011.03641) attributes MLPerf-scale wins to exactly
+these host-side overlaps.
+"""
+
+from distribuuuu_tpu.asyncplane.committer import (  # noqa: F401
+    AsyncCommitError,
+    join_commits,
+    pending_commits,
+    snapshot_tree,
+    submit_commit,
+)
+from distribuuuu_tpu.asyncplane.evalloop import ConcurrentEval  # noqa: F401

@@ -1,0 +1,172 @@
+"""Background checkpoint committer: the off-critical-path half of an
+async save.
+
+The trainer's save cost splits into two very different halves: the
+device→host snapshot (must happen before the next epoch's steps DONATE
+the state buffers — ``donate_argnums=0`` invalidates them) and the
+durable commit (orbax payload write, per-file sha256 digests, atomic
+``MANIFEST.json``). Only the first half has any business on the epoch
+loop's critical path; this module runs the second on a daemon thread.
+
+Protocol invariants, unchanged from the synchronous path
+(resilience/manifest.py):
+
+* the manifest commits strictly AFTER every payload byte is on disk —
+  a process killed anywhere inside the async commit leaves a
+  manifest-less directory that ``find_last_valid_checkpoint``
+  quarantines and walks back over (drilled:
+  ``tools/resilience_drill.py killed_mid_async_save``);
+* at most ONE commit is in flight: ``submit_commit`` joins the previous
+  commit first, so snapshot memory is bounded and commit order is save
+  order;
+* a failed commit is not silent: the error is re-raised (as
+  ``AsyncCommitError``) at the next join — before the next save, at
+  preemption, at exit — never swallowed.
+
+Every committed save leaves a ``kind="ckpt.async"`` telemetry record
+splitting on-path (``snapshot_s``) from off-path (``commit_s``) time;
+the commit itself runs under a ``ckpt_commit`` span
+(tools/run_report.py reports both sides).
+"""
+
+from __future__ import annotations
+
+import atexit
+import threading
+import time
+
+import numpy as np
+
+from distribuuuu_tpu.telemetry import spans as telemetry_spans
+from distribuuuu_tpu.utils.logger import get_logger
+
+
+class AsyncCommitError(RuntimeError):
+    """A background checkpoint commit failed; raised at the next join
+    barrier (the save that queued it already returned to the trainer)."""
+
+
+_state: dict = {
+    "thread": None,   # the in-flight commit, or None
+    "label": None,    # its checkpoint basename (for logs/errors)
+    "error": None,    # (label, exception) from a failed commit
+    "commits": 0,     # total commits completed this process
+    "atexit": False,  # exit-barrier registered
+}
+_lock = threading.Lock()
+
+
+def snapshot_tree(tree):
+    """Donation-safe host copy of a checkpoint payload: every
+    ``jax.Array`` leaf is fetched to host (``np.asarray`` blocks until
+    the device buffer is ready and copies it), so the trainer may donate
+    the originals to the next step the moment this returns. Non-array
+    leaves (python scalars, numpy) pass through untouched."""
+    import jax
+
+    def _snap(leaf):
+        if isinstance(leaf, jax.Array):
+            return np.asarray(leaf)
+        return leaf
+
+    return jax.tree.map(_snap, tree)
+
+
+def pending_commits() -> bool:
+    """True while a commit is in flight (tests, drain diagnostics)."""
+    t = _state["thread"]
+    return t is not None and t.is_alive()
+
+
+def submit_commit(label: str, fn) -> None:
+    """Queue ``fn`` (the durable-commit closure: payload write →
+    manifest LAST) on the committer thread. Joins the previous commit
+    first — the barrier that keeps one commit in flight and surfaces a
+    prior failure before new work piles on it."""
+    join_commits()
+    with _lock:
+        if not _state["atexit"]:
+            # exit barrier: a normally-exiting process never abandons a
+            # half-committed save (SIGKILL is what the walk-back is for)
+            atexit.register(_drain_at_exit)
+            _state["atexit"] = True
+        t = threading.Thread(
+            target=_run, args=(label, fn), daemon=True,
+            name="dtpu-ckpt-committer",
+        )
+        _state["thread"] = t
+        _state["label"] = label
+    t.start()
+
+
+def _run(label: str, fn) -> None:
+    t0 = time.perf_counter()
+    try:
+        fn()
+        _state["commits"] += 1
+    except BaseException as e:  # surfaces at the next join, never silent
+        _state["error"] = (label, e)
+        get_logger().error(
+            "async checkpoint commit FAILED for %s after %.2fs: %s",
+            label, time.perf_counter() - t0, e,
+        )
+
+
+def join_commits(reason: str = "") -> None:
+    """The join barrier: block until the in-flight commit (if any) is
+    durable, then re-raise any commit failure. ``reason`` names the
+    barrier in the drain log line (preemption / exit / next-save)."""
+    with _lock:
+        t = _state["thread"]
+        label = _state["label"]
+        _state["thread"] = None
+        _state["label"] = None
+    if t is not None:
+        waited = t.is_alive()
+        # the join runs outside the epoch loop (no Heartbeat thread):
+        # watch_blocking flags a commit wedged on hung storage with the
+        # same stall contract (TRAIN.STALL_TIMEOUT; 0 = no watcher)
+        from distribuuuu_tpu.config import cfg
+        from distribuuuu_tpu.resilience import supervisor
+
+        with supervisor.watch_blocking(
+            f"async checkpoint commit ({label})", cfg.TRAIN.STALL_TIMEOUT
+        ):
+            t.join()
+        if reason:
+            get_logger().info(
+                "async checkpoint committer drained (%s): %s %s; "
+                "%d commit(s) completed this process",
+                reason, label,
+                "joined in-flight commit" if waited else "already durable",
+                _state["commits"],
+            )
+    err = _state["error"]
+    if err is not None:
+        _state["error"] = None
+        elabel, e = err
+        raise AsyncCommitError(
+            f"async checkpoint commit failed for {elabel}: "
+            f"{type(e).__name__}: {e}. The checkpoint directory has NO "
+            "committed manifest — auto-resume will quarantine it and walk "
+            "back to the previous intact save."
+        ) from e
+
+
+def _drain_at_exit() -> None:
+    # atexit must not raise; a failed final commit is logged (above) and
+    # the manifest-less dir is handled by the next start's walk-back
+    try:
+        join_commits(reason="exit")
+    except AsyncCommitError:
+        pass
+
+
+def emit_commit_record(ckpt: str, snapshot_s: float, commit_s: float,
+                       ok: bool = True) -> None:
+    """One ``kind="ckpt.async"`` record per async save: the on-path /
+    off-path split run_report's checkpoint section attributes."""
+    telemetry_spans.emit_event(
+        "ckpt.async", ckpt=ckpt, snapshot_s=round(float(snapshot_s), 6),
+        commit_s=round(float(commit_s), 6), ok=bool(ok),
+    )

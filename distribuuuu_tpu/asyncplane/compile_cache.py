@@ -1,0 +1,89 @@
+"""Persistent compilation cache wiring (the ``COMPILE_CACHE`` node).
+
+A restart — crash recovery, preemption resume, elastic resume at the
+same topology, a rolling serve-replica deploy — pays the full compile
+storm again: every step program, every serve bucket, every reshard
+helper. JAX ships an on-disk executable cache keyed on (program, flags,
+backend); this module turns it on from config, points it at a
+restart-stable directory, and makes its effect OBSERVABLE:
+
+* ``jit.cache_hits`` / ``jit.cache_misses`` registry counters and one
+  ``kind="compile.cache"`` telemetry record per lookup
+  (telemetry/runtime.py listens on jax's monitoring bus);
+* a compile served from the cache is counted as a HIT, **not** as a
+  ``jit.compiles`` compile — deserializing an executable is not a
+  compilation, and the recompile-storm alert / run_report recompile
+  count must not fire on a deliberately warm restart.
+
+``tools/asyncplane_bench.py`` runs the cold/warm restart pair and
+records the proof into BENCH_r06.json (warm-restart ``jit.compiles`` at
+or near zero for previously-compiled step programs).
+"""
+
+from __future__ import annotations
+
+import os
+
+from distribuuuu_tpu.utils.logger import get_logger
+
+
+def validate_cfg(cc) -> None:
+    """Refuse nonsense knob values before they reach jax.config (the
+    cache failing open at runtime would just silently not cache)."""
+    if float(cc.MIN_COMPILE_TIME_S) < 0:
+        raise ValueError(
+            f"COMPILE_CACHE.MIN_COMPILE_TIME_S={cc.MIN_COMPILE_TIME_S}: "
+            "must be >= 0 (0 caches every compile)"
+        )
+    if int(cc.MAX_SIZE_MB) < 0:
+        raise ValueError(
+            f"COMPILE_CACHE.MAX_SIZE_MB={cc.MAX_SIZE_MB}: must be >= 0 "
+            "(0 = unbounded)"
+        )
+
+
+def setup_from_cfg(cfg) -> str | None:
+    """Apply the ``COMPILE_CACHE`` node. Returns the resolved cache dir
+    when enabled, None otherwise.
+
+    The knob is authoritative per run: ENABLED False actively CLEARS any
+    previously-configured cache dir (jax config is process-global —
+    without the clear, a later run in the same process would silently
+    keep writing into the earlier run's cache directory).
+    """
+    import jax
+
+    cc = cfg.COMPILE_CACHE
+    validate_cfg(cc)
+    if not cc.ENABLED:
+        if getattr(jax.config, "jax_compilation_cache_dir", None):
+            jax.config.update("jax_compilation_cache_dir", None)
+        return None
+    cache_dir = os.path.abspath(
+        cc.DIR or os.path.join(cfg.OUT_DIR, "compile_cache")
+    )
+    os.makedirs(cache_dir, exist_ok=True)
+    jax.config.update("jax_enable_compilation_cache", True)
+    jax.config.update("jax_compilation_cache_dir", cache_dir)
+    # jax's own default (1s) skips everything test/CPU-sized; the node
+    # default (0) persists every compile — restarts are what we optimize
+    jax.config.update(
+        "jax_persistent_cache_min_compile_time_secs",
+        float(cc.MIN_COMPILE_TIME_S),
+    )
+    jax.config.update("jax_persistent_cache_min_entry_size_bytes", -1)
+    if int(cc.MAX_SIZE_MB) > 0:
+        jax.config.update(
+            "jax_compilation_cache_max_size", int(cc.MAX_SIZE_MB) * 2**20
+        )
+    # hit/miss observability rides the same monitoring bus as the
+    # compile listener; installing here covers serve/test entrypoints too
+    from distribuuuu_tpu.telemetry import runtime as telemetry_runtime
+
+    telemetry_runtime.install_compile_listener()
+    get_logger().info(
+        "persistent compilation cache: %s (min_compile_time %.3fs%s)",
+        cache_dir, float(cc.MIN_COMPILE_TIME_S),
+        f", max {int(cc.MAX_SIZE_MB)} MB" if int(cc.MAX_SIZE_MB) else "",
+    )
+    return cache_dir

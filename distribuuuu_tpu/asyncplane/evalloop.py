@@ -1,0 +1,132 @@
+"""Concurrent eval: validate() overlapped with the next train epoch.
+
+The synchronous epoch boundary serializes train → eval → save → train:
+on a chip-bound run the devices sit idle for the whole eval wall. Here
+the trainer instead takes an ON-DEVICE copy of the state leaves eval
+reads (params + batch_stats — the train loop donates the originals to
+the next epoch's steps, so a copy is mandatory, not an optimization) and
+hands it to a worker thread running the REAL ``trainer.validate`` body;
+the result joins — with best-acc bookkeeping and the ``eval``/``epoch``
+log records — at the following epoch boundary.
+
+Determinism: eval is a pure read of its snapshot; training math never
+observes it, so the training trajectory is bit-identical with the
+feature on or off (tests/test_asyncplane.py pins it end-to-end), and the
+eval metrics themselves are identical too — same snapshot values, same
+val batches, same order.
+
+Logging discipline: the worker runs ``validate`` with ``quiet=True`` so
+the "Eval[..]" line and the ``kind="eval"`` metrics record are emitted
+by the MAIN thread at join time — telemetry consumers see the same
+record order a synchronous run produces (per-batch eval spans, which
+carry their own timestamps, land as they happen).
+
+Single-process, single-DEVICE only (the trainer enforces it): two
+multi-device SPMD programs dispatched from two host threads can enqueue
+in different orders on different per-device queues — their collectives
+then cross-wait and the backend deadlocks (observed on the virtual
+8-device CPU mesh: eval's AllReduce waiting on train's across ranks).
+One device means one queue and no collectives, so any interleaving is
+safe. The trainer degrades to synchronous eval with a logged warning
+otherwise; lifting this needs a per-device dispatch-order guarantee
+(future work).
+"""
+
+from __future__ import annotations
+
+import threading
+
+import jax
+import jax.numpy as jnp
+
+
+def device_snapshot(tree):
+    """On-device copy of every ``jax.Array`` leaf (sharding preserved —
+    ``jnp.copy`` computes under the input's sharding). The copies are
+    NOT donated anywhere, so the eval worker may read them for as long
+    as it likes while the train loop donates the originals."""
+
+    def _copy(leaf):
+        if isinstance(leaf, jax.Array):
+            return jnp.copy(leaf)
+        return leaf
+
+    return jax.tree.map(_copy, tree)
+
+
+class ConcurrentEval:
+    """One in-flight eval at a time, launched per epoch boundary.
+
+    ``eval_fn(snapshot_state, epoch)`` is the trainer-provided closure
+    (validate with quiet=True against the snapshot); ``launch`` captures
+    the snapshot BEFORE returning (the caller may donate the live state
+    immediately after); ``join`` blocks for the result and re-raises a
+    worker failure — an eval crash must fail the run, not vanish on a
+    daemon thread.
+    """
+
+    def __init__(self, eval_fn):
+        self._eval_fn = eval_fn
+        self._thread: threading.Thread | None = None
+        self._epoch: int | None = None
+        self._snap = None
+        self._result = None
+        self._error: BaseException | None = None
+
+    @property
+    def in_flight(self) -> bool:
+        return self._thread is not None
+
+    def launch(self, state, epoch: int) -> None:
+        """Snapshot ``state``'s eval-visible leaves and start the worker.
+        The previous eval must have been joined (one in flight keeps the
+        bookkeeping ordered and the snapshot memory bounded)."""
+        if self._thread is not None:
+            raise RuntimeError(
+                "ConcurrentEval.launch with an eval still in flight — "
+                "join() the previous epoch's result first"
+            )
+        # eval reads params/batch_stats (+ the step/key leaves ride along
+        # in the TrainState signature); copy them all — the originals are
+        # donated to the next epoch's first step
+        snap = state.replace(
+            params=device_snapshot(state.params),
+            batch_stats=device_snapshot(state.batch_stats),
+            opt_state={},  # eval never reads it; dropping it halves the copy
+            step=device_snapshot(state.step),
+            key=state.key,  # the base key is never rewritten by steps
+        )
+        self._epoch = int(epoch)
+        self._snap = snap
+        self._result = None
+        self._error = None
+
+        def _work():
+            try:
+                self._result = self._eval_fn(snap, self._epoch)
+            except BaseException as e:
+                self._error = e
+
+        self._thread = threading.Thread(
+            target=_work, daemon=True, name="dtpu-concurrent-eval"
+        )
+        self._thread.start()
+
+    def join(self):
+        """Block for the in-flight eval; returns ``(epoch, result,
+        snapshot)`` or ``None`` when nothing is in flight. ``result`` is
+        whatever ``eval_fn`` returned (the validate 4-tuple, or None if
+        the eval was abandoned); ``snapshot`` is the state copy the eval
+        ran against — the caller writes the weights-only ``best``
+        checkpoint from it when the result sets a new best (the live
+        state has long been donated to the next epoch's steps)."""
+        if self._thread is None:
+            return None
+        self._thread.join()
+        self._thread = None
+        if self._error is not None:
+            err, self._error = self._error, None
+            raise err
+        epoch, result, snap = self._epoch, self._result, self._snap
+        self._epoch, self._result, self._snap = None, None, None
+        return epoch, result, snap

@@ -482,6 +482,13 @@ _C.FAULTS.RECOMPILE_N = 8
 # (keep SLOWDOWN_MS well under TRAIN.STALL_TIMEOUT). 0 = off.
 _C.FAULTS.SLOWDOWN_EPOCH = 0
 _C.FAULTS.SLOWDOWN_MS = 0.0
+# SIGKILL the process from the async checkpoint committer thread AFTER
+# ckpt_ep_{KILL_MID_ASYNC_SAVE}'s orbax payload is fully written but
+# BEFORE its MANIFEST.json commits (CHECKPOINT.ASYNC) — the async-save
+# crash window. The restart must quarantine the manifest-less directory
+# and walk back to the previous intact checkpoint
+# (tools/resilience_drill.py killed_mid_async_save). -1 = off.
+_C.FAULTS.KILL_MID_ASYNC_SAVE = -1
 # Truncate shard file #TRUNCATE_SHARD of the dataset split to 60% of its
 # manifest size before the reader opens it (DATA.FORMAT=shards): kills the
 # index footer and the tail records — the reader must recover the index by
@@ -493,6 +500,69 @@ _C.FAULTS.TRUNCATE_SHARD = -1
 # (crash-before-commit path). -1 = off.
 _C.FAULTS.CORRUPT_EPOCH = -1
 _C.FAULTS.CORRUPT_MODE = "truncate"
+
+# ------------------------------- checkpointing ------------------------------
+# Async execution plane (distribuuuu_tpu/asyncplane/): checkpoint commit off
+# the trainer's critical path. With ASYNC on, a save blocks the epoch loop
+# only for the device→host snapshot of the state tree (donation-safe copy);
+# the orbax payload write, file digests, and the atomic MANIFEST.json commit
+# run on a background committer thread. The PR 3 crash-consistency protocol
+# is preserved exactly — the manifest is still written strictly LAST, so a
+# process killed mid-async-save leaves a manifest-less directory that
+# find_last_valid_checkpoint quarantines and walks back over. A join
+# barrier runs before the next save (at most one commit in flight), at
+# preemption (the committer drains inside the SIGTERM grace window before
+# the preempt save), and at exit. Telemetry splits the cost:
+# "ckpt_snapshot" spans are the on-path time, "ckpt_commit" spans the
+# off-path time (tools/run_report.py reports both). Single-process runs
+# only — multi-host saves are collective, so ASYNC degrades to the
+# synchronous protocol with a logged warning.
+_C.CHECKPOINT = CfgNode()
+_C.CHECKPOINT.ASYNC = False
+
+# Run validate() concurrently with the NEXT train epoch (asyncplane/
+# evalloop.py): at each epoch boundary the trainer takes an on-device copy
+# of params/batch_stats and hands it to an eval worker thread; the result
+# joins — with best-acc/is_best bookkeeping and the "eval"/"epoch" log
+# records — at the following boundary. Trajectory-neutral by contract
+# (eval reads a snapshot; training math never sees it —
+# tests/test_asyncplane.py pins async-everything ≡ sync bit-identically).
+# Epoch checkpoints record best_acc1 as of one eval earlier (the in-flight
+# eval hasn't joined when the boundary save happens); the weights-only
+# "best" checkpoint itself is always written when a new best joins.
+# Single-process, single-DEVICE runs only: two multi-device SPMD programs
+# dispatched from two host threads can enqueue in different orders on
+# different per-device queues, cross-wait in their collectives, and
+# deadlock the backend (observed on the virtual 8-device CPU mesh).
+# Anything else degrades to synchronous eval with a logged warning.
+_C.TRAIN.CONCURRENT_EVAL = False
+
+# ------------------------------- compilation cache ---------------------------
+# JAX persistent compilation cache (asyncplane/compile_cache.py): compiled
+# step programs are serialized to DIR, so a restart — crash recovery,
+# preemption resume, elastic resume at the same topology — skips the
+# compile storm PR 5's jit.compiles counter measures. Cache hits/misses
+# are counted (jit.cache_hits / jit.cache_misses registry counters +
+# kind="compile.cache" telemetry records); a compile served from the
+# cache is NOT counted as a jit.compile (it is a deserialization, not a
+# compilation), so a warm restart shows jit.compiles at/near zero for
+# previously-compiled programs (tools/asyncplane_bench.py proves it into
+# BENCH_r06.json). TRADE-OFF: while the cache is active the cost-model
+# HBM ledger (TELEMETRY.COSTMODEL_MEMORY) is skipped — its extra AOT
+# compile corrupts the CPU backend heap when combined with the cache's
+# executable (de)serialization and a checkpoint restore in one process
+# (PERF.md "Async execution plane"); cost.step/cost.roofline still emit.
+_C.COMPILE_CACHE = CfgNode()
+_C.COMPILE_CACHE.ENABLED = False
+# Cache directory; "" = {OUT_DIR}/compile_cache (restarts of the same run
+# share it). Point several runs at one absolute path to share compiles
+# across output dirs (the cache key covers program + flags + backend).
+_C.COMPILE_CACHE.DIR = ""
+# Only compiles at least this long are persisted (0 caches everything —
+# jax's own default of 1s would skip most CPU-test-sized programs).
+_C.COMPILE_CACHE.MIN_COMPILE_TIME_S = 0.0
+# Evict least-recently-used entries past this size. 0 = unbounded.
+_C.COMPILE_CACHE.MAX_SIZE_MB = 0
 
 # ------------------------------- serving ------------------------------------
 # Online inference (serve/, serve_net.py) — the request-level engine that

@@ -131,21 +131,41 @@ def manifest_path(ckpt_dir: str) -> str:
 
 
 def write_manifest(ckpt_dir: str, payload, kind: str = "full",
-                   epoch: int | None = None) -> str:
+                   epoch: int | None = None,
+                   fsync_payload: bool = False) -> str:
     """Commit marker for a completed save. Call AFTER the orbax write has
     returned on every process, from the primary only (a plain filesystem
-    op, like ``prune_preempts``). Atomic: tmp file + ``os.replace``."""
+    op, like ``prune_preempts``). Atomic: tmp file + ``os.replace``.
+
+    ``fsync_payload`` (the async committer sets it — utils/checkpoint.py
+    ``CHECKPOINT.ASYNC``) fsyncs every payload file and its directory
+    BEFORE the manifest commits, so the commit-marker ordering holds
+    through a power loss, not just a process death: a durable manifest
+    can then never describe payload bytes the kernel still held. Off the
+    critical path the fsync pass is free to the trainer; the synchronous
+    protocol keeps the classic ordering (process-death-safe) by default."""
     files = {}
+    dirs = set()
     for dirpath, _, names in os.walk(ckpt_dir):
         for name in sorted(names):
             if name in (MANIFEST_NAME, MANIFEST_NAME + ".tmp"):
                 continue
             full = os.path.join(dirpath, name)
             rel = os.path.relpath(full, ckpt_dir)
+            if fsync_payload:
+                with open(full, "rb") as pf:
+                    os.fsync(pf.fileno())
+                dirs.add(dirpath)
             files[rel] = {
                 "size": os.path.getsize(full),
                 "sha256": _sha256_file(full),
             }
+    for d in sorted(dirs):  # directory entries durable before the marker
+        fd = os.open(d, os.O_RDONLY)
+        try:
+            os.fsync(fd)
+        finally:
+            os.close(fd)
     man = {
         "schema": MANIFEST_SCHEMA,
         "kind": kind,

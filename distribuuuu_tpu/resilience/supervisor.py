@@ -35,6 +35,7 @@ from __future__ import annotations
 
 import threading
 import time
+from contextlib import contextmanager
 
 import jax
 import jax.numpy as jnp
@@ -136,6 +137,54 @@ class NonFiniteMonitor:
             policy=self.policy,
         )
         raise NonFiniteLossError(self.epoch, batch, loss)
+
+
+@contextmanager
+def watch_blocking(label: str, timeout: float, logger=None):
+    """Stall coverage for blocking host-side operations OUTSIDE the
+    epoch loop, where no ``Heartbeat`` thread is running: the async
+    checkpoint committer's join barrier, a preemption drain, a restore.
+    Same signal contract as the heartbeat — a warning line, the
+    ``resilience.stalls`` counter, and a ``kind="stall"`` record — when
+    the wrapped block exceeds ``timeout`` seconds (the operator's first
+    clue that storage, not training, is what hung). ``timeout <= 0``
+    disables (zero overhead: no thread is started). Flag, not kill —
+    the block keeps waiting; the restart decision stays external."""
+    timeout = float(timeout)
+    if timeout <= 0:
+        yield
+        return
+    logger = logger or get_logger()
+    done = threading.Event()
+    t0 = time.monotonic()
+
+    def _watch():
+        while not done.wait(min(timeout / 4.0, 1.0)):
+            age = time.monotonic() - t0
+            if age > timeout:
+                logger.warning(
+                    "blocked in %s for %.1fs (threshold %.1fs) — hung "
+                    "storage or a wedged background commit; see "
+                    "docs/RUNBOOK.md 'Async checkpointing and warm "
+                    "restarts'", label, age, timeout,
+                )
+                telemetry_registry.get_registry().counter(
+                    "resilience.stalls"
+                ).inc(1)
+                metrics_log(
+                    "stall", age_s=round(age, 3), last=label, count=1
+                )
+                return  # one flag per excursion; the join itself persists
+
+    watcher = threading.Thread(
+        target=_watch, daemon=True, name="dtpu-block-watch"
+    )
+    watcher.start()
+    try:
+        yield
+    finally:
+        done.set()
+        watcher.join(timeout=2.0)
 
 
 class Heartbeat:

@@ -1,4 +1,5 @@
-"""Runtime capture: jit compile events + device memory stats.
+"""Runtime capture: jit compile events, compilation-cache hits, device
+memory stats.
 
 **Compile events.** A recompile storm (a shape drifting per step, a
 donation mismatch, an eval path missing its cache) shows up as minutes of
@@ -8,6 +9,18 @@ monitoring bus emits a duration event for every backend compile;
 (``jit.compiles`` / ``jit.compile_s``) and drops one ``kind="compile"``
 record per compile in the per-rank sink, so both the run report (count +
 wall) and the Perfetto trace (a slice on the ``jit`` track) carry them.
+
+**Compilation-cache events.** With the persistent compilation cache on
+(``COMPILE_CACHE`` — asyncplane/compile_cache.py), the bus additionally
+reports a cache hit or miss per lookup. A HIT still flows through the
+``backend_compile`` duration event (jax wraps compile-or-retrieve in one
+timer), but retrieving a serialized executable is NOT a compilation: the
+listener counts it as ``jit.cache_hits`` + a ``kind="compile.cache"``
+record and SUPPRESSES the ``jit.compiles``/``kind="compile"`` emission
+for that lookup — so a deliberately warm restart reads as zero
+recompiles, not a recompile storm. The hit→compile pairing is
+thread-local (concurrent compiles on other threads cannot steal each
+other's suppression).
 
 The listener registers once per process and stays registered (JAX has no
 public unregister); it is a no-op while the telemetry sink is closed, so
@@ -22,6 +35,7 @@ device per epoch.
 
 from __future__ import annotations
 
+import threading
 import time
 
 from distribuuuu_tpu.telemetry import registry as registry_lib, spans
@@ -29,16 +43,54 @@ from distribuuuu_tpu.telemetry import registry as registry_lib, spans
 # the monitoring key of one backend compilation (jax 0.4.x); the other
 # /jax/core/compile/* keys are sub-phases of the same compile
 _COMPILE_EVENT = "backend_compile"
+# persistent-compilation-cache lookup outcomes (same bus, plain events);
+# on a hit the sequence is cache_hits → ... → backend_compile_duration,
+# all on the compiling thread
+_CACHE_HIT_EVENT = "/jax/compilation_cache/cache_hits"
+_CACHE_MISS_EVENT = "/jax/compilation_cache/cache_misses"
 
-_state = {"installed": False}
+_state = {"installed": False, "hits": 0, "misses": 0}
+_tls = threading.local()  # per-thread "this compile was a cache hit" flag
+
+
+def _on_event(event: str, **_kw) -> None:
+    """Plain (non-duration) bus events: the compilation-cache outcomes."""
+    if event == _CACHE_HIT_EVENT:
+        _tls.cache_hit = True
+        _state["hits"] += 1
+        outcome = "hit"
+    elif event == _CACHE_MISS_EVENT:
+        _tls.cache_hit = False
+        _state["misses"] += 1
+        outcome = "miss"
+    else:
+        return
+    if not spans.enabled():
+        return
+    reg = registry_lib.get_registry()
+    reg.counter(
+        "jit.cache_hits" if outcome == "hit" else "jit.cache_misses"
+    ).inc(1)
+    spans.emit_event(
+        "compile.cache", event=outcome,
+        hits=_state["hits"], misses=_state["misses"],
+    )
 
 
 def _on_event_duration(event: str, duration: float, **_kw) -> None:
     if _COMPILE_EVENT not in event:
         return
+    # consume the thread-local hit flag FIRST: a cache-served executable
+    # must not count as a compile even while the sink is closed (the flag
+    # would otherwise leak onto the next real compile)
+    was_hit = getattr(_tls, "cache_hit", False)
+    _tls.cache_hit = False
     if not spans.enabled():
         return
     reg = registry_lib.get_registry()
+    if was_hit:
+        reg.counter("jit.cache_hit_s").inc(float(duration))
+        return  # a deserialization, not a compilation
     reg.counter("jit.compiles").inc(1)
     reg.counter("jit.compile_s").inc(float(duration))
     # mono stamp approximates the compile's END (the bus reports after)
@@ -58,8 +110,15 @@ def install_compile_listener() -> bool:
     except Exception:  # pragma: no cover — jax without the bus
         return False
     monitoring.register_event_duration_secs_listener(_on_event_duration)
+    monitoring.register_event_listener(_on_event)
     _state["installed"] = True
     return True
+
+
+def cache_tallies() -> tuple[int, int]:
+    """(hits, misses) of the persistent compilation cache this process —
+    process-lifetime totals, independent of the telemetry sink state."""
+    return _state["hits"], _state["misses"]
 
 
 def sample_memstats(**attrs) -> int:

@@ -52,6 +52,13 @@ KINDS: dict[str, frozenset] = {
     "registry": frozenset({"v", "counters", "gauges", "histograms"}),
     "compile": frozenset({"event", "dur_s", "mono"}),
     "memstats": frozenset({"device", "bytes_in_use", "peak_bytes_in_use"}),
+    # -- async execution plane (asyncplane/) -----------------------------
+    # one per async checkpoint save: the on-path (device→host snapshot)
+    # vs off-path (background payload+manifest commit) time split
+    "ckpt.async": frozenset({"ckpt", "snapshot_s", "commit_s", "ok"}),
+    # one per persistent-compilation-cache lookup (telemetry/runtime.py):
+    # event "hit"|"miss" + the process-lifetime running tallies
+    "compile.cache": frozenset({"event", "hits", "misses"}),
     # -- XLA cost-model ledger (telemetry/costmodel.py) ------------------
     # per-step flops/bytes from cost_analysis (source "xla") or the hand
     # table (source "analytic"); peak_flops is the full-mesh peak so

@@ -59,6 +59,8 @@ from distribuuuu_tpu.parallel.partition.lowering import (  # noqa: F401
     make_scan_train_step,
     make_train_step,
 )
+from distribuuuu_tpu import asyncplane
+from distribuuuu_tpu.asyncplane import compile_cache
 from distribuuuu_tpu.resilience import manifest as manifest_lib, supervisor
 from distribuuuu_tpu import telemetry
 from distribuuuu_tpu.telemetry import (
@@ -678,15 +680,24 @@ def train_epoch(loader, mesh, state, train_step, epoch: int, logger,
     return state, interrupted, done
 
 
-def validate(loader, mesh, state, eval_step, epoch: int, logger):
-    """Full evaluation pass; returns (top1, topk) percentages
+def validate(loader, mesh, state, eval_step, epoch: int, logger,
+             quiet: bool = False, watch_preemption: bool | None = None):
+    """Full evaluation pass; returns ``(top1, topk, loss, samples)``
     (ref: trainer.py:67-103), or ``None`` if preemption was signaled
     mid-eval (``TRAIN.PREEMPT_SAVE`` — the caller persists state and
     exits inside the grace window rather than finishing a long eval).
     Per-batch progress at TEST.PRINT_FREQ (≙ ref validate's meter display,
     trainer.py:91-95) — totals stay on device between prints so batches
-    dispatch asynchronously."""
-    watch_preemption = cfg.TRAIN.PREEMPT_SAVE
+    dispatch asynchronously.
+
+    ``quiet`` suppresses every log line and the ``kind="eval"`` record —
+    the concurrent-eval worker (asyncplane/evalloop.py) runs this body
+    off-thread and the MAIN thread logs the summary at join time, so the
+    record order matches a synchronous run. ``watch_preemption`` False
+    disables the mid-eval abandon (the concurrent path must complete:
+    its result is joined before any preemption exit)."""
+    if watch_preemption is None:
+        watch_preemption = cfg.TRAIN.PREEMPT_SAVE
     # same collective-throttle as train_epoch: cross-host agreement only at
     # every Nth deterministic site; free local check at world size 1
     preempt_check_every = 1 if jax.process_count() == 1 else 8
@@ -742,7 +753,8 @@ def validate(loader, mesh, state, eval_step, epoch: int, logger):
                     it + 1, num_batches,
                 )
             return None
-        if (it + 1) % cfg.TEST.PRINT_FREQ == 0 and mesh_lib.is_primary():
+        if (it + 1) % cfg.TEST.PRINT_FREQ == 0 and mesh_lib.is_primary() \
+                and not quiet:
             # async metric fetch (same treatment the train loop gives its
             # metrics): start the host copy of THIS window's totals and log
             # the PREVIOUS window's — already landed, so reading it costs
@@ -769,16 +781,25 @@ def validate(loader, mesh, state, eval_step, epoch: int, logger):
     top1 = totals["correct1"] / n * 100.0
     topk = totals["correctk"] / n * 100.0
     loss = totals["loss_sum"] / n
+    if not quiet:
+        log_eval_result(logger, epoch, top1, topk, loss, int(n))
+    return top1, topk, loss, int(n)
+
+
+def log_eval_result(logger, epoch: int, top1: float, topk: float,
+                    loss: float, samples: int) -> None:
+    """The eval summary line + ``kind="eval"`` record — split out so the
+    concurrent-eval join path emits them from the main thread in the same
+    order a synchronous run would."""
     if mesh_lib.is_primary():
         logger.info(
             "Eval[%d]  Loss %.4f  Acc@1 %.3f  Acc@%d %.3f  (%d samples)",
-            epoch + 1, loss, top1, effective_topk(), topk, int(n),
+            epoch + 1, loss, top1, effective_topk(), topk, samples,
         )
         metrics_log(
             "eval", epoch=epoch + 1, loss=loss, top1=top1, topk=topk,
-            samples=int(n),
+            samples=samples,
         )
-    return top1, topk
 
 
 def _place_like(tmpl, new):
@@ -1049,6 +1070,11 @@ def train_model():
     # snapshots, mirrored resilience events — rank-local signals survive on
     # every process, unlike the primary-only metrics.jsonl above
     telemetry.setup_from_cfg(cfg, rank=jax.process_index())
+    # persistent compilation cache (COMPILE_CACHE): must be applied
+    # before the first jit below — a restart then loads every
+    # previously-compiled step program from disk instead of recompiling
+    # (counted as jit.cache_hits, not jit.compiles)
+    compile_cache.setup_from_cfg(cfg)
     mesh = mesh_lib.mesh_from_cfg(cfg)
     # cost.* records carry the resolved mesh/topology so post-mortem
     # consumers attribute comm volume per mesh axis (ISSUE 9 satellite)
@@ -1124,6 +1150,10 @@ def train_model():
         preempt.install()
 
     def _preempt_exit(path, resume_epoch):
+        # a boundary save submitted just before the signal may still be
+        # committing in the background — the grace window ends with every
+        # manifest durable, never with a half-written directory
+        asyncplane.join_commits(reason="preemption exit")
         if telemetry.enabled():  # final counters survive the preemption
             telemetry.emit_snapshot()
         if mesh_lib.is_primary():
@@ -1143,6 +1173,76 @@ def train_model():
             telemetry_runtime.sample_memstats(epoch=epoch + 1)
         telemetry.emit_snapshot(epoch=epoch + 1)
 
+    # concurrent eval (TRAIN.CONCURRENT_EVAL — asyncplane/evalloop.py):
+    # validate() runs against an on-device epoch-boundary snapshot on a
+    # worker thread while the next train epoch dispatches; results join
+    # (with best-acc bookkeeping + the eval/epoch records) one boundary
+    # later. Single-process only — on multi-host the eval collectives
+    # would interleave with train collectives across processes.
+    conc_eval = None
+    if cfg.TRAIN.CONCURRENT_EVAL:
+        if jax.process_count() > 1:
+            logger.warning(
+                "TRAIN.CONCURRENT_EVAL requested but process_count=%d — "
+                "multi-host eval collectives cannot overlap train "
+                "collectives; falling back to synchronous eval",
+                jax.process_count(),
+            )
+        elif jax.device_count() > 1:
+            # two SPMD programs dispatched from two host threads can land
+            # in DIFFERENT orders on different per-device queues — their
+            # collectives then cross-wait and the backend deadlocks
+            # (observed on the 8-virtual-device CPU mesh). One device has
+            # one queue and no collectives: any interleaving is safe.
+            logger.warning(
+                "TRAIN.CONCURRENT_EVAL requested but device_count=%d — "
+                "overlapped dispatch of two multi-device programs can "
+                "interleave their collectives per-device and deadlock; "
+                "falling back to synchronous eval (single-device "
+                "processes only)", jax.device_count(),
+            )
+        else:
+            conc_eval = asyncplane.ConcurrentEval(
+                lambda snap, ep: validate(
+                    val_loader, mesh, snap, eval_step, ep, logger,
+                    quiet=True, watch_preemption=False,
+                )
+            )
+            logger.info(
+                "concurrent eval: validate() overlaps the next train "
+                "epoch; results join one boundary later"
+            )
+
+    def _join_concurrent_eval():
+        """Join the in-flight eval (no-op when none): emit the deferred
+        eval summary + epoch record, update best-tracking, and side-write
+        the ``best`` checkpoint from the eval's own snapshot — exactly
+        what the synchronous boundary does, one epoch later."""
+        nonlocal best_acc1
+        if conc_eval is None:
+            return
+        joined = conc_eval.join()
+        if joined is None:
+            return
+        ep, result, snap = joined
+        if result is None:  # defensive: the worker runs with watch off
+            logger.warning(
+                "concurrent eval for epoch %d returned no result", ep + 1
+            )
+            return
+        acc1, topk_v, loss, n = result
+        log_eval_result(logger, ep, acc1, topk_v, loss, n)
+        is_best = acc1 > best_acc1
+        best_acc1 = max(acc1, best_acc1)
+        if is_best:
+            ckpt.save_best_checkpoint(snap.params, snap.batch_stats, ep)
+        if mesh_lib.is_primary():
+            logger.info(
+                "epoch %d done: Acc@1 %.3f (best %.3f)",
+                ep + 1, acc1, best_acc1,
+            )
+            metrics_log("epoch", epoch=ep + 1, acc1=acc1, best_acc1=best_acc1)
+
     def _finish_epoch(epoch):
         """Validate + best-track + save for a completed epoch. Returns the
         preempt-checkpoint path if the eval itself was preempted, else
@@ -1153,7 +1253,7 @@ def train_model():
             return ckpt.save_preempt_checkpoint(
                 _state_tree(state), epoch + 1, best_acc1, pending_eval=epoch
             )
-        acc1, _ = result
+        acc1 = result[0]
         is_best = acc1 > best_acc1
         best_acc1 = max(acc1, best_acc1)
         ckpt.save_checkpoint(_state_tree(state), epoch, best_acc1, is_best)
@@ -1188,89 +1288,139 @@ def train_model():
 
     epoch = start_epoch
     rollbacks_left = max(0, int(cfg.TRAIN.MAX_ROLLBACKS))
-    while epoch < cfg.OPTIM.MAX_EPOCH:
+    try:
+        while epoch < cfg.OPTIM.MAX_EPOCH:
+            try:
+                state, interrupted, batches_done = train_epoch(
+                    loader=train_loader, mesh=mesh, state=state,
+                    train_step=train_step, epoch=epoch, logger=logger,
+                    first_epoch=start_epoch, scan_step=scan_step)
+            except supervisor.NonFiniteLossError as e:
+                # TRAIN.NONFINITE=rollback: reload the last intact checkpoint
+                # and re-run from there — the transient-corruption recovery.
+                # A deterministic NaN re-trips and surfaces once the budget
+                # (TRAIN.MAX_ROLLBACKS) is spent; "raise" propagates directly.
+                if cfg.TRAIN.NONFINITE != "rollback":
+                    raise
+                if rollbacks_left <= 0:
+                    logger.error(
+                        "rollback budget exhausted (TRAIN.MAX_ROLLBACKS=%d) — "
+                        "the non-finite loss reproduces from the checkpoint; "
+                        "this is not transient corruption",
+                        cfg.TRAIN.MAX_ROLLBACKS,
+                    )
+                    raise
+                if not ckpt.has_checkpoint():
+                    logger.error(
+                        "non-finite loss before any checkpoint exists — "
+                        "nothing to roll back to"
+                    )
+                    raise
+                rollbacks_left -= 1
+                logger.warning(
+                    "non-finite loss at epoch %d batch ~%d — rolling back to "
+                    "the last intact checkpoint (%d attempt(s) left)",
+                    e.epoch + 1, e.batch, rollbacks_left,
+                )
+                # quiesce the async plane before reloading: the in-flight
+                # eval joins (its best bookkeeping applies, then _resume
+                # restores the checkpointed best), and find_last_valid joins
+                # any commit still in flight
+                _join_concurrent_eval()
+                state, epoch, best_acc1, rb_pending, rb_ds = _resume(state, mesh)
+                # the pre-epoch state's buffers were DONATED to the step calls
+                # (donate_argnums=0) — its key is deleted; re-attach the live
+                # base key (the value is seed-derived, identical by definition)
+                state = state.replace(key=key)
+                # rolling back onto a preempt save: honor its data cursor too
+                _arm_exact_resume(train_loader, rb_ds, epoch, logger)
+                if rb_pending is not None:
+                    # rolled back onto an eval-pending preempt save: finish
+                    # that epoch's validation first, as a fresh start would
+                    path = _finish_epoch(rb_pending)
+                    if path is not None:
+                        return _preempt_exit(path, rb_pending + 1)
+                    ckpt.prune_preempts(rb_pending + 1)
+                continue
+            watching = cfg.TRAIN.PREEMPT_SAVE
+            if interrupted:
+                # mid-epoch preemption: persist now; the next run's AUTO_RESUME
+                # prefers this checkpoint and re-runs this epoch from it
+                # (utils/preempt.py has the full story). The shards pipeline
+                # additionally embeds the loader's exact global cursor, so the
+                # re-run CONTINUES at batch `batches_done` instead of batch 0.
+                # The previous epoch's concurrent eval joins first — its best
+                # bookkeeping must ride the preempt save.
+                _join_concurrent_eval()
+                data_state = (
+                    train_loader.state_dict(batches_done)
+                    if train_loader.can_save_state()
+                    else None
+                )
+                path = ckpt.save_preempt_checkpoint(
+                    _state_tree(state), epoch, best_acc1, data_state=data_state
+                )
+                return _preempt_exit(path, epoch)
+            if watching and preempt.requested_global():
+                # signaled between the last batch and validate: the epoch is
+                # COMPLETE — skip the (possibly long) validation, save the
+                # finished state marked eval-pending, exit inside the grace
+                # window; the resume validates it before continuing
+                _join_concurrent_eval()
+                path = ckpt.save_preempt_checkpoint(
+                    _state_tree(state), epoch + 1, best_acc1, pending_eval=epoch
+                )
+                return _preempt_exit(path, epoch + 1)
+            if conc_eval is not None:
+                # concurrent boundary: join the PREVIOUS epoch's eval (its
+                # result, best-tracking, and log records land now), commit
+                # this epoch's checkpoint (async snapshot inside when
+                # CHECKPOINT.ASYNC), then launch this epoch's eval — the next
+                # train epoch dispatches while it runs. The boundary save
+                # records best_acc1 as of the previous eval (this epoch's is
+                # in flight); the best side-write itself lands at join.
+                _join_concurrent_eval()
+                ckpt.save_checkpoint(
+                    _state_tree(state), epoch, best_acc1, is_best=False
+                )
+                conc_eval.launch(state, epoch)
+            else:
+                path = _finish_epoch(epoch)
+                if path is not None:  # eval was preempted (validate → None)
+                    return _preempt_exit(path, epoch + 1)
+            _epoch_telemetry(epoch)
+            if watching and preempt.requested_global():
+                # signaled during the save: ckpt_ep_{epoch} is already on
+                # disk (or committing in the background — _preempt_exit
+                # drains) — nothing more to persist; the in-flight eval
+                # joins so its result is not lost
+                _join_concurrent_eval()
+                return _preempt_exit(ckpt.get_checkpoint(epoch), epoch + 1)
+            epoch += 1
+        # end of run: the final epoch's eval joins (best-tracking + records),
+        # and the committer drains — no process exits with an uncommitted save
+        _join_concurrent_eval()
+        asyncplane.join_commits(reason="exit")
+        return best_acc1
+    finally:
+        # quiesce the async plane on EVERY exit — including an
+        # exception (e.g. NonFiniteLossError under policy "raise")
+        # propagating to the caller: a worker thread still
+        # dispatching device work during interpreter teardown aborts
+        # the whole process, and a clean exit must never abandon an
+        # uncommitted save. On the normal path the loop already
+        # joined, so these are no-ops.
+        if conc_eval is not None and conc_eval.in_flight:
+            try:
+                conc_eval.join()
+            except Exception as qe:
+                logger.warning(
+                    "concurrent eval quiesced with error: %s", qe
+                )
         try:
-            state, interrupted, batches_done = train_epoch(
-                loader=train_loader, mesh=mesh, state=state,
-                train_step=train_step, epoch=epoch, logger=logger,
-                first_epoch=start_epoch, scan_step=scan_step)
-        except supervisor.NonFiniteLossError as e:
-            # TRAIN.NONFINITE=rollback: reload the last intact checkpoint
-            # and re-run from there — the transient-corruption recovery.
-            # A deterministic NaN re-trips and surfaces once the budget
-            # (TRAIN.MAX_ROLLBACKS) is spent; "raise" propagates directly.
-            if cfg.TRAIN.NONFINITE != "rollback":
-                raise
-            if rollbacks_left <= 0:
-                logger.error(
-                    "rollback budget exhausted (TRAIN.MAX_ROLLBACKS=%d) — "
-                    "the non-finite loss reproduces from the checkpoint; "
-                    "this is not transient corruption",
-                    cfg.TRAIN.MAX_ROLLBACKS,
-                )
-                raise
-            if not ckpt.has_checkpoint():
-                logger.error(
-                    "non-finite loss before any checkpoint exists — "
-                    "nothing to roll back to"
-                )
-                raise
-            rollbacks_left -= 1
-            logger.warning(
-                "non-finite loss at epoch %d batch ~%d — rolling back to "
-                "the last intact checkpoint (%d attempt(s) left)",
-                e.epoch + 1, e.batch, rollbacks_left,
-            )
-            state, epoch, best_acc1, rb_pending, rb_ds = _resume(state, mesh)
-            # the pre-epoch state's buffers were DONATED to the step calls
-            # (donate_argnums=0) — its key is deleted; re-attach the live
-            # base key (the value is seed-derived, identical by definition)
-            state = state.replace(key=key)
-            # rolling back onto a preempt save: honor its data cursor too
-            _arm_exact_resume(train_loader, rb_ds, epoch, logger)
-            if rb_pending is not None:
-                # rolled back onto an eval-pending preempt save: finish
-                # that epoch's validation first, as a fresh start would
-                path = _finish_epoch(rb_pending)
-                if path is not None:
-                    return _preempt_exit(path, rb_pending + 1)
-                ckpt.prune_preempts(rb_pending + 1)
-            continue
-        watching = cfg.TRAIN.PREEMPT_SAVE
-        if interrupted:
-            # mid-epoch preemption: persist now; the next run's AUTO_RESUME
-            # prefers this checkpoint and re-runs this epoch from it
-            # (utils/preempt.py has the full story). The shards pipeline
-            # additionally embeds the loader's exact global cursor, so the
-            # re-run CONTINUES at batch `batches_done` instead of batch 0.
-            data_state = (
-                train_loader.state_dict(batches_done)
-                if train_loader.can_save_state()
-                else None
-            )
-            path = ckpt.save_preempt_checkpoint(
-                _state_tree(state), epoch, best_acc1, data_state=data_state
-            )
-            return _preempt_exit(path, epoch)
-        if watching and preempt.requested_global():
-            # signaled between the last batch and validate: the epoch is
-            # COMPLETE — skip the (possibly long) validation, save the
-            # finished state marked eval-pending, exit inside the grace
-            # window; the resume validates it before continuing
-            path = ckpt.save_preempt_checkpoint(
-                _state_tree(state), epoch + 1, best_acc1, pending_eval=epoch
-            )
-            return _preempt_exit(path, epoch + 1)
-        path = _finish_epoch(epoch)
-        if path is not None:  # eval itself was preempted (validate → None)
-            return _preempt_exit(path, epoch + 1)
-        _epoch_telemetry(epoch)
-        if watching and preempt.requested_global():
-            # signaled during the save: ckpt_ep_{epoch} is already on
-            # disk — nothing more to persist, just exit promptly
-            return _preempt_exit(ckpt.get_checkpoint(epoch), epoch + 1)
-        epoch += 1
-    return best_acc1
+            asyncplane.join_commits()
+        except asyncplane.AsyncCommitError as qe:
+            logger.warning("async committer quiesced with error: %s", qe)
 
 
 def test_model():
@@ -1281,6 +1431,7 @@ def test_model():
     topo = check_trainer_mesh()
     logger = setup_logger()
     telemetry.setup_from_cfg(cfg, rank=jax.process_index())
+    compile_cache.setup_from_cfg(cfg)  # warm eval compiles on restart
     mesh = mesh_lib.mesh_from_cfg(cfg)
     costmodel.set_mesh_extras(
         {"mesh": topo.axes, "topology": topo.class_name()}
@@ -1301,7 +1452,7 @@ def test_model():
         if mesh_lib.is_primary():
             logger.warning("evaluation preempted before completion")
         return None
-    top1, topk = result
+    top1, topk = result[0], result[1]
     if telemetry.enabled():
         telemetry.emit_snapshot()
     if mesh_lib.is_primary():

@@ -19,6 +19,17 @@ resume entry — verifies candidates newest-first, quarantines corrupt or
 partial directories to ``*.corrupt``, and walks back to the newest intact
 save; the raw lexicographic pick (``get_last_checkpoint``) previously
 selected a half-written dir and killed the resume inside tensorstore.
+
+Async commit (``CHECKPOINT.ASYNC`` — asyncplane/committer.py): the
+trainer blocks only for the device→host snapshot of the payload
+(``ckpt_snapshot`` span); the orbax write, digests, and manifest commit
+run on a background thread (``ckpt_commit`` span), manifest still
+strictly LAST — the crash-consistency story above is byte-for-byte the
+same, just off the critical path. Single-process runs only (multi-host
+saves are collective); degrades to the synchronous protocol with one
+logged warning. Preempt saves always drain the committer first and
+commit synchronously — the process is about to exit, and the grace
+window must end with a durable manifest.
 """
 
 from __future__ import annotations
@@ -139,9 +150,15 @@ def find_last_valid_checkpoint() -> str:
     (resilience/manifest.verify_checkpoint), walking back over — and
     quarantining — corrupt or partial saves instead of crashing the
     resume on them. Raises ``NoValidCheckpointError`` when nothing
-    survives."""
+    survives.
+
+    Joins any in-flight async commit first: a mid-run resume (the
+    non-finite rollback path) must not race the committer for the very
+    directory it is about to verify."""
+    from distribuuuu_tpu.asyncplane import committer
     from distribuuuu_tpu.utils.logger import get_logger
 
+    committer.join_commits()
     cands = _ordered_candidates()
     if not cands:
         raise NoValidCheckpointError(
@@ -220,15 +237,68 @@ def unpack_opt_state(template, stored):
     return jax.tree.unflatten(tdef, leaves)
 
 
+_state: dict = {"async_warned": False}
+
+
+def async_enabled() -> bool:
+    """CHECKPOINT.ASYNC, gated to single-process runs: the orbax write
+    is collective on multi-host — every process must participate at the
+    same point, which a per-process background thread cannot line up.
+    Degrades to the synchronous protocol with one logged warning."""
+    if not cfg.CHECKPOINT.ASYNC:
+        return False
+    if jax.process_count() > 1:
+        if not _state.get("async_warned"):
+            _state["async_warned"] = True
+            from distribuuuu_tpu.utils.logger import get_logger
+
+            get_logger().warning(
+                "CHECKPOINT.ASYNC requested but process_count=%d — "
+                "multi-host saves are collective; falling back to "
+                "synchronous checkpointing", jax.process_count(),
+            )
+        return False
+    return True
+
+
+def _commit(path: str, payload: dict, epoch_cursor: int,
+            post_commit=None, fsync_payload: bool = False) -> None:
+    """The durable half of one save: orbax payload write, then the
+    atomic manifest commit STRICTLY last, then any post-commit work
+    (best side-write, preempt pruning, fault hooks). Runs on the caller
+    thread (sync protocol) or the committer thread (async — which also
+    fsyncs the payload before the marker: power-loss-safe ordering,
+    free off the critical path)."""
+    from distribuuuu_tpu.utils import faults
+
+    ocp.PyTreeCheckpointer().save(path, payload, force=True)
+    # the async-save crash window, injectable: SIGKILL lands here — after
+    # every payload byte, before the commit marker (no-op unless FAULTS.*)
+    faults.maybe_kill_mid_async_save(path, epoch_cursor)
+    if jax.process_index() == 0:
+        manifest_lib.write_manifest(path, payload, kind="full",
+                                    epoch=epoch_cursor,
+                                    fsync_payload=fsync_payload)
+    if post_commit is not None:
+        post_commit(payload)
+
+
 def _save_full(
     path: str, state_tree: dict, epoch_cursor: int, best_acc1: float,
-    extra: dict | None = None,
+    extra: dict | None = None, post_commit=None, force_sync: bool = False,
 ):
     """The one save protocol: reference-shaped payload {epoch, state,
     best_acc1} (ref: utils.py:375-380), collective orbax write (every
     process participates; array shards written by their owners), then the
     manifest commit marker (primary only, atomic, AFTER the payload — a
-    crash at any earlier point leaves a dir that verification rejects)."""
+    crash at any earlier point leaves a dir that verification rejects).
+
+    With ``CHECKPOINT.ASYNC`` (and not ``force_sync``) the caller blocks
+    only for the device→host snapshot; the commit runs on the background
+    committer (asyncplane/committer.py), same protocol, same ordering —
+    the manifest is still the last byte written."""
+    import time as _time
+
     os.makedirs(get_checkpoint_dir(), exist_ok=True)
     payload = dict(state_tree)
     if "opt_state" in payload:
@@ -237,17 +307,41 @@ def _save_full(
     payload["best_acc1"] = np.float32(best_acc1)
     if extra:
         payload.update(extra)
+    name = os.path.basename(path)
+    if async_enabled() and not force_sync:
+        from distribuuuu_tpu.asyncplane import committer
+
+        # on-path cost: ONLY the host snapshot (donation-safe copy); the
+        # span is what run_report attributes as trainer-blocked time
+        t0 = _time.perf_counter()
+        with telemetry_spans.span(
+            "ckpt_snapshot", track="ckpt", ckpt=name,
+            epoch=int(epoch_cursor),
+        ):
+            payload = committer.snapshot_tree(payload)
+        snapshot_s = _time.perf_counter() - t0
+
+        def _bg_commit():
+            c0 = _time.perf_counter()
+            with telemetry_spans.span(
+                "ckpt_commit", track="ckpt", ckpt=name,
+                epoch=int(epoch_cursor),
+            ):
+                _commit(path, payload, epoch_cursor, post_commit,
+                        fsync_payload=True)
+            committer.emit_commit_record(
+                name, snapshot_s, _time.perf_counter() - c0
+            )
+
+        committer.submit_commit(name, _bg_commit)
+        return path
     # span covers payload + manifest commit: the save duration an operator
     # budgets the preemption grace window against (tools/run_report.py
     # reports count/mean/max per rank from these)
     with telemetry_spans.span(
-        "ckpt_save", track="ckpt",
-        ckpt=os.path.basename(path), epoch=int(epoch_cursor),
+        "ckpt_save", track="ckpt", ckpt=name, epoch=int(epoch_cursor),
     ):
-        ocp.PyTreeCheckpointer().save(path, payload, force=True)
-        if jax.process_index() == 0:
-            manifest_lib.write_manifest(path, payload, kind="full",
-                                        epoch=epoch_cursor)
+        _commit(path, payload, epoch_cursor, post_commit)
     return path
 
 
@@ -265,21 +359,53 @@ def prune_preempts(upto: int):
             shutil.rmtree(p, ignore_errors=True)
 
 
+def _write_best(params, batch_stats, epoch: int) -> str:
+    """The weights-only ``best`` side-write: payload then manifest, same
+    commit ordering as a full save. Accepts device OR host arrays."""
+    best = {"params": params, "batch_stats": batch_stats}
+    ocp.PyTreeCheckpointer().save(get_best_checkpoint(), best, force=True)
+    if jax.process_index() == 0:
+        manifest_lib.write_manifest(
+            get_best_checkpoint(), best, kind="weights", epoch=epoch
+        )
+    return get_best_checkpoint()
+
+
+def save_best_checkpoint(params, batch_stats, epoch: int) -> str:
+    """Standalone best side-write for the concurrent-eval join path
+    (the epoch checkpoint was already committed at the boundary; the
+    is_best verdict arrives one epoch later). Async mode rides the
+    committer — off the critical path, ordered after any in-flight full
+    commit; ``params``/``batch_stats`` must then be snapshot copies the
+    train loop will not donate (asyncplane/evalloop.device_snapshot)."""
+    path = get_best_checkpoint()
+    if async_enabled():
+        from distribuuuu_tpu.asyncplane import committer
+
+        committer.submit_commit(
+            _BEST_NAME, lambda: _write_best(params, batch_stats, epoch)
+        )
+        return path
+    return _write_best(params, batch_stats, epoch)
+
+
 def save_checkpoint(state_tree: dict, epoch: int, best_acc1: float, is_best: bool):
-    """Save a full training checkpoint; side-write weights-only ``best``."""
-    path = _save_full(get_checkpoint(epoch), state_tree, epoch, best_acc1)
-    if is_best:
-        best = {"params": state_tree["params"], "batch_stats": state_tree["batch_stats"]}
-        ocp.PyTreeCheckpointer().save(get_best_checkpoint(), best, force=True)
-        if jax.process_index() == 0:
-            manifest_lib.write_manifest(
-                get_best_checkpoint(), best, kind="weights", epoch=epoch
-            )
-    prune_preempts(epoch)
+    """Save a full training checkpoint; side-write weights-only ``best``.
+
+    The best side-write, preempt pruning, and the corrupt-checkpoint
+    fault hook all run post-commit — after the manifest is durable, on
+    the committer thread when ``CHECKPOINT.ASYNC`` (the payload handed
+    to the closure is then the host snapshot, safe to re-save)."""
+    path = get_checkpoint(epoch)
     from distribuuuu_tpu.utils import faults
 
-    faults.maybe_corrupt_checkpoint(path, epoch)  # no-op unless injected
-    return path
+    def _post(payload):
+        if is_best:
+            _write_best(payload["params"], payload["batch_stats"], epoch)
+        prune_preempts(epoch)
+        faults.maybe_corrupt_checkpoint(path, epoch)  # no-op unless injected
+
+    return _save_full(path, state_tree, epoch, best_acc1, post_commit=_post)
 
 
 def encode_data_state(data_state: dict) -> np.ndarray:
@@ -322,7 +448,15 @@ def save_preempt_checkpoint(
     CONTINUES at the next batch instead of re-running from batch 0 —
     trajectory-equivalent to the uninterrupted run. Same collective save
     protocol as ``save_checkpoint``.
+
+    Always synchronous: the process exits right after, so there is
+    nothing to overlap with — and the grace window must end with a
+    durable manifest. Any in-flight async commit (the previous epoch
+    boundary's) is drained FIRST, so the preempt save can never race it.
     """
+    from distribuuuu_tpu.asyncplane import committer
+
+    committer.join_commits(reason="preemption")
     extra = {}
     if pending_eval is not None:
         extra["pending_eval"] = np.int32(pending_eval)
@@ -330,7 +464,7 @@ def save_preempt_checkpoint(
         extra["data_state"] = encode_data_state(data_state)
     return _save_full(
         os.path.join(get_checkpoint_dir(), f"{_PREEMPT_PREFIX}{epoch:03d}"),
-        state_tree, epoch - 1, best_acc1, extra or None,
+        state_tree, epoch - 1, best_acc1, extra or None, force_sync=True,
     )
 
 

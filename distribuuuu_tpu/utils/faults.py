@@ -29,6 +29,11 @@ survive into a reproducible, config-driven event, so tests and
   truncated shard        ``FAULTS.TRUNCATE_SHARD`` — cut a record shard
                          (DATA.FORMAT=shards) to 60% before the reader
                          opens it: index-footer recovery + record skips;
+  killed mid-async-save  ``FAULTS.KILL_MID_ASYNC_SAVE`` — SIGKILL from
+                         the async committer thread after ckpt_ep_e's
+                         payload is written but before its manifest
+                         commits (CHECKPOINT.ASYNC): the walk-back must
+                         recover from the previous intact checkpoint;
   recompile storm        ``FAULTS.RECOMPILE_AT_BATCH/RECOMPILE_N`` —
                          N real backend compiles mid-run (trivial jits
                          at distinct shapes; the shape-leak signature
@@ -53,8 +58,8 @@ from distribuuuu_tpu.config import cfg
 __all__ = [
     "InjectedFault", "enabled", "nan_injection_step", "maybe_decode_error",
     "maybe_kill", "maybe_stall", "maybe_corrupt_checkpoint",
-    "maybe_preempt", "maybe_truncate_shard", "maybe_recompile",
-    "maybe_slowdown", "reset",
+    "maybe_kill_mid_async_save", "maybe_preempt", "maybe_truncate_shard",
+    "maybe_recompile", "maybe_slowdown", "reset",
 ]
 
 
@@ -211,6 +216,23 @@ def maybe_stall(epoch: int, batch: int) -> None:
         and cfg.FAULTS.STALL_S > 0
     ):
         time.sleep(float(cfg.FAULTS.STALL_S))
+
+
+def maybe_kill_mid_async_save(path: str, epoch: int) -> None:
+    """SIGKILL this process inside the async-save crash window: the
+    checkpoint's orbax payload is fully on disk, its ``MANIFEST.json``
+    is NOT — exactly where a host dying mid-background-commit leaves the
+    directory (``CHECKPOINT.ASYNC``). The restart must quarantine the
+    manifest-less dir ("no committed manifest") and walk back to the
+    previous intact save (tools/resilience_drill.py
+    ``killed_mid_async_save``). Epoch checkpoints only — a preempt
+    save's number is its interrupted epoch, not a save cursor."""
+    if not enabled() or cfg.FAULTS.KILL_MID_ASYNC_SAVE < 0:
+        return
+    if not os.path.basename(path).startswith("ckpt_ep_"):
+        return
+    if epoch == int(cfg.FAULTS.KILL_MID_ASYNC_SAVE):
+        os.kill(os.getpid(), signal.SIGKILL)
 
 
 def maybe_corrupt_checkpoint(path: str, epoch: int) -> None:

@@ -86,6 +86,12 @@ def main(argv=None):
     telemetry.setup_from_cfg(
         cfg, rank=int(os.environ.get("DTPU_REPLICA_RANK", "0"))
     )
+    # persistent compilation cache (COMPILE_CACHE): a restarted or
+    # replacement replica deserializes its AOT bucket executables from
+    # disk instead of paying the warm-up compile storm again
+    from distribuuuu_tpu.asyncplane import compile_cache
+
+    compile_cache.setup_from_cfg(cfg)
     engine = engine_from_cfg()
     logger.info(
         "serving %s: buckets %s compiled (%d shapes), max_wait %.1f ms, "

@@ -1,0 +1,513 @@
+"""Async execution plane (distribuuuu_tpu/asyncplane/, ISSUE 10):
+committer ordering (manifest strictly last) + join-barrier correctness,
+async-vs-sync checkpoint payload equality, concurrent-eval result parity
+with sync eval, compile-cache hit/miss counters (unit + a real cold/warm
+restart pair), config validation, the new schema kinds, the run_report
+on/off-path checkpoint section, BENCH_r06 indexing — and the hard
+contract: async-everything on ≡ fully-sync run bit-identical.
+"""
+
+import json
+import os
+import subprocess
+import sys
+import time
+
+import numpy as np
+import pytest
+
+import jax
+
+import distribuuuu_tpu.config as config
+from distribuuuu_tpu.config import cfg
+from distribuuuu_tpu.asyncplane import committer, compile_cache, evalloop
+from distribuuuu_tpu.telemetry import (
+    registry as registry_lib,
+    runtime as telemetry_runtime,
+    schema,
+    spans,
+)
+from distribuuuu_tpu.utils import checkpoint as ckpt, jsonlog
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, os.path.join(REPO, "tools"))
+
+import bench_history  # noqa: E402
+import run_report  # noqa: E402
+
+
+@pytest.fixture(autouse=True)
+def _drain_and_close():
+    yield
+    try:
+        committer.join_commits()
+    except committer.AsyncCommitError:
+        pass
+    spans.close_telemetry()
+    jsonlog.close_metrics_log()
+    registry_lib.get_registry().reset()
+
+
+def _tree(seed=0.0):
+    return {
+        "params": {"w": np.arange(6, dtype=np.float32).reshape(2, 3) + seed},
+        "batch_stats": {"m": np.ones(3, np.float32)},
+        "opt_state": {"mu": np.zeros(3, np.float32), "lr": 0.1},
+        "step": np.int32(7),
+    }
+
+
+# ------------------------------------------------------------- committer
+def test_manifest_written_strictly_last(tmp_path, monkeypatch):
+    """The PR 3 commit protocol survives going async: at the injectable
+    crash-window hook (payload durable, manifest pending) the orbax
+    payload files are ALL on disk and MANIFEST.json is NOT."""
+    from distribuuuu_tpu.resilience import manifest as manifest_lib
+    from distribuuuu_tpu.utils import faults
+
+    cfg.OUT_DIR = str(tmp_path)
+    cfg.CHECKPOINT.ASYNC = True
+    observed = {}
+
+    def probe(path, epoch):
+        payload_files = []
+        for dirpath, _, names in os.walk(path):
+            payload_files += [n for n in names if n != "MANIFEST.json"]
+        observed["payload_files"] = len(payload_files)
+        observed["manifest_there"] = os.path.isfile(
+            os.path.join(path, "MANIFEST.json")
+        )
+
+    monkeypatch.setattr(faults, "maybe_kill_mid_async_save", probe)
+    path = ckpt.save_checkpoint(_tree(), 0, 0.5, is_best=False)
+    committer.join_commits()
+    assert observed["payload_files"] > 0  # orbax payload fully written...
+    assert observed["manifest_there"] is False  # ...manifest strictly after
+    ok, reason = manifest_lib.verify_checkpoint(path)
+    assert ok, reason
+
+
+def test_join_barrier_serializes_back_to_back_saves():
+    """submit joins the previous commit FIRST: at most one commit in
+    flight, completion order == submit order even when the first commit
+    is slow."""
+    order = []
+
+    def slow():
+        time.sleep(0.3)
+        order.append("a")
+
+    committer.submit_commit("a", slow)
+    committer.submit_commit("b", lambda: order.append("b"))
+    # the second submit could only start after "a" fully committed
+    assert order[0] == "a"
+    committer.join_commits()
+    assert order == ["a", "b"]
+
+
+def test_commit_failure_surfaces_at_join():
+    def boom():
+        raise OSError("disk gone")
+
+    committer.submit_commit("ckpt_ep_042", boom)
+    with pytest.raises(committer.AsyncCommitError, match="ckpt_ep_042"):
+        committer.join_commits()
+    committer.join_commits()  # error consumed; barrier is clean again
+
+
+def test_async_payload_bitwise_equals_sync(tmp_path):
+    tree = _tree()
+    cfg.OUT_DIR = str(tmp_path / "async")
+    cfg.CHECKPOINT.ASYNC = True
+    p_async = ckpt.save_checkpoint(tree, 0, 0.5, is_best=True)
+    committer.join_commits()
+    cfg.CHECKPOINT.ASYNC = False
+    cfg.OUT_DIR = str(tmp_path / "sync")
+    p_sync = ckpt.save_checkpoint(tree, 0, 0.5, is_best=True)
+    a, b = ckpt.load_checkpoint(p_async), ckpt.load_checkpoint(p_sync)
+    la = jax.tree_util.tree_flatten_with_path(a)[0]
+    lb = jax.tree_util.tree_flatten_with_path(b)[0]
+    assert [k for k, _ in la] == [k for k, _ in lb]
+    for (_, va), (_, vb) in zip(la, lb):
+        np.testing.assert_array_equal(np.asarray(va), np.asarray(vb))
+    # the best side-writes committed (and verify) in both modes
+    from distribuuuu_tpu.resilience import manifest as manifest_lib
+
+    for out in ("async", "sync"):
+        ok, reason = manifest_lib.verify_checkpoint(
+            str(tmp_path / out / "checkpoints" / "best")
+        )
+        assert ok, (out, reason)
+
+
+def test_async_multi_host_degrades_to_sync(tmp_path, monkeypatch):
+    cfg.CHECKPOINT.ASYNC = True
+    monkeypatch.setattr(jax, "process_count", lambda: 2)
+    assert ckpt.async_enabled() is False  # collective saves stay sync
+    monkeypatch.setattr(jax, "process_count", lambda: 1)
+    assert ckpt.async_enabled() is True
+
+
+def test_preempt_save_drains_committer_first(tmp_path):
+    """The preemption join barrier: a slow in-flight commit becomes
+    durable BEFORE the preempt checkpoint is written synchronously."""
+    order = []
+
+    def slow():
+        time.sleep(0.2)
+        order.append("boundary_commit")
+
+    cfg.OUT_DIR = str(tmp_path)
+    cfg.CHECKPOINT.ASYNC = True
+    committer.submit_commit("ckpt_ep_000", slow)
+    path = ckpt.save_preempt_checkpoint(_tree(), 1, 0.0)
+    order.append("preempt_saved")
+    assert order == ["boundary_commit", "preempt_saved"]
+    from distribuuuu_tpu.resilience import manifest as manifest_lib
+
+    ok, reason = manifest_lib.verify_checkpoint(path)
+    assert ok, reason  # the preempt save itself committed synchronously
+
+
+# -------------------------------------------------------- concurrent eval
+def _eval_setup():
+    from distribuuuu_tpu import trainer
+    from distribuuuu_tpu.data.dummy import DummyDataset
+    from distribuuuu_tpu.data.loader import Loader
+    from distribuuuu_tpu.parallel import mesh as mesh_lib
+
+    config.reset_cfg()
+    cfg.MODEL.ARCH = "resnet18"
+    cfg.MODEL.NUM_CLASSES = 10
+    cfg.MODEL.DUMMY_INPUT = True
+    cfg.DEVICE.COMPUTE_DTYPE = "float32"
+    cfg.TRAIN.IM_SIZE = 16
+    cfg.TRAIN.BATCH_SIZE = 1
+    cfg.RNG_SEED = 1
+    mesh = mesh_lib.build_mesh()
+    model = trainer.build_model_from_cfg()
+    eval_step = trainer.make_eval_step(model, topk=5)
+    state = trainer.create_train_state(model, jax.random.key(0), mesh, 16)
+    loader = Loader(
+        DummyDataset(length=20, size=16), batch_size=8, shuffle=False,
+        drop_last=False, workers=2,
+    )
+    loader.set_epoch(0)
+    return trainer, mesh, state, eval_step, loader
+
+
+def test_concurrent_eval_matches_sync_validate():
+    """The worker runs the REAL validate body against a device snapshot:
+    result 4-tuple identical to the synchronous call, and the snapshot
+    leaves are genuinely independent copies of the live state."""
+    from distribuuuu_tpu.utils.logger import get_logger
+
+    trainer, mesh, state, eval_step, loader = _eval_setup()
+    sync = trainer.validate(
+        loader, mesh, state, eval_step, 0, get_logger(), quiet=True
+    )
+
+    conc = evalloop.ConcurrentEval(
+        lambda snap, ep: trainer.validate(
+            loader, mesh, snap, eval_step, ep, get_logger(),
+            quiet=True, watch_preemption=False,
+        )
+    )
+    conc.launch(state, 0)
+    assert conc.in_flight
+    ep, result, snap = conc.join()
+    assert ep == 0 and not conc.in_flight
+    assert result == sync
+    # the snapshot is a COPY: same values, different buffers
+    live_leaf = jax.tree.leaves(state.params)[0]
+    snap_leaf = jax.tree.leaves(snap.params)[0]
+    np.testing.assert_array_equal(np.asarray(live_leaf), np.asarray(snap_leaf))
+    assert snap_leaf is not live_leaf
+
+
+def test_concurrent_eval_relaunch_guard_and_error_propagation():
+    class _S:  # minimal state stand-in with .replace
+        params = {"w": np.ones(2, np.float32)}
+        batch_stats = {}
+        step = 0
+        key = None
+
+        def replace(self, **kw):
+            return self
+
+    def boom(snap, ep):
+        raise RuntimeError("eval exploded")
+
+    conc = evalloop.ConcurrentEval(boom)
+    conc.launch(_S(), 3)
+    with pytest.raises(RuntimeError, match="eval exploded"):
+        conc.join()
+    ok = evalloop.ConcurrentEval(lambda snap, ep: (1.0, 2.0, 3.0, 4))
+    ok.launch(_S(), 0)
+    with pytest.raises(RuntimeError, match="still in flight"):
+        ok.launch(_S(), 1)
+    assert ok.join()[1] == (1.0, 2.0, 3.0, 4)
+
+
+# ----------------------------------------------------------- compile cache
+def test_compile_cache_config_validation(tmp_path):
+    cfg.COMPILE_CACHE.MIN_COMPILE_TIME_S = -1.0
+    with pytest.raises(ValueError, match="MIN_COMPILE_TIME_S"):
+        compile_cache.setup_from_cfg(cfg)
+    config.reset_cfg()
+    cfg.COMPILE_CACHE.MAX_SIZE_MB = -5
+    with pytest.raises(ValueError, match="MAX_SIZE_MB"):
+        compile_cache.setup_from_cfg(cfg)
+    config.reset_cfg()
+    assert compile_cache.setup_from_cfg(cfg) is None  # disabled → no-op
+    cfg.COMPILE_CACHE.ENABLED = True
+    cfg.COMPILE_CACHE.DIR = str(tmp_path / "cc")
+    cache_dir = compile_cache.setup_from_cfg(cfg)
+    assert cache_dir == str(tmp_path / "cc") and os.path.isdir(cache_dir)
+    assert jax.config.jax_compilation_cache_dir == cache_dir
+    # the knob is authoritative: disabling CLEARS the process-global dir
+    config.reset_cfg()
+    compile_cache.setup_from_cfg(cfg)
+    assert not jax.config.jax_compilation_cache_dir
+
+
+def test_cache_hit_suppresses_compile_count(tmp_path):
+    """Unit-level listener contract (telemetry/runtime.py): the bus
+    sequence of a cache hit (cache_hits event → backend_compile
+    duration) counts a hit, NOT a compile; a miss still counts the
+    compile. kind=\"compile.cache\" records land schema-valid."""
+    path = spans.setup_telemetry(str(tmp_path), rank=0)
+    reg = registry_lib.get_registry()
+    reg.reset()
+    # a cache hit: the following backend_compile is a deserialization
+    telemetry_runtime._on_event("/jax/compilation_cache/cache_hits")
+    telemetry_runtime._on_event_duration(
+        "/jax/core/compile/backend_compile_duration", 0.004
+    )
+    # a cache miss: the following backend_compile is the real thing
+    telemetry_runtime._on_event("/jax/compilation_cache/cache_misses")
+    telemetry_runtime._on_event_duration(
+        "/jax/core/compile/backend_compile_duration", 1.5
+    )
+    snap = reg.snapshot()["counters"]
+    assert snap["jit.cache_hits"] == 1
+    assert snap["jit.cache_misses"] == 1
+    assert snap["jit.compiles"] == 1  # only the miss compiled
+    recs = [json.loads(ln) for ln in open(path).read().splitlines()]
+    cache_recs = [r for r in recs if r["kind"] == "compile.cache"]
+    assert [r["event"] for r in cache_recs] == ["hit", "miss"]
+    for r in cache_recs:
+        schema.validate_record(r)
+    # exactly ONE kind="compile" record — the real compile, not the hit
+    assert len([r for r in recs if r["kind"] == "compile"]) == 1
+
+
+_CACHE_SCRIPT = """
+import os, sys, json
+os.environ["JAX_PLATFORMS"] = "cpu"
+import jax, jax.numpy as jnp
+jax.config.update("jax_platforms", "cpu")
+import distribuuuu_tpu.config as config
+from distribuuuu_tpu.config import cfg
+from distribuuuu_tpu.asyncplane import compile_cache
+from distribuuuu_tpu.telemetry import registry as registry_lib, spans
+cache_dir, sink_dir = sys.argv[1], sys.argv[2]
+config.reset_cfg()
+cfg.COMPILE_CACHE.ENABLED = True
+cfg.COMPILE_CACHE.DIR = cache_dir
+compile_cache.setup_from_cfg(cfg)
+spans.setup_telemetry(sink_dir, rank=0)
+f = jax.jit(lambda x: (x * 2 + 1).sum())
+g = jax.jit(lambda x, y: jnp.tanh(x) @ y)
+f(jnp.ones((64, 64))).block_until_ready()
+g(jnp.ones((16, 16)), jnp.ones((16, 16))).block_until_ready()
+print("COUNTERS " + json.dumps(
+    registry_lib.get_registry().snapshot()["counters"]))
+"""
+
+
+def test_warm_restart_hits_cache_zero_compiles(tmp_path):
+    """The real thing, across processes: a cold run populates the cache
+    (misses, real compiles); a warm rerun of the same programs in a
+    FRESH interpreter reports cache hits and ZERO counted compiles."""
+    script = tmp_path / "cc_script.py"
+    script.write_text(_CACHE_SCRIPT)
+    env = {**os.environ, "PYTHONPATH": REPO + os.pathsep
+           + os.environ.get("PYTHONPATH", "")}
+
+    def run(tag):
+        out = subprocess.run(
+            [sys.executable, str(script), str(tmp_path / "cache"),
+             str(tmp_path / tag)],
+            capture_output=True, text=True, env=env, cwd=REPO, timeout=180,
+        )
+        assert out.returncode == 0, out.stdout + out.stderr
+        line = [ln for ln in out.stdout.splitlines()
+                if ln.startswith("COUNTERS ")][-1]
+        return json.loads(line[len("COUNTERS "):])
+
+    cold = run("cold")
+    assert cold.get("jit.compiles", 0) >= 2  # the two user programs
+    assert cold.get("jit.cache_misses", 0) >= 2
+    assert cold.get("jit.cache_hits", 0) == 0
+    warm = run("warm")
+    assert warm.get("jit.compiles", 0) == 0  # everything deserialized
+    assert warm.get("jit.cache_hits", 0) >= 2
+
+
+# ------------------------------------------------- schema / report / index
+def test_new_kinds_declared_and_static_check_clean():
+    assert "ckpt.async" in schema.KINDS
+    assert "compile.cache" in schema.KINDS
+    import check_telemetry_schema as chk
+
+    violations, seen = chk.check_tree(
+        os.path.join(REPO, "distribuuuu_tpu")
+    )
+    assert violations == [], violations
+    assert "ckpt.async" in seen and "compile.cache" in seen
+
+
+def test_run_report_splits_on_vs_off_path(tmp_path):
+    """run_report's checkpoint section attributes trainer-blocked
+    (snapshot) vs background (commit) seconds and tallies cache events."""
+    tdir = tmp_path / "telemetry"
+    path = spans.setup_telemetry(str(tdir), rank=0)
+    spans.emit_span("step", 1.0, 1.1, track="pipeline", phase="train",
+                    epoch=1, batch=0, n=8)
+    spans.emit_span("ckpt_snapshot", 2.0, 2.05, track="ckpt",
+                    ckpt="ckpt_ep_000", epoch=0)
+    spans.emit_span("ckpt_commit", 2.05, 3.25, track="ckpt",
+                    ckpt="ckpt_ep_000", epoch=0)
+    spans.emit_event("compile.cache", event="hit", hits=1, misses=0)
+    spans.emit_event("compile.cache", event="miss", hits=1, misses=1)
+    spans.close_telemetry()
+    rep = run_report.build_report(str(tmp_path))
+    ck = rep["checkpoint"]
+    assert ck["snapshots"] == 1 and ck["commits"] == 1
+    assert ck["on_path_s"] == pytest.approx(0.05, abs=1e-3)
+    assert ck["off_path_s"] == pytest.approx(1.2, abs=1e-3)
+    assert ck["on_path_s"] < 0.5 * ck["off_path_s"]  # the acceptance shape
+    assert rep["compile_cache"] == {"hits": 1, "misses": 1}
+    # sanity: the record forms above are schema-valid
+    for r in [json.loads(ln) for ln in open(path).read().splitlines()]:
+        schema.validate_record(r)
+
+
+def test_bench_index_carries_asyncplane_series():
+    """BENCH_r06.json indexed (regeneration pin: tests/test_monitor.py
+    asserts committed == rebuilt; here the asyncplane series exist and
+    none of them rides a throughput-reference name)."""
+    index = bench_history.build_index(REPO)
+    series = index["series"]
+    assert "ckpt_trainer_blocked_s_async" in series
+    assert "ckpt_trainer_blocked_s_sync" in series
+    assert "warm_restart_compiles" in series
+    assert "warm_restart_cache_hits" in series
+    # the async run blocks the trainer for less than the sync run did
+    blocked_async = series["ckpt_trainer_blocked_s_async"][-1]["value"]
+    blocked_sync = series["ckpt_trainer_blocked_s_sync"][-1]["value"]
+    assert blocked_async < blocked_sync
+    # warm restart: previously-compiled step programs not recompiled
+    warm = series["warm_restart_compiles"][-1]["value"]
+    cold = series["cold_start_compiles"][-1]["value"]
+    assert warm <= max(2.0, 0.1 * cold)
+    assert series["warm_restart_cache_hits"][-1]["value"] >= 2
+    # none of the new series can poison the throughput gate
+    mapped = run_report.comparable_metrics(
+        json.load(open(os.path.join(REPO, "BENCH_INDEX.json")))
+    )
+    r5 = json.load(open(os.path.join(REPO, "BENCH_r05.json")))
+    assert mapped["img_per_sec"] == r5["parsed"]["value"]
+
+
+# ------------------------------------------------------- trajectory pin
+_PIN_SCRIPT = """
+import os, sys, json
+os.environ["JAX_PLATFORMS"] = "cpu"
+os.environ.pop("XLA_FLAGS", None)  # ONE device: concurrent eval must run
+import jax
+jax.config.update("jax_platforms", "cpu")
+import distribuuuu_tpu.config as config
+from distribuuuu_tpu.config import cfg
+from distribuuuu_tpu import trainer
+
+out, mode, cc_dir = sys.argv[1], sys.argv[2], sys.argv[3]
+config.reset_cfg()
+cfg.MODEL.ARCH = "resnet18"
+cfg.MODEL.NUM_CLASSES = 10
+cfg.MODEL.DUMMY_INPUT = True
+cfg.DEVICE.COMPUTE_DTYPE = "float32"
+cfg.TRAIN.BATCH_SIZE = 4
+cfg.TRAIN.IM_SIZE = 16
+cfg.TRAIN.PRINT_FREQ = 64
+cfg.TEST.BATCH_SIZE = 32
+cfg.TEST.IM_SIZE = 16
+cfg.OPTIM.MAX_EPOCH = 2
+cfg.OPTIM.BASE_LR = 0.01
+cfg.RNG_SEED = 0
+cfg.OUT_DIR = out
+if mode == "async":
+    # async-EVERYTHING: background ckpt commit + concurrent eval +
+    # persistent compile cache, all at once
+    cfg.CHECKPOINT.ASYNC = True
+    cfg.TRAIN.CONCURRENT_EVAL = True
+    cfg.COMPILE_CACHE.ENABLED = True
+    cfg.COMPILE_CACHE.DIR = cc_dir
+best = trainer.train_model()
+assert jax.device_count() == 1
+print(f"PIN_DONE best={best}", flush=True)
+"""
+
+
+def test_async_everything_trajectory_bit_identical(tmp_path):
+    """ISSUE 10 hard contract, same style as the PR 7 monitor pin: a run
+    with background checkpoint commit + concurrent eval + persistent
+    compile cache all ON produces BIT-IDENTICAL checkpoint state trees
+    and eval metrics as the fully synchronous run. Fresh single-device
+    subprocesses: concurrent eval is gated to one device (two
+    multi-device programs dispatched from two threads can deadlock
+    their collectives), so the 8-virtual-device test mesh would
+    silently degrade it — a real 1-device run is the only honest pin."""
+    script = tmp_path / "pin.py"
+    script.write_text(_PIN_SCRIPT)
+    env = {**os.environ, "PYTHONPATH": REPO + os.pathsep
+           + os.environ.get("PYTHONPATH", "")}
+
+    def run(mode):
+        out_dir = str(tmp_path / mode)
+        proc = subprocess.run(
+            [sys.executable, str(script), out_dir, mode,
+             str(tmp_path / "cc")],
+            capture_output=True, text=True, env=env, cwd=REPO, timeout=540,
+        )
+        assert proc.returncode == 0, proc.stdout[-3000:] + proc.stderr[-2000:]
+        if mode == "async":  # the overlapped paths genuinely engaged
+            assert "concurrent eval: validate() overlaps" in proc.stderr                 or "concurrent eval: validate() overlaps" in proc.stdout
+        evals = [
+            (r["epoch"], r["loss"], r["top1"], r["topk"], r["samples"])
+            for r in (json.loads(ln)
+                      for ln in open(os.path.join(out_dir, "metrics.jsonl")))
+            if r["kind"] == "eval"
+        ]
+        return out_dir, evals
+
+    out_async, ev_async = run("async")
+    out_sync, ev_sync = run("sync")
+    assert len(ev_async) == 2 and ev_async == ev_sync  # per-epoch metrics
+    for name in ("ckpt_ep_000", "ckpt_ep_001", "best"):
+        a = ckpt.load_checkpoint(os.path.join(out_async, "checkpoints", name))
+        b = ckpt.load_checkpoint(os.path.join(out_sync, "checkpoints", name))
+        la = jax.tree_util.tree_flatten_with_path(a)[0]
+        lb = jax.tree_util.tree_flatten_with_path(b)[0]
+        assert [k for k, _ in la] == [k for k, _ in lb]
+        for (key, va), (_, vb) in zip(la, lb):
+            if "best_acc1" in jax.tree_util.keystr(key):
+                # concurrent mode: the boundary save records best as of
+                # the PREVIOUS eval (this epoch's is still in flight) —
+                # documented lag; the state trees themselves must match
+                continue
+            np.testing.assert_array_equal(
+                np.asarray(va), np.asarray(vb),
+                err_msg=f"{name}:{jax.tree_util.keystr(key)}",
+            )

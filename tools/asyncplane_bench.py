@@ -1,0 +1,235 @@
+"""Async-plane proof bench: measure what ISSUE 10 claims, commit it as
+``BENCH_r06.json``.
+
+Two measurements, both against the REAL trainer in fresh interpreters
+(the compile cache and the committer are process-lifetime state — only a
+genuine restart proves a warm restart):
+
+1. **Checkpoint stall split.** The same short run twice — synchronous
+   saves vs ``CHECKPOINT.ASYNC`` — and from each run's telemetry the
+   trainer-blocked seconds: sync runs block for the full ``ckpt_save``
+   span (payload + digests + manifest), async runs only for the
+   ``ckpt_snapshot`` span while the ``ckpt_commit`` span runs on the
+   background committer. The acceptance shape is snapshot ≪ commit.
+
+2. **Warm-restart compile count.** A cold run with ``COMPILE_CACHE`` on
+   populates the cache and records its ``jit.compiles``; a warm rerun of
+   the SAME config in a fresh process must show ``jit.compiles`` at or
+   near zero with ``jit.cache_hits`` ≈ the cold compile count — the
+   compile storm PR 5's counter made visible, gone.
+
+Output rides the BENCH_r*.json naming so ``tools/bench_history.py``
+folds it into BENCH_INDEX.json (series ``ckpt_trainer_blocked_s_*``,
+``warm_restart_compiles``, ...) — deliberately WITHOUT a ``parsed``
+img/s block: CPU-container seconds must never become the throughput
+reference run_report gates against.
+
+    JAX_PLATFORMS=cpu python tools/asyncplane_bench.py --out BENCH_r06.json
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import subprocess
+import sys
+import tempfile
+import time
+
+import _path  # noqa: F401  — repo root onto sys.path for the package import
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+WORKER = """
+import os, sys
+os.environ["JAX_PLATFORMS"] = "cpu"
+import jax
+jax.config.update("jax_platforms", "cpu")
+
+import distribuuuu_tpu.config as config
+from distribuuuu_tpu.config import cfg
+from distribuuuu_tpu import trainer
+
+out_dir = sys.argv[1]
+config.reset_cfg()
+cfg.MODEL.ARCH = "resnet18"
+cfg.MODEL.NUM_CLASSES = 10
+cfg.MODEL.DUMMY_INPUT = True
+cfg.DEVICE.COMPUTE_DTYPE = "float32"
+cfg.TRAIN.BATCH_SIZE = 2
+cfg.TRAIN.IM_SIZE = 32
+cfg.TRAIN.PRINT_FREQ = 32
+cfg.TEST.BATCH_SIZE = 8
+cfg.TEST.IM_SIZE = 32
+cfg.OPTIM.MAX_EPOCH = 2
+cfg.OPTIM.BASE_LR = 0.01
+cfg.RNG_SEED = 0
+cfg.OUT_DIR = out_dir
+if len(sys.argv) > 2:
+    cfg.merge_from_list(sys.argv[2:])
+best = trainer.train_model()
+print(f"BENCH_RUN_DONE best={best:.3f}", flush=True)
+"""
+
+
+def _run(work: str, out_dir: str, overrides=(), tag="run", timeout=1800):
+    script = os.path.join(work, "worker.py")
+    with open(script, "w") as f:
+        f.write(WORKER)
+    env = dict(os.environ)
+    env["PYTHONPATH"] = ROOT + os.pathsep + env.get("PYTHONPATH", "")
+    t0 = time.time()
+    proc = subprocess.run(
+        [sys.executable, script, out_dir, *map(str, overrides)],
+        env=env, cwd=ROOT, capture_output=True, text=True, timeout=timeout,
+    )
+    wall = round(time.time() - t0, 2)
+    if proc.returncode != 0:
+        raise RuntimeError(
+            f"{tag} run failed rc={proc.returncode}: "
+            f"{(proc.stdout + proc.stderr)[-2000:]}"
+        )
+    return wall
+
+
+def _telemetry_records(out_dir: str) -> list[dict]:
+    recs = []
+    tdir = os.path.join(out_dir, "telemetry")
+    if not os.path.isdir(tdir):
+        return recs
+    for name in sorted(os.listdir(tdir)):
+        if not name.endswith(".jsonl"):
+            continue
+        for line in open(os.path.join(tdir, name)):
+            try:
+                recs.append(json.loads(line))
+            except json.JSONDecodeError:
+                pass
+    return recs
+
+
+def _span_durs(recs: list[dict], name: str) -> list[float]:
+    return [
+        float(r["dur"]) for r in recs
+        if r.get("kind") == "span" and r.get("name") == name
+    ]
+
+
+def _last_counter(recs: list[dict], counter: str) -> int:
+    val = 0
+    for r in recs:
+        if r.get("kind") == "registry":
+            val = int((r.get("counters") or {}).get(counter, val))
+    return val
+
+
+def bench_ckpt_split(work: str) -> dict:
+    """Sync vs async save runs → the trainer-blocked second split."""
+    rows = {}
+    for mode, overrides in (
+        ("sync", ()),
+        ("async", ("CHECKPOINT.ASYNC", "True")),
+    ):
+        out = os.path.join(work, f"ckpt_{mode}")
+        _run(work, out, overrides, tag=f"ckpt_{mode}")
+        recs = _telemetry_records(out)
+        rows[mode] = {
+            "ckpt_save_s": _span_durs(recs, "ckpt_save"),
+            "ckpt_snapshot_s": _span_durs(recs, "ckpt_snapshot"),
+            "ckpt_commit_s": _span_durs(recs, "ckpt_commit"),
+        }
+    sync_saves = rows["sync"]["ckpt_save_s"]
+    snaps = rows["async"]["ckpt_snapshot_s"]
+    commits = rows["async"]["ckpt_commit_s"]
+    out = {
+        "runs": rows,
+        "trainer_blocked_s_sync": round(sum(sync_saves), 4),
+        "trainer_blocked_s_async": round(sum(snaps), 4),
+        "off_path_commit_s": round(sum(commits), 4),
+        "snapshot_mean_s": round(sum(snaps) / max(1, len(snaps)), 4),
+        "commit_mean_s": round(sum(commits) / max(1, len(commits)), 4),
+        "blocked_reduction_x": round(
+            sum(sync_saves) / max(sum(snaps), 1e-9), 2
+        ),
+        # the acceptance shape: the on-path snapshot is a small fraction
+        # of the off-path commit it replaced on the critical path
+        "snapshot_much_less_than_commit":
+            sum(snaps) < 0.5 * sum(commits) if commits else None,
+    }
+    return out
+
+
+def bench_compile_cache(work: str) -> dict:
+    """Cold + warm restart against one persistent cache dir."""
+    cache_dir = os.path.join(work, "compile_cache")
+    out_cold = os.path.join(work, "cc_cold")
+    out_warm = os.path.join(work, "cc_warm")
+    overrides = ("COMPILE_CACHE.ENABLED", "True", "COMPILE_CACHE.DIR",
+                 cache_dir)
+    cold_wall = _run(work, out_cold, overrides, tag="cc_cold")
+    # fresh interpreter + fresh OUT_DIR, SAME cache dir: every step
+    # program previously compiled must come back as a cache hit
+    warm_wall = _run(work, out_warm, overrides, tag="cc_warm")
+    cold = _telemetry_records(out_cold)
+    warm = _telemetry_records(out_warm)
+    return {
+        "cache_dir_entries": len([
+            n for n in os.listdir(cache_dir) if n.endswith("-cache")
+        ]),
+        "cold_compiles": _last_counter(cold, "jit.compiles"),
+        "cold_cache_misses": _last_counter(cold, "jit.cache_misses"),
+        "cold_wall_s": cold_wall,
+        "warm_compiles": _last_counter(warm, "jit.compiles"),
+        "warm_cache_hits": _last_counter(warm, "jit.cache_hits"),
+        "warm_cache_misses": _last_counter(warm, "jit.cache_misses"),
+        "warm_wall_s": warm_wall,
+    }
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--out", default="BENCH_r06.json")
+    ap.add_argument("--work-dir", default=None)
+    args = ap.parse_args(argv)
+    work = args.work_dir or tempfile.mkdtemp(prefix="asyncplane_bench_")
+    os.makedirs(work, exist_ok=True)
+
+    print("[asyncplane_bench] checkpoint stall split (sync vs async)...",
+          flush=True)
+    ckpt = bench_ckpt_split(work)
+    print(
+        f"  trainer blocked: sync {ckpt['trainer_blocked_s_sync']}s -> "
+        f"async {ckpt['trainer_blocked_s_async']}s "
+        f"({ckpt['blocked_reduction_x']}x less; "
+        f"{ckpt['off_path_commit_s']}s committed off-path)", flush=True,
+    )
+    print("[asyncplane_bench] compile cache cold/warm restart...", flush=True)
+    cc = bench_compile_cache(work)
+    print(
+        f"  cold: {cc['cold_compiles']} compiles ({cc['cold_wall_s']}s); "
+        f"warm restart: {cc['warm_compiles']} compiles, "
+        f"{cc['warm_cache_hits']} cache hits ({cc['warm_wall_s']}s)",
+        flush=True,
+    )
+
+    report = {
+        "schema": 1,
+        "generated_by": "tools/asyncplane_bench.py",
+        "platform": "cpu",
+        "note": (
+            "CPU container numbers: the SHAPE is the claim (snapshot << "
+            "commit; warm-restart compiles ~0), absolute seconds are not "
+            "a TPU reference. No `parsed` img/s block by design - these "
+            "series must not become the throughput gate baseline."
+        ),
+        "asyncplane": {"ckpt": ckpt, "compile_cache": cc},
+    }
+    with open(args.out, "w") as f:
+        json.dump(report, f, indent=1)
+    print(f"wrote {args.out}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,12 +1,14 @@
 """Fold the per-round bench artifacts into ONE machine-readable
 trajectory: ``BENCH_INDEX.json``.
 
-Five rounds of ``BENCH_r*.json`` (single-chip training throughput),
-``BENCH_serve.json`` (serving latency/throughput frontier + fleet
-scaling), and ``COSTMODEL_r*.json`` (the XLA cost-model ledger: measured
-MFU + HBM headroom, tools/costmodel_report.py) each have their own
-ad-hoc shape; answering "how has img/s moved across PRs" meant opening
-five files. This tool scans them all and emits one index:
+Rounds of ``BENCH_r*.json`` (single-chip training throughput; r06 adds
+the ``asyncplane`` section — checkpoint stall seconds + warm-restart
+compile counts, tools/asyncplane_bench.py), ``BENCH_serve.json``
+(serving latency/throughput frontier + fleet scaling), and
+``COSTMODEL_r*.json`` (the XLA cost-model ledger: measured MFU + HBM
+headroom, tools/costmodel_report.py) each have their own ad-hoc shape;
+answering "how has img/s moved across PRs" meant opening five files.
+This tool scans them all and emits one index:
 
     {"bench_index": 1,
      "series": {
@@ -51,10 +53,37 @@ def _point(series: dict, metric: str, rnd: str, source: str, value,
     })
 
 
+def index_asyncplane(path: str, doc: dict, series: dict) -> None:
+    """BENCH_r06+ ``asyncplane`` section (tools/asyncplane_bench.py):
+    trainer-blocked checkpoint seconds (async snapshot vs full sync
+    save) and the warm-restart compile counts. Deliberately named so
+    none of them matches the throughput-reference patterns run_report's
+    ``--compare BENCH_INDEX.json`` gates on — CPU-container seconds must
+    never become the img/s baseline."""
+    ap = doc.get("asyncplane") or {}
+    rnd, src = _round_of(path), os.path.basename(path)
+    ck = ap.get("ckpt") or {}
+    _point(series, "ckpt_trainer_blocked_s_async", rnd, src,
+           ck.get("trainer_blocked_s_async"), "s")
+    _point(series, "ckpt_trainer_blocked_s_sync", rnd, src,
+           ck.get("trainer_blocked_s_sync"), "s")
+    _point(series, "ckpt_commit_s_offpath", rnd, src,
+           ck.get("off_path_commit_s"), "s")
+    cc = ap.get("compile_cache") or {}
+    _point(series, "cold_start_compiles", rnd, src, cc.get("cold_compiles"))
+    _point(series, "warm_restart_compiles", rnd, src,
+           cc.get("warm_compiles"))
+    _point(series, "warm_restart_cache_hits", rnd, src,
+           cc.get("warm_cache_hits"))
+
+
 def index_train_bench(path: str, series: dict) -> None:
-    """BENCH_r*.json: the ``parsed`` block is the metric."""
+    """BENCH_r*.json: the ``parsed`` block is the metric (r06+ may
+    instead carry an ``asyncplane`` section — indexed separately)."""
     with open(path) as f:
         doc = json.load(f)
+    if doc.get("asyncplane"):
+        index_asyncplane(path, doc, series)
     parsed = doc.get("parsed") or {}
     if "metric" in parsed and "value" in parsed:
         _point(series, str(parsed["metric"]), _round_of(path),

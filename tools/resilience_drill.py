@@ -27,6 +27,16 @@ way an operator would verify a production incident:
   killed_rank           SIGKILL of rank 1 of 2 mid-epoch-1 (no grace
                         window) → the group restart resumes from the
                         intact ckpt_ep_000 and finishes
+  killed_mid_async_save CHECKPOINT.ASYNC: SIGKILL lands on the background
+                        committer AFTER ckpt_ep_001's payload is written
+                        but BEFORE its manifest commits → the restart
+                        quarantines the manifest-less dir ("no committed
+                        manifest") and walks back to ckpt_ep_000
+  async_save_then_preempt CHECKPOINT.ASYNC + SIGTERM mid-epoch → the
+                        preempt save drains the committer first (the
+                        boundary commit becomes durable inside the grace
+                        window), then commits synchronously; the restart
+                        resumes from the preempt checkpoint
   shards_midepoch       real shard corpus (DATA.FORMAT=shards): the
                         scheduler preempts (SIGTERM) mid-epoch-1 and the
                         process is SIGKILLed right after the preempt
@@ -302,6 +312,102 @@ def drill_partition_elastic(work):
         "completed": "DRILL_DONE" in log,
         "epoch1_saved": "ckpt_ep_001" in _ckpts(out),
     }
+    return all(checks.values()), checks
+
+
+@_drill("killed_mid_async_save")
+def drill_killed_mid_async_save(work):
+    """The async-save crash window (CHECKPOINT.ASYNC): SIGKILL lands on
+    the background committer between ckpt_ep_001's payload write and its
+    MANIFEST.json commit (FAULTS.KILL_MID_ASYNC_SAVE). The restart must
+    quarantine the manifest-less directory ("no committed manifest" — the
+    PR 3 protocol treats an uncommitted save as never having happened),
+    walk back to the intact ckpt_ep_000, re-train epoch 1, and complete."""
+    import signal as _signal
+
+    out = os.path.join(work, "out")
+    rc, log = _run_worker(
+        work, out,
+        ("OPTIM.MAX_EPOCH", 2, "CHECKPOINT.ASYNC", "True",
+         "FAULTS.ENABLED", "True", "FAULTS.KILL_MID_ASYNC_SAVE", 1),
+        tag="kill",
+    )
+    names = _ckpts(out)
+    checks = {
+        # SIGKILL from the committer thread kills the whole process
+        "sigkilled": rc == -_signal.SIGKILL,
+        "epoch0_committed": os.path.isfile(
+            os.path.join(out, "checkpoints", "ckpt_ep_000", "MANIFEST.json")
+        ),
+        # the crash window: payload on disk, manifest NOT
+        "payload_written_no_manifest": "ckpt_ep_001" in names
+        and not os.path.isfile(
+            os.path.join(out, "checkpoints", "ckpt_ep_001", "MANIFEST.json")
+        ),
+    }
+    if not all(checks.values()):
+        return False, checks
+    rc, log = _run_worker(
+        work, out, ("OPTIM.MAX_EPOCH", 2, "CHECKPOINT.ASYNC", "True"),
+        tag="recover",
+    )
+    names = _ckpts(out)
+    checks.update({
+        "recover_rc==0": rc == 0,
+        "quarantined_as_uncommitted": "no committed manifest" in log
+        and any(n.startswith("ckpt_ep_001.corrupt") for n in names),
+        "walked_back": "resumed from" in log and "ckpt_ep_000" in log,
+        "epoch1_retrained": "ckpt_ep_001" in names,
+        "completed": "DRILL_DONE" in log,
+    })
+    return all(checks.values()), checks
+
+
+@_drill("async_save_then_preempt")
+def drill_async_save_then_preempt(work):
+    """SIGTERM (deterministic scheduler preemption, FAULTS.PREEMPT_*)
+    lands while CHECKPOINT.ASYNC is on: the preempt save must DRAIN the
+    committer first — the previous boundary's commit becomes durable
+    before the mid-epoch checkpoint is written synchronously inside the
+    grace window — and the restart resumes from the preempt save."""
+    out = os.path.join(work, "out")
+    rc, log = _run_worker(
+        work, out,
+        ("OPTIM.MAX_EPOCH", 2, "CHECKPOINT.ASYNC", "True",
+         "FAULTS.ENABLED", "True", "FAULTS.PREEMPT_EPOCH", 1,
+         "FAULTS.PREEMPT_AT_BATCH", 3),
+        tag="preempt",
+    )
+    checks = {
+        "preempt_rc==0": rc == 0,
+        "preempt_logged": "preemption signaled" in log,
+        # the join barrier ran before the preempt save (logged drain)
+        "committer_drained": "async checkpoint committer drained" in log
+        and "preemption" in log,
+        # both the boundary save and the preempt save are fully committed
+        "epoch0_committed": os.path.isfile(
+            os.path.join(out, "checkpoints", "ckpt_ep_000", "MANIFEST.json")
+        ),
+        "preempt_committed": os.path.isfile(
+            os.path.join(out, "checkpoints", "preempt_ep_001",
+                         "MANIFEST.json")
+        ),
+    }
+    if not all(checks.values()):
+        return False, checks
+    rc, log = _run_worker(
+        work, out, ("OPTIM.MAX_EPOCH", 2, "CHECKPOINT.ASYNC", "True"),
+        tag="resume",
+    )
+    names = _ckpts(out)
+    checks.update({
+        "resume_rc==0": rc == 0,
+        "resumed_from_preempt": bool(
+            re.search(r"resumed from .*preempt_ep_001", log)
+        ),
+        "completed": "DRILL_DONE" in log,
+        "epoch1_saved": "ckpt_ep_001" in names,
+    })
     return all(checks.values()), checks
 
 
@@ -654,6 +760,7 @@ def main():
         drill_truncated_checkpoint, drill_partial_checkpoint,
         drill_nan_skip, drill_nan_rollback,
         drill_decode_error_retry, drill_decode_error_skip,
+        drill_killed_mid_async_save, drill_async_save_then_preempt,
         drill_stall_watchdog, drill_partition_elastic,
         drill_shards_midepoch_resume,
         drill_fleet_replica_kill,

@@ -24,7 +24,14 @@ Metrics:
 * **resilience events** — stall / data_error / nonfinite counts across
   ALL ranks (the per-rank sink is what makes ranks > 0 visible).
 * **recompiles** — ``kind="compile"`` count + wall seconds per rank.
-* **checkpoints** — save/restore span count, mean, max.
+* **checkpoints** — save/restore span count, mean, max — split into
+  on-critical-path time (synchronous ``ckpt_save`` spans + async
+  ``ckpt_snapshot`` spans: what the trainer actually blocked for) and
+  off-path time (``ckpt_commit`` spans: the background committer's wall,
+  ``CHECKPOINT.ASYNC`` — asyncplane/).
+* **compile cache** — persistent-compilation-cache hits/misses
+  (``kind="compile.cache"``): a warm restart shows hits ≈ programs and
+  recompiles ≈ 0.
 
 ``--compare BASELINE.json`` accepts a previous ``RUN_REPORT.json``, a
 repo ``BENCH_*.json`` artifact (its ``parsed.value`` img/s becomes the
@@ -255,16 +262,27 @@ def build_report(run_dir: str, phase: str = "train") -> dict:
 
     # -- recompiles / checkpoints / resilience events --------------------
     compiles = {"count": 0, "wall_s": 0.0}
+    cache = {"hits": 0, "misses": 0}
     ckpt = {"saves": 0, "save_mean_s": 0.0, "save_max_s": 0.0,
-            "restores": 0, "restore_mean_s": 0.0}
-    saves, restores = [], []
+            "restores": 0, "restore_mean_s": 0.0,
+            "snapshots": 0, "snapshot_mean_s": 0.0, "snapshot_max_s": 0.0,
+            "commits": 0, "commit_mean_s": 0.0, "commit_max_s": 0.0,
+            "on_path_s": 0.0, "off_path_s": 0.0}
+    saves, restores, snaps, commits = [], [], [], []
     for recs in ranks.values():
         for r in recs:
             if r.get("kind") == "compile":
                 compiles["count"] += 1
                 compiles["wall_s"] += float(r["dur_s"])
+            elif r.get("kind") == "compile.cache":
+                if r.get("event") == "hit":
+                    cache["hits"] += 1
+                elif r.get("event") == "miss":
+                    cache["misses"] += 1
         saves += [float(r["dur"]) for r in _spans(recs, "ckpt_save")]
         restores += [float(r["dur"]) for r in _spans(recs, "ckpt_restore")]
+        snaps += [float(r["dur"]) for r in _spans(recs, "ckpt_snapshot")]
+        commits += [float(r["dur"]) for r in _spans(recs, "ckpt_commit")]
     compiles["wall_s"] = round(compiles["wall_s"], 3)
     if saves:
         ckpt.update(saves=len(saves),
@@ -273,6 +291,19 @@ def build_report(run_dir: str, phase: str = "train") -> dict:
     if restores:
         ckpt.update(restores=len(restores),
                     restore_mean_s=round(sum(restores) / len(restores), 3))
+    # async checkpointing (CHECKPOINT.ASYNC): the trainer blocks only for
+    # the snapshot spans; commit spans run on the background committer —
+    # on_path vs off_path is the headline the async plane is gated on
+    if snaps:
+        ckpt.update(snapshots=len(snaps),
+                    snapshot_mean_s=round(sum(snaps) / len(snaps), 6),
+                    snapshot_max_s=round(max(snaps), 6))
+    if commits:
+        ckpt.update(commits=len(commits),
+                    commit_mean_s=round(sum(commits) / len(commits), 6),
+                    commit_max_s=round(max(commits), 6))
+    ckpt["on_path_s"] = round(sum(saves) + sum(snaps), 6)
+    ckpt["off_path_s"] = round(sum(commits), 6)
 
     step_summary = _summary_ms(pooled)
     mean_step_s = (
@@ -292,6 +323,7 @@ def build_report(run_dir: str, phase: str = "train") -> dict:
         "cost": _cost_section(ranks, phase, mean_step_s),
         "events": _count_events(ranks, metrics),
         "recompiles": compiles,
+        "compile_cache": cache if (cache["hits"] or cache["misses"]) else None,
         "checkpoint": ckpt,
     }
     return report
@@ -447,10 +479,25 @@ def _print_report(rep: dict) -> None:
           f"data_error={ev['data_error']} nonfinite={ev['nonfinite']}")
     rc = rep["recompiles"]
     print(f"recompiles: {rc['count']} ({rc['wall_s']}s)")
+    cache = rep.get("compile_cache")
+    if cache:
+        print(f"compile cache: {cache['hits']} hits, "
+              f"{cache['misses']} misses"
+              + ("  (warm restart: previously-compiled programs "
+                 "deserialized, not recompiled)"
+                 if cache["hits"] and not rc["count"] else ""))
     ck = rep["checkpoint"]
     print(f"checkpoints: {ck['saves']} saves "
           f"(mean {ck['save_mean_s']}s, max {ck['save_max_s']}s), "
           f"{ck['restores']} restores (mean {ck['restore_mean_s']}s)")
+    if ck["commits"] or ck["snapshots"]:
+        blocked = ck["on_path_s"]
+        off = ck["off_path_s"]
+        print(f"  async commit split: trainer blocked {blocked}s "
+              f"({ck['snapshots']} snapshots, mean "
+              f"{ck['snapshot_mean_s']}s) vs {off}s committed in the "
+              f"background ({ck['commits']} commits, mean "
+              f"{ck['commit_mean_s']}s)")
 
 
 def _print_compare(cmp: dict, baseline_path: str) -> None:
