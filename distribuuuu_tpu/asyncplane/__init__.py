@@ -3,8 +3,19 @@ checkpoint save, eval, and (re)compilation off the trainer's critical
 path without giving up one bit of the crash-consistency and determinism
 story.
 
-Three coordinated pieces, each a module here:
+Four coordinated pieces, each a module here:
 
+    sequencer.py      token-ordered dispatch ring (ISSUE 11) — the
+                      primitive that makes the other overlaps safe on
+                      multi-DEVICE topologies: every step dispatch from
+                      the trainer / concurrent-eval / snapshot threads
+                      acquires a dispatch token granted in ONE global
+                      order, with a completion fence on stream switches,
+                      so every device observes one program sequence and
+                      the cross-thread collective deadlock PR 10 pinned
+                      is structurally removed. Wedged dispatchers flag
+                      through the supervisor stall contract
+                      (kind="dispatch.wedge") instead of hanging.
     committer.py      async checkpoint commit — the trainer blocks only
                       for a device→host snapshot of the state tree; a
                       background committer thread writes the orbax
@@ -14,33 +25,43 @@ Three coordinated pieces, each a module here:
                       find_last_valid_checkpoint quarantines and walks
                       back over). Join barriers before the next save, at
                       preemption, and at exit; at most one commit in
-                      flight (bounded snapshot memory).
+                      flight (bounded snapshot memory). Multi-host saves
+                      commit async too, behind the cross-host commit
+                      barrier (all hosts' payload durable, then the
+                      manifest — kill-at-barrier recovered by walk-back).
     evalloop.py       concurrent eval — validate() runs against an
                       on-device epoch-boundary snapshot on a worker
                       thread while the next train epoch dispatches;
                       results (and the best-acc bookkeeping + log
-                      records) join at the following boundary.
+                      records) join at the following boundary. Runs on
+                      multi-device meshes under the sequencer.
     compile_cache.py  persistent compilation cache — JAX's on-disk
                       executable cache behind the COMPILE_CACHE config
                       node, with hit/miss counters: a warm restart skips
                       the compile storm, and a cache hit is counted as a
-                      hit, not a compile (telemetry/runtime.py).
+                      hit, not a compile (telemetry/runtime.py). Coexists
+                      with the HBM memory ledger via costmodel's
+                      subprocess-isolated AOT probe.
 
 Hard contracts (tests/test_asyncplane.py): the manifest is written
 strictly after every payload byte; async-everything on ≡ fully-sync run
-bit-identical (checkpoint state trees and eval metrics); concurrent-eval
-results ≡ sync validate() results.
+bit-identical (checkpoint state trees and eval metrics) — including on
+the multi-device mesh that used to deadlock; concurrent-eval results ≡
+sync validate() results.
 
 Grounding: "Exploring the limits of Concurrency in ML Training on
 Google TPUs" (arXiv:2011.03641) attributes MLPerf-scale wins to exactly
-these host-side overlaps.
+these host-side overlaps — across ALL cores, which is what the
+sequencer buys.
 """
 
 from distribuuuu_tpu.asyncplane.committer import (  # noqa: F401
     AsyncCommitError,
+    MultiHostSnapshotError,
     join_commits,
     pending_commits,
     snapshot_tree,
     submit_commit,
 )
 from distribuuuu_tpu.asyncplane.evalloop import ConcurrentEval  # noqa: F401
+from distribuuuu_tpu.asyncplane import sequencer  # noqa: F401

@@ -27,11 +27,45 @@ Every committed save leaves a ``kind="ckpt.async"`` telemetry record
 splitting on-path (``snapshot_s``) from off-path (``commit_s``) time;
 the commit itself runs under a ``ckpt_commit`` span
 (tools/run_report.py reports both sides).
+
+Multi-host (ISSUE 11): collective saves commit off-path too, behind a
+**cross-host commit barrier**. Each host's committer thread runs its
+share of the protocol against the shared checkpoint directory:
+
+    primary    opens the barrier (fresh ``.<name>.barrier/`` dir with an
+               OPEN sentinel), writes the orbax payload from its host
+               snapshot, fsyncs every payload byte, arrives
+               (``host0.arrived``), waits for every peer's arrival, then
+               — strictly last, behind the all-hosts-durable barrier —
+               commits MANIFEST.json and removes the barrier dir;
+    peers      wait for OPEN (a stale barrier from a killed previous
+               attempt cannot satisfy a new save), arrive
+               (``host<r>.arrived``), and wait for the manifest —
+               re-asserting their marker if the primary's barrier reset
+               raced it — so every host's join barrier agrees the commit
+               is durable before the next save / preemption exit.
+
+A host killed between barrier arrival and the manifest commit
+(``FAULTS.KILL_AT_COMMIT_BARRIER``) leaves a manifest-less directory —
+exactly the state the PR 3 walk-back protocol quarantines and recovers
+(drilled: ``tools/resilience_drill.py multihost_async_save_kill``).
+Barrier waits are bounded by ``ASYNC.BARRIER_TIMEOUT_S`` and surface as
+``AsyncCommitError`` at the next join, never as a silent hang; each host
+leaves a ``kind="ckpt.barrier"`` record with its barrier wait.
+
+The host snapshot itself (``snapshot_tree``) materializes every leaf
+from this host's addressable shards — replicated leaves and leaves
+sharded over local devices assemble to the full array. A tree sharded
+ACROSS hosts (ZeRO over a cross-host axis) cannot be materialized
+host-locally; ``MultiHostSnapshotError`` then degrades the save to the
+synchronous collective protocol (utils/checkpoint.py warns once).
 """
 
 from __future__ import annotations
 
 import atexit
+import os
+import shutil
 import threading
 import time
 
@@ -46,6 +80,12 @@ class AsyncCommitError(RuntimeError):
     barrier (the save that queued it already returned to the trainer)."""
 
 
+class MultiHostSnapshotError(RuntimeError):
+    """A leaf of the checkpoint payload is sharded across hosts and
+    cannot be materialized from this host's addressable shards — the
+    caller degrades to the synchronous collective save."""
+
+
 _state: dict = {
     "thread": None,   # the in-flight commit, or None
     "label": None,    # its checkpoint basename (for logs/errors)
@@ -56,20 +96,69 @@ _state: dict = {
 _lock = threading.Lock()
 
 
-def snapshot_tree(tree):
-    """Donation-safe host copy of a checkpoint payload: every
-    ``jax.Array`` leaf is fetched to host (``np.asarray`` blocks until
-    the device buffer is ready and copies it), so the trainer may donate
-    the originals to the next step the moment this returns. Non-array
-    leaves (python scalars, numpy) pass through untouched."""
+def _assemble_shards(shape, dtype, shards):
+    """Full host array from ``(index, data)`` shard pairs. Replica
+    shards dedup by index; every element must be covered, else
+    ``MultiHostSnapshotError`` (the leaf is sharded across hosts and a
+    host-local snapshot cannot represent it)."""
+    out = np.empty(shape, dtype)
+    covered = 0
+    seen_idx = set()
+    for idx, data in shards:
+        key = tuple(
+            (s.start, s.stop, s.step) if isinstance(s, slice) else s
+            for s in idx
+        )
+        out[idx] = data
+        if key not in seen_idx:
+            seen_idx.add(key)
+            covered += int(np.asarray(data).size)
+    total = int(np.prod(shape)) if shape != () else 1
+    if covered < total and shape != ():
+        raise MultiHostSnapshotError(
+            f"leaf of shape {shape} is sharded across hosts (local "
+            f"shards cover {covered}/{total} elements) — a host-local "
+            "snapshot cannot represent it"
+        )
+    return out
+
+
+def _materialize(leaf):
+    """Full host value of one ``jax.Array`` leaf from THIS host's
+    addressable shards. Fully-addressable arrays fetch directly; a
+    process-spanning leaf (replicated over a multi-host mesh, or sharded
+    over local devices only) assembles from its local shards."""
     import jax
 
-    def _snap(leaf):
-        if isinstance(leaf, jax.Array):
-            return np.asarray(leaf)
+    if not isinstance(leaf, jax.Array):
         return leaf
+    if leaf.is_fully_addressable:
+        return np.asarray(leaf)
+    shards = leaf.addressable_shards
+    if not shards:
+        raise MultiHostSnapshotError(
+            f"leaf of shape {leaf.shape} has no addressable shards on "
+            "this host"
+        )
+    return _assemble_shards(
+        leaf.shape, leaf.dtype,
+        ((s.index, np.asarray(s.data)) for s in shards),
+    )
 
-    return jax.tree.map(_snap, tree)
+
+def snapshot_tree(tree):
+    """Donation-safe host copy of a checkpoint payload: every
+    ``jax.Array`` leaf is fetched to host (blocking until the device
+    buffer is ready), so the trainer may donate the originals to the
+    next step the moment this returns. Non-array leaves (python scalars,
+    numpy) pass through untouched. Process-spanning leaves materialize
+    from this host's addressable shards when they cover the full array
+    (multi-host async commit); raises ``MultiHostSnapshotError`` for a
+    genuinely cross-host-sharded leaf — the caller degrades to the
+    synchronous collective save."""
+    import jax
+
+    return jax.tree.map(_materialize, tree)
 
 
 def pending_commits() -> bool:
@@ -170,3 +259,158 @@ def emit_commit_record(ckpt: str, snapshot_s: float, commit_s: float,
         "ckpt.async", ckpt=ckpt, snapshot_s=round(float(snapshot_s), 6),
         commit_s=round(float(commit_s), 6), ok=bool(ok),
     )
+
+
+# ------------------------------------------------- cross-host commit barrier
+_BARRIER_OPEN = "OPEN"
+
+
+def barrier_dir(path: str) -> str:
+    """The barrier rendezvous directory for one checkpoint: a hidden
+    sibling (never inside the orbax payload dir — verification walks
+    that tree) on the same shared storage the manifests live on."""
+    return os.path.join(
+        os.path.dirname(path), "." + os.path.basename(path) + ".barrier"
+    )
+
+
+def _fsync_tree(root: str) -> None:
+    """fsync every file and directory under ``root`` — the durability
+    attestation a host makes by ARRIVING at the barrier (the manifest's
+    own fsync pass is then redundant and skipped)."""
+    for dirpath, _, names in os.walk(root):
+        for name in names:
+            with open(os.path.join(dirpath, name), "rb") as f:
+                os.fsync(f.fileno())
+        fd = os.open(dirpath, os.O_RDONLY)
+        try:
+            os.fsync(fd)
+        finally:
+            os.close(fd)
+
+
+def open_barrier(path: str) -> str:
+    """Primary only: (re)create the barrier dir with a fresh OPEN
+    sentinel. Clearing FIRST makes a stale barrier from a killed
+    previous attempt unable to satisfy this save."""
+    bdir = barrier_dir(path)
+    shutil.rmtree(bdir, ignore_errors=True)
+    os.makedirs(bdir, exist_ok=True)
+    with open(os.path.join(bdir, _BARRIER_OPEN), "w") as f:
+        f.write(str(time.time()))
+        f.flush()
+        os.fsync(f.fileno())
+    return bdir
+
+
+def _arrive_marker(path: str, rank: int) -> str:
+    return os.path.join(barrier_dir(path), f"host{rank}.arrived")
+
+
+def arrive_barrier(path: str, rank: int) -> None:
+    """Record this host's arrival: its share of the payload is durable."""
+    marker = _arrive_marker(path, rank)
+    with open(marker, "w") as f:
+        f.write(str(time.time()))
+        f.flush()
+        os.fsync(f.fileno())
+
+
+def _wait_for(predicate, label: str, timeout: float, keepalive=None) -> float:
+    """Poll ``predicate`` under the stall watchdog; returns the seconds
+    waited or raises TimeoutError. ``keepalive`` (peers' manifest wait)
+    runs every poll — it re-asserts state a concurrent barrier reset may
+    have clobbered."""
+    from distribuuuu_tpu.config import cfg
+    from distribuuuu_tpu.resilience import supervisor
+
+    t0 = time.monotonic()
+    with supervisor.watch_blocking(label, cfg.TRAIN.STALL_TIMEOUT):
+        while not predicate():
+            if keepalive is not None:
+                keepalive()
+            if time.monotonic() - t0 > timeout:
+                raise TimeoutError(
+                    f"{label}: no progress after {timeout:.0f}s "
+                    "(ASYNC.BARRIER_TIMEOUT_S) — a peer host died or "
+                    "shared storage is unreachable; the save has NO "
+                    "committed manifest and auto-resume will walk back"
+                )
+            time.sleep(0.02)
+    return time.monotonic() - t0
+
+
+def emit_barrier_record(ckpt: str, host: int, hosts: int,
+                        wait_s: float) -> None:
+    """One ``kind="ckpt.barrier"`` record per host per multi-host async
+    save: the barrier wait run_report surfaces per host."""
+    telemetry_spans.emit_event(
+        "ckpt.barrier", ckpt=ckpt, host=int(host), hosts=int(hosts),
+        wait_s=round(float(wait_s), 6),
+    )
+
+
+def multihost_commit(path: str, payload: dict, epoch_cursor: int,
+                     write_payload, write_manifest, post_commit=None,
+                     rank: int | None = None,
+                     world: int | None = None) -> None:
+    """One host's share of a cross-host async commit (runs on that
+    host's committer thread). ``write_payload()`` writes the orbax
+    payload from the primary's host snapshot; ``write_manifest()``
+    commits the marker. The manifest stays strictly LAST, now behind the
+    all-hosts-durable barrier. ``rank``/``world`` default from the live
+    jax process (explicit for the single-process protocol tests)."""
+    import jax
+
+    from distribuuuu_tpu.config import cfg
+    from distribuuuu_tpu.utils import faults
+
+    if rank is None:
+        rank = jax.process_index()
+    if world is None:
+        world = jax.process_count()
+    timeout = float(cfg.ASYNC.BARRIER_TIMEOUT_S)
+    name = os.path.basename(path)
+    from distribuuuu_tpu.resilience.manifest import manifest_path
+
+    if rank == 0:
+        open_barrier(path)
+        write_payload()
+        _fsync_tree(path)  # durable before arriving — arrival attests it
+        arrive_barrier(path, 0)
+        wait_s = _wait_for(
+            lambda: all(
+                os.path.isfile(_arrive_marker(path, r))
+                for r in range(world)
+            ),
+            f"cross-host commit barrier ({name})", timeout,
+        )
+        # the injectable crash window: all hosts durable, manifest NOT
+        faults.maybe_kill_at_commit_barrier(path, epoch_cursor)
+        write_manifest()
+        if post_commit is not None:
+            post_commit(payload)
+        shutil.rmtree(barrier_dir(path), ignore_errors=True)
+    else:
+        bdir = barrier_dir(path)
+        wait_open = _wait_for(
+            lambda: os.path.isfile(os.path.join(bdir, _BARRIER_OPEN)),
+            f"cross-host barrier open ({name})", timeout,
+        )
+        arrive_barrier(path, rank)
+        # a concurrent barrier reset (primary re-opening after a crash
+        # of a previous attempt) may clear our marker: re-assert it
+        # every poll until the manifest lands
+        def _reassert():
+            try:
+                if not os.path.isfile(_arrive_marker(path, rank)):
+                    arrive_barrier(path, rank)
+            except OSError:
+                pass  # barrier mid-reset; the next poll re-asserts
+
+        wait_s = wait_open + _wait_for(
+            lambda: os.path.isfile(manifest_path(path)),
+            f"cross-host manifest wait ({name})", timeout,
+            keepalive=_reassert,
+        )
+    emit_barrier_record(name, rank, world, wait_s)

@@ -21,15 +21,17 @@ by the MAIN thread at join time — telemetry consumers see the same
 record order a synchronous run produces (per-batch eval spans, which
 carry their own timestamps, land as they happen).
 
-Single-process, single-DEVICE only (the trainer enforces it): two
-multi-device SPMD programs dispatched from two host threads can enqueue
-in different orders on different per-device queues — their collectives
-then cross-wait and the backend deadlocks (observed on the virtual
-8-device CPU mesh: eval's AllReduce waiting on train's across ranks).
-One device means one queue and no collectives, so any interleaving is
-safe. The trainer degrades to synchronous eval with a logged warning
-otherwise; lifting this needs a per-device dispatch-order guarantee
-(future work).
+Multi-device processes run under the dispatch sequencer
+(asyncplane/sequencer.py, ``ASYNC.SEQUENCER`` — ISSUE 11): the trainer,
+this worker, and the snapshot copies all dispatch through one
+token-ordered ring with a completion fence on stream switches, so the
+per-device program order that two free-running host threads used to
+scramble (the pinned PR 10 deadlock: eval's AllReduce cross-waiting
+train's at the XLA rendezvous on the 8-virtual-device mesh) is now a
+single agreed sequence. ``ASYNC.SEQUENCER=False`` restores the old
+single-device gate with a logged warning. Multi-host processes still
+degrade to synchronous eval — overlapping eval and train collectives
+ACROSS hosts needs a cross-host dispatch agreement (future work).
 """
 
 from __future__ import annotations
@@ -39,19 +41,26 @@ import threading
 import jax
 import jax.numpy as jnp
 
+from distribuuuu_tpu.asyncplane import sequencer
+
 
 def device_snapshot(tree):
     """On-device copy of every ``jax.Array`` leaf (sharding preserved —
     ``jnp.copy`` computes under the input's sharding). The copies are
     NOT donated anywhere, so the eval worker may read them for as long
-    as it likes while the train loop donates the originals."""
+    as it likes while the train loop donates the originals. The copy
+    programs dispatch under the sequencer's ``snapshot`` stream when it
+    is active (they carry no collectives, but token-ordering them keeps
+    every dispatch in the one global sequence)."""
 
     def _copy(leaf):
         if isinstance(leaf, jax.Array):
             return jnp.copy(leaf)
         return leaf
 
-    return jax.tree.map(_copy, tree)
+    return sequencer.dispatch(
+        sequencer.SNAPSHOT_STREAM, lambda: jax.tree.map(_copy, tree)
+    )
 
 
 class ConcurrentEval:

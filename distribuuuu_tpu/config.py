@@ -500,6 +500,44 @@ _C.FAULTS.TRUNCATE_SHARD = -1
 # (crash-before-commit path). -1 = off.
 _C.FAULTS.CORRUPT_EPOCH = -1
 _C.FAULTS.CORRUPT_MODE = "truncate"
+# Hold dispatch token #WEDGE_DISPATCH (the sequencer's global grant
+# counter — asyncplane/sequencer.py) for WEDGE_S seconds before the
+# dispatch proceeds: a wedged dispatcher thread. The sequencer's wedge
+# watchdog (wired through supervisor.watch_blocking) must flag it as a
+# kind="dispatch.wedge" record instead of the run hanging silently
+# (tools/resilience_drill.py dispatch_wedge_recovery). -1 = off.
+_C.FAULTS.WEDGE_DISPATCH = -1
+_C.FAULTS.WEDGE_S = 0.0
+# SIGKILL the PRIMARY host from its committer thread inside the
+# multi-host async-commit crash window: AFTER every host arrived at the
+# cross-host commit barrier (payload durable everywhere) but BEFORE
+# MANIFEST.json commits (asyncplane/committer.py). The restart must
+# quarantine the manifest-less dir and walk back
+# (tools/resilience_drill.py multihost_async_save_kill). -1 = off.
+_C.FAULTS.KILL_AT_COMMIT_BARRIER = -1
+
+# ------------------------------- async dispatch plane ------------------------
+# The dispatch sequencer (asyncplane/sequencer.py): the primitive that
+# makes overlapped execution safe on multi-DEVICE processes. Two host
+# threads dispatching SPMD programs concurrently can enqueue in
+# different per-device orders; their collectives then cross-wait at the
+# XLA rendezvous and the backend deadlocks (pinned: PR 10, reproduced
+# deterministically on the 8-virtual-device CPU mesh). With SEQUENCER on
+# (the default), every step dispatch from the trainer / concurrent-eval
+# / snapshot threads first acquires a dispatch token — tokens are
+# granted in ONE global order, and switching dispatch streams fences on
+# the previous stream's completion — so every device observes one
+# program sequence and the deadlock precondition is structurally
+# removed. SEQUENCER False is the explicit escape hatch: it restores the
+# PR 10 degrade-to-sync gates (concurrent eval single-device only, async
+# commit single-host only) with a logged warning.
+_C.ASYNC = CfgNode()
+_C.ASYNC.SEQUENCER = True
+# Cross-host commit barrier (multi-host CHECKPOINT.ASYNC): how long a
+# host waits for its peers' barrier arrivals / the manifest commit
+# before the background commit fails (surfaced as AsyncCommitError at
+# the next join barrier — never silent, never a hang).
+_C.ASYNC.BARRIER_TIMEOUT_S = 600.0
 
 # ------------------------------- checkpointing ------------------------------
 # Async execution plane (distribuuuu_tpu/asyncplane/): checkpoint commit off
@@ -514,9 +552,14 @@ _C.FAULTS.CORRUPT_MODE = "truncate"
 # preemption (the committer drains inside the SIGTERM grace window before
 # the preempt save), and at exit. Telemetry splits the cost:
 # "ckpt_snapshot" spans are the on-path time, "ckpt_commit" spans the
-# off-path time (tools/run_report.py reports both). Single-process runs
-# only — multi-host saves are collective, so ASYNC degrades to the
-# synchronous protocol with a logged warning.
+# off-path time (tools/run_report.py reports both). Multi-host runs
+# commit async too (ASYNC.SEQUENCER on, the default): hosts rendezvous
+# on a cross-host commit barrier — per-host background threads, payload
+# durable on every host, MANIFEST.json strictly last behind the
+# all-hosts-durable barrier (asyncplane/committer.py; a host killed
+# between barrier and manifest is recovered by the walk-back). Only a
+# state tree sharded ACROSS hosts (e.g. ZeRO over a cross-host axis)
+# still degrades to the synchronous collective save, with a warning.
 _C.CHECKPOINT = CfgNode()
 _C.CHECKPOINT.ASYNC = False
 
@@ -530,11 +573,15 @@ _C.CHECKPOINT.ASYNC = False
 # Epoch checkpoints record best_acc1 as of one eval earlier (the in-flight
 # eval hasn't joined when the boundary save happens); the weights-only
 # "best" checkpoint itself is always written when a new best joins.
-# Single-process, single-DEVICE runs only: two multi-device SPMD programs
-# dispatched from two host threads can enqueue in different orders on
-# different per-device queues, cross-wait in their collectives, and
-# deadlock the backend (observed on the virtual 8-device CPU mesh).
-# Anything else degrades to synchronous eval with a logged warning.
+# Multi-device processes run it under the dispatch sequencer
+# (ASYNC.SEQUENCER, asyncplane/sequencer.py): train/eval/snapshot
+# dispatches are token-ordered into one global program sequence, which
+# removes the cross-thread collective deadlock PR 10 pinned on the
+# 8-virtual-device mesh. Multi-host processes still degrade to
+# synchronous eval with a logged warning (eval collectives cannot
+# overlap train collectives across hosts without a cross-host dispatch
+# agreement — future work), as does ASYNC.SEQUENCER=False on
+# multi-device (the explicit escape hatch).
 _C.TRAIN.CONCURRENT_EVAL = False
 
 # ------------------------------- compilation cache ---------------------------
@@ -547,11 +594,12 @@ _C.TRAIN.CONCURRENT_EVAL = False
 # cache is NOT counted as a jit.compile (it is a deserialization, not a
 # compilation), so a warm restart shows jit.compiles at/near zero for
 # previously-compiled programs (tools/asyncplane_bench.py proves it into
-# BENCH_r06.json). TRADE-OFF: while the cache is active the cost-model
-# HBM ledger (TELEMETRY.COSTMODEL_MEMORY) is skipped — its extra AOT
-# compile corrupts the CPU backend heap when combined with the cache's
-# executable (de)serialization and a checkpoint restore in one process
-# (PERF.md "Async execution plane"); cost.step/cost.roofline still emit.
+# BENCH_r06.json). While the cache is active the cost-model HBM ledger
+# (TELEMETRY.COSTMODEL_MEMORY) runs its extra AOT compile in an ISOLATED
+# child process (telemetry/costmodel.py subprocess probe) — the in-process
+# compile corrupted the CPU backend heap when combined with the cache's
+# executable (de)serialization and a checkpoint restore (PERF.md "Async
+# execution plane"); the probe keeps cache and ledger coexisting.
 _C.COMPILE_CACHE = CfgNode()
 _C.COMPILE_CACHE.ENABLED = False
 # Cache directory; "" = {OUT_DIR}/compile_cache (restarts of the same run

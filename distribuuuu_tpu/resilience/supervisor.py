@@ -140,16 +140,23 @@ class NonFiniteMonitor:
 
 
 @contextmanager
-def watch_blocking(label: str, timeout: float, logger=None):
+def watch_blocking(label: str, timeout: float, logger=None, on_flag=None):
     """Stall coverage for blocking host-side operations OUTSIDE the
     epoch loop, where no ``Heartbeat`` thread is running: the async
-    checkpoint committer's join barrier, a preemption drain, a restore.
-    Same signal contract as the heartbeat — a warning line, the
-    ``resilience.stalls`` counter, and a ``kind="stall"`` record — when
-    the wrapped block exceeds ``timeout`` seconds (the operator's first
-    clue that storage, not training, is what hung). ``timeout <= 0``
-    disables (zero overhead: no thread is started). Flag, not kill —
-    the block keeps waiting; the restart decision stays external."""
+    checkpoint committer's join barrier, the cross-host commit barrier
+    wait, a preemption drain, a restore, the dispatch sequencer's
+    token/fence waits. Same signal contract as the heartbeat — a warning
+    line, the ``resilience.stalls`` counter, and a ``kind="stall"``
+    record — when the wrapped block exceeds ``timeout`` seconds (the
+    operator's first clue that storage, not training, is what hung).
+    ``timeout <= 0`` disables (zero overhead: no thread is started).
+    Flag, not kill — the block keeps waiting; the restart decision stays
+    external.
+
+    ``on_flag(age_s)`` replaces the default emission: callers with their
+    own record kind (the sequencer's ``dispatch.wedge``) reuse the
+    watcher mechanics but speak their own schema — kinds stay literal at
+    their emit sites for the static schema check."""
     timeout = float(timeout)
     if timeout <= 0:
         yield
@@ -162,6 +169,9 @@ def watch_blocking(label: str, timeout: float, logger=None):
         while not done.wait(min(timeout / 4.0, 1.0)):
             age = time.monotonic() - t0
             if age > timeout:
+                if on_flag is not None:
+                    on_flag(age)
+                    return  # one flag per excursion
                 logger.warning(
                     "blocked in %s for %.1fs (threshold %.1fs) — hung "
                     "storage or a wedged background commit; see "

@@ -70,6 +70,7 @@ RULE_KINDS = (
     "throughput-regression",
     "mfu-regression",
     "hbm-headroom-low",
+    "dispatch-wedge",
 )
 
 _RANK_RE = re.compile(r"rank(\d+)\.jsonl$")
@@ -263,6 +264,7 @@ class LiveAggregator:
         self._compile_wall = 0.0
         self._ckpt_saves: list[float] = []
         self._ckpt_restores: list[float] = []
+        self._wedges = 0  # dispatch.wedge records this window (sequencer)
         self._have_rank_sinks = False
         # cost-model ledger state (run-scope: a run emits each cost.*
         # record once, at first dispatch — it must survive window resets)
@@ -307,6 +309,11 @@ class LiveAggregator:
                 self._compile_wall += float(rec["dur_s"])
             except (KeyError, TypeError, ValueError):
                 pass
+            return
+        if kind == "dispatch.wedge":
+            # the sequencer's wedge watchdog flagged a stuck dispatcher
+            # (asyncplane/sequencer.py) — the dispatch-wedge rule's input
+            self._wedges += 1
             return
         if kind == "cost.step":
             # per-step flops + the resolved peak, for the live MFU read
@@ -454,6 +461,7 @@ class LiveAggregator:
                 "count": self._compiles,
                 "wall_s": round(self._compile_wall, 3),
             },
+            "dispatch_wedges": self._wedges,
             "events": dict(self._events),
             "ckpt": {
                 "saves": len(self._ckpt_saves),
@@ -472,6 +480,7 @@ class LiveAggregator:
         self._compile_wall = 0.0
         self._ckpt_saves = []
         self._ckpt_restores = []
+        self._wedges = 0
         return snap
 
 
@@ -707,6 +716,12 @@ class RuleEngine:
             # record arrived (insufficient signal ≠ calm)
             hr = snap.get("hbm_headroom_pct")
             return None if hr is None else float(hr)
+        if rule.kind == "dispatch-wedge":
+            # sequencer wedge flags (kind="dispatch.wedge" records —
+            # asyncplane/sequencer.py) over the lookback window
+            return float(
+                sum(e["snap"].get("dispatch_wedges", 0) for e in window)
+            )
         return None
 
     def _breached(self, rule: AlertRule, value: float) -> bool:
@@ -832,6 +847,11 @@ def render_prometheus(snap: dict, engine: RuleEngine | None = None) -> str:
     if snap.get("hbm_headroom_pct") is not None:
         gauge("dtpu_hbm_headroom_pct", snap["hbm_headroom_pct"],
               "tightest executable HBM headroom percent")
+    # sequencer wedge flags appear only once one fired (conditional like
+    # the cost-model gauges — the golden exposition stays unchanged)
+    if snap.get("dispatch_wedges"):
+        gauge("dtpu_dispatch_wedges", snap["dispatch_wedges"],
+              "dispatch-sequencer wedge flags in the last window")
     counter("dtpu_steps_total", snap["totals"]["steps"],
             "steps observed since the monitor attached")
     counter("dtpu_recompiles_total", snap["totals"]["compiles"],

@@ -59,6 +59,15 @@ KINDS: dict[str, frozenset] = {
     # one per persistent-compilation-cache lookup (telemetry/runtime.py):
     # event "hit"|"miss" + the process-lifetime running tallies
     "compile.cache": frozenset({"event", "hits", "misses"}),
+    # dispatch sequencer stats (asyncplane/sequencer.py), emitted at
+    # epoch boundaries: running token/fence aggregates of the ring
+    "dispatch.token": frozenset({"tokens", "max_wait_s", "fence_waits"}),
+    # a wedged dispatcher flagged by the sequencer's watchdog (the
+    # monitor's dispatch-wedge rule input)
+    "dispatch.wedge": frozenset({"age_s", "holder", "count"}),
+    # one per host per multi-host async save: the cross-host commit
+    # barrier wait (asyncplane/committer.py multihost_commit)
+    "ckpt.barrier": frozenset({"ckpt", "host", "hosts", "wait_s"}),
     # -- XLA cost-model ledger (telemetry/costmodel.py) ------------------
     # per-step flops/bytes from cost_analysis (source "xla") or the hand
     # table (source "analytic"); peak_flops is the full-mesh peak so

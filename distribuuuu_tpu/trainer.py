@@ -60,7 +60,7 @@ from distribuuuu_tpu.parallel.partition.lowering import (  # noqa: F401
     make_train_step,
 )
 from distribuuuu_tpu import asyncplane
-from distribuuuu_tpu.asyncplane import compile_cache
+from distribuuuu_tpu.asyncplane import compile_cache, sequencer
 from distribuuuu_tpu.resilience import manifest as manifest_lib, supervisor
 from distribuuuu_tpu import telemetry
 from distribuuuu_tpu.telemetry import (
@@ -595,7 +595,12 @@ def train_epoch(loader, mesh, state, train_step, epoch: int, logger,
                         memory_only=True,
                     )
                     prof.begin(done)
-                    state, metrics = scan_step(state, batch)
+                    # token-ordered when a second dispatch stream is
+                    # active (asyncplane/sequencer.py); pass-through with
+                    # one attribute read otherwise
+                    state, metrics = sequencer.dispatch(
+                        sequencer.TRAIN_STREAM, scan_step, state, batch
+                    )
                     prof.end(done + fold - 1, state)
                     pending.append((fold, metrics))
                 else:  # ragged tail: per-step dispatch
@@ -607,7 +612,9 @@ def train_epoch(loader, mesh, state, train_step, epoch: int, logger,
                             phase="train",
                         )
                         prof.begin(done + i)
-                        state, metrics = train_step(state, b)
+                        state, metrics = sequencer.dispatch(
+                            sequencer.TRAIN_STREAM, train_step, state, b
+                        )
                         prof.end(done + i, state)
                         pending.append((1, metrics))
                 done += n
@@ -658,7 +665,9 @@ def train_epoch(loader, mesh, state, train_step, epoch: int, logger,
                 )
                 prof.begin(abs_it)
                 tl["step0"] = time.perf_counter()
-                state, metrics = train_step(state, batch)
+                state, metrics = sequencer.dispatch(
+                    sequencer.TRAIN_STREAM, train_step, state, batch
+                )
                 tl["step1"] = time.perf_counter()
                 prof.end(abs_it, state)
                 pending.append((1, metrics))
@@ -722,7 +731,14 @@ def validate(loader, mesh, state, eval_step, epoch: int, logger,
             eval_step, state, batch, label="eval_step", phase="eval"
         )
         tl["step0"] = time.perf_counter()
-        m = eval_step(state, batch)
+        # eval steps do not chain through data dependencies, so under
+        # the sequencer each one is dispatched fenced (outputs ready
+        # before the token releases) — the eval thread absorbs the wait,
+        # the train stream never fences on eval (asyncplane/sequencer.py
+        # has the dispatch-ordering story); pass-through when inactive
+        m = sequencer.dispatch(
+            sequencer.EVAL_STREAM, eval_step, state, batch, fence=True
+        )
         totals = (
             m
             if totals is None
@@ -806,12 +822,20 @@ def _place_like(tmpl, new):
     """Place restored arrays with the live template's dtype + layout
     (replicated, TP- or ZeRO-sharded), leaf by leaf.
 
-    Host (numpy) leaves go through a plain sharded device_put. Restored
-    ``jax.Array`` leaves that SPAN processes (multi-host ZeRO resume:
-    orbax hands back arrays in their saved sharding, of which this
-    process addresses only its slice) cannot be fetched to host at all —
-    those reshard on-device through a jitted identity with the template's
-    sharding as out_shardings (compiles to the minimal collective)."""
+    Host (numpy) leaves go through a plain sharded device_put on a
+    single-process run; on MULTI-HOST they place collective-free through
+    ``jax.make_array_from_callback`` (each process feeds its addressable
+    shards from its own host copy) — a cross-process ``device_put``
+    dispatches per-leaf gloo/ICI collectives whose enqueue order is not
+    agreed across hosts, and two hosts mid-restore can interleave them
+    (observed: gloo "op.preamble.length <= op.nbytes" aborts restoring a
+    multi-host async save; the same dispatch-ordering hazard the
+    sequencer removes from the train loop). Restored ``jax.Array``
+    leaves that SPAN processes (multi-host ZeRO resume: orbax hands back
+    arrays in their saved sharding, of which this process addresses only
+    its slice) cannot be fetched to host at all — those reshard
+    on-device through a jitted identity with the template's sharding as
+    out_shardings (compiles to the minimal collective)."""
 
     def _place(t, n):
         dtype = getattr(t, "dtype", None)
@@ -823,7 +847,12 @@ def _place_like(tmpl, new):
             # set_lr injects in place (a mid-run rollback resumes against
             # a live, already-mutated state): keep it host-side
             return np.asarray(n, dtype=dtype) if dtype is not None else n
-        return jax.device_put(np.asarray(n, dtype=dtype), sharding)
+        host = np.asarray(n, dtype=dtype)
+        if not sharding.is_fully_addressable:
+            return jax.make_array_from_callback(
+                host.shape, sharding, lambda idx: host[idx]
+            )
+        return jax.device_put(host, sharding)
 
     return jax.tree.map(_place, tmpl, new)
 
@@ -1166,42 +1195,54 @@ def train_model():
     def _epoch_telemetry(epoch):
         """Epoch-boundary sampling: device memory stats (TPU/GPU — the
         CPU backend reports none) and one registry snapshot (recompile
-        counters, IO tallies) per rank — run_report reads the last."""
+        counters, IO tallies) per rank — run_report reads the last.
+        With the dispatch sequencer active, its running token/fence
+        aggregates land as a ``dispatch.token`` record too."""
         if not telemetry.enabled():
             return
         if cfg.TELEMETRY.MEMSTATS:
             telemetry_runtime.sample_memstats(epoch=epoch + 1)
+        sequencer.emit_stats(epoch=epoch + 1)
         telemetry.emit_snapshot(epoch=epoch + 1)
 
     # concurrent eval (TRAIN.CONCURRENT_EVAL — asyncplane/evalloop.py):
     # validate() runs against an on-device epoch-boundary snapshot on a
     # worker thread while the next train epoch dispatches; results join
     # (with best-acc bookkeeping + the eval/epoch records) one boundary
-    # later. Single-process only — on multi-host the eval collectives
-    # would interleave with train collectives across processes.
+    # later. Multi-device processes run under the dispatch sequencer
+    # (asyncplane/sequencer.py): train/eval/snapshot dispatches are
+    # token-ordered into one global program sequence, which removes the
+    # cross-thread collective deadlock PR 10 pinned on the
+    # 8-virtual-device mesh. Multi-host still degrades (cross-host
+    # dispatch agreement is future work), as does ASYNC.SEQUENCER=False
+    # on multi-device — the explicit escape hatch.
     conc_eval = None
     if cfg.TRAIN.CONCURRENT_EVAL:
         if jax.process_count() > 1:
             logger.warning(
                 "TRAIN.CONCURRENT_EVAL requested but process_count=%d — "
                 "multi-host eval collectives cannot overlap train "
-                "collectives; falling back to synchronous eval",
+                "collectives without a cross-host dispatch agreement; "
+                "falling back to synchronous eval",
                 jax.process_count(),
             )
-        elif jax.device_count() > 1:
-            # two SPMD programs dispatched from two host threads can land
-            # in DIFFERENT orders on different per-device queues — their
-            # collectives then cross-wait and the backend deadlocks
-            # (observed on the 8-virtual-device CPU mesh). One device has
-            # one queue and no collectives: any interleaving is safe.
+        elif jax.device_count() > 1 and not cfg.ASYNC.SEQUENCER:
             logger.warning(
-                "TRAIN.CONCURRENT_EVAL requested but device_count=%d — "
-                "overlapped dispatch of two multi-device programs can "
+                "TRAIN.CONCURRENT_EVAL requested with "
+                "ASYNC.SEQUENCER=False and device_count=%d — without "
+                "token-ordered dispatch two multi-device programs can "
                 "interleave their collectives per-device and deadlock; "
-                "falling back to synchronous eval (single-device "
-                "processes only)", jax.device_count(),
+                "falling back to synchronous eval (re-enable "
+                "ASYNC.SEQUENCER to overlap)", jax.device_count(),
             )
         else:
+            if jax.device_count() > 1:
+                sequencer.install(cfg.TRAIN.STALL_TIMEOUT, logger=logger)
+                logger.info(
+                    "dispatch sequencer active: train/eval/snapshot "
+                    "dispatches token-ordered across %d devices "
+                    "(ASYNC.SEQUENCER)", jax.device_count(),
+                )
             conc_eval = asyncplane.ConcurrentEval(
                 lambda snap, ep: validate(
                     val_loader, mesh, snap, eval_step, ep, logger,
@@ -1421,6 +1462,10 @@ def train_model():
             asyncplane.join_commits()
         except asyncplane.AsyncCommitError as qe:
             logger.warning("async committer quiesced with error: %s", qe)
+        # the sequencer's final stats, then back to the zero-overhead
+        # pass-through (process-global, like the committer's state)
+        sequencer.emit_stats(final=True)
+        sequencer.shutdown()
 
 
 def test_model():

@@ -25,11 +25,16 @@ trainer blocks only for the device→host snapshot of the payload
 (``ckpt_snapshot`` span); the orbax write, digests, and manifest commit
 run on a background thread (``ckpt_commit`` span), manifest still
 strictly LAST — the crash-consistency story above is byte-for-byte the
-same, just off the critical path. Single-process runs only (multi-host
-saves are collective); degrades to the synchronous protocol with one
-logged warning. Preempt saves always drain the committer first and
-commit synchronously — the process is about to exit, and the grace
-window must end with a durable manifest.
+same, just off the critical path. Multi-host runs commit async too:
+each host's committer thread runs the cross-host commit barrier
+(asyncplane/committer.py ``multihost_commit`` — payload durable on
+every host BEFORE the primary's manifest), unless ``ASYNC.SEQUENCER``
+is off (the escape hatch) or the state tree is sharded across hosts
+(host-local snapshots cannot represent it) — those degrade to the
+synchronous collective protocol with one logged warning. Preempt saves
+always drain the committer first and commit synchronously — the
+process is about to exit, and the grace window must end with a durable
+manifest.
 """
 
 from __future__ import annotations
@@ -237,28 +242,50 @@ def unpack_opt_state(template, stored):
     return jax.tree.unflatten(tdef, leaves)
 
 
-_state: dict = {"async_warned": False}
+_state: dict = {"async_warned": False, "snapshot_warned": False,
+                "solo": False}
 
 
 def async_enabled() -> bool:
-    """CHECKPOINT.ASYNC, gated to single-process runs: the orbax write
-    is collective on multi-host — every process must participate at the
-    same point, which a per-process background thread cannot line up.
-    Degrades to the synchronous protocol with one logged warning."""
+    """CHECKPOINT.ASYNC. Multi-host runs commit async too, behind the
+    cross-host commit barrier (asyncplane/committer.py): per-host
+    background committer threads rendezvous on payload durability and
+    the manifest commits strictly last — unless ``ASYNC.SEQUENCER`` is
+    off (the explicit escape hatch restoring the PR 10 single-host
+    gate, warned once). A state tree sharded ACROSS hosts additionally
+    degrades at snapshot time (see ``_save_full``)."""
     if not cfg.CHECKPOINT.ASYNC:
         return False
-    if jax.process_count() > 1:
+    if jax.process_count() > 1 and not cfg.ASYNC.SEQUENCER:
         if not _state.get("async_warned"):
             _state["async_warned"] = True
             from distribuuuu_tpu.utils.logger import get_logger
 
             get_logger().warning(
-                "CHECKPOINT.ASYNC requested but process_count=%d — "
-                "multi-host saves are collective; falling back to "
-                "synchronous checkpointing", jax.process_count(),
+                "CHECKPOINT.ASYNC requested with ASYNC.SEQUENCER=False "
+                "and process_count=%d — the cross-host commit barrier "
+                "is part of the sequencer plane; falling back to "
+                "synchronous collective checkpointing",
+                jax.process_count(),
             )
         return False
     return True
+
+
+def _solo_checkpointer():
+    """An orbax checkpointer whose internal barriers span only THIS
+    process. The multihost async commit writes the primary's
+    host-snapshot payload SOLO (the peers attest durability through the
+    cross-host commit barrier instead) — the default ``Checkpointer``
+    would block at its own all-process sync, which the peers never
+    reach."""
+    return ocp.Checkpointer(
+        ocp.PyTreeCheckpointHandler(),
+        multiprocessing_options=ocp.options.MultiprocessingOptions(
+            primary_host=jax.process_index(),
+            active_processes={jax.process_index()},
+        ),
+    )
 
 
 def _commit(path: str, payload: dict, epoch_cursor: int,
@@ -312,29 +339,88 @@ def _save_full(
         from distribuuuu_tpu.asyncplane import committer
 
         # on-path cost: ONLY the host snapshot (donation-safe copy); the
-        # span is what run_report attributes as trainer-blocked time
-        t0 = _time.perf_counter()
-        with telemetry_spans.span(
-            "ckpt_snapshot", track="ckpt", ckpt=name,
-            epoch=int(epoch_cursor),
-        ):
-            payload = committer.snapshot_tree(payload)
-        snapshot_s = _time.perf_counter() - t0
+        # span is what run_report attributes as trainer-blocked time.
+        # Non-primary hosts of a multi-host run snapshot nothing — the
+        # primary's host snapshot materializes the full tree; their
+        # committer thread only runs the barrier protocol.
+        multihost = jax.process_count() > 1
+        snapshot_s = 0.0
+        try:
+            if not multihost or jax.process_index() == 0:
+                t0 = _time.perf_counter()
+                with telemetry_spans.span(
+                    "ckpt_snapshot", track="ckpt", ckpt=name,
+                    epoch=int(epoch_cursor),
+                ):
+                    payload = committer.snapshot_tree(payload)
+                snapshot_s = _time.perf_counter() - t0
+        except committer.MultiHostSnapshotError as e:
+            # cross-host-sharded state (e.g. ZeRO over a cross-host
+            # axis): a host-local snapshot cannot represent it — the
+            # save stays on the synchronous collective protocol
+            if not _state.get("snapshot_warned"):
+                _state["snapshot_warned"] = True
+                from distribuuuu_tpu.utils.logger import get_logger
 
-        def _bg_commit():
-            c0 = _time.perf_counter()
-            with telemetry_spans.span(
-                "ckpt_commit", track="ckpt", ckpt=name,
-                epoch=int(epoch_cursor),
-            ):
-                _commit(path, payload, epoch_cursor, post_commit,
-                        fsync_payload=True)
-            committer.emit_commit_record(
-                name, snapshot_s, _time.perf_counter() - c0
-            )
+                get_logger().warning(
+                    "CHECKPOINT.ASYNC: state is sharded across hosts "
+                    "(%s) — committing synchronously (collective)", e,
+                )
+        else:
+            if multihost:
+                # only the primary's closures touch the payload — a
+                # non-primary host must not pin references to device
+                # buffers the next epoch's steps are about to donate
+                bg_payload = payload if jax.process_index() == 0 else None
 
-        committer.submit_commit(name, _bg_commit)
-        return path
+                def _post_solo(p):
+                    # post-commit work (the best side-write) must use
+                    # the solo checkpointer too — the peers are not in
+                    # this code path to meet a collective barrier
+                    if post_commit is None:
+                        return
+                    _state["solo"] = True
+                    try:
+                        post_commit(p)
+                    finally:
+                        _state["solo"] = False
+
+                def _bg_multihost():
+                    c0 = _time.perf_counter()
+                    with telemetry_spans.span(
+                        "ckpt_commit", track="ckpt", ckpt=name,
+                        epoch=int(epoch_cursor),
+                    ):
+                        committer.multihost_commit(
+                            path, bg_payload, epoch_cursor,
+                            write_payload=lambda: _solo_checkpointer()
+                            .save(path, bg_payload, force=True),
+                            write_manifest=lambda: manifest_lib
+                            .write_manifest(path, bg_payload, kind="full",
+                                            epoch=epoch_cursor),
+                            post_commit=_post_solo,
+                        )
+                    committer.emit_commit_record(
+                        name, snapshot_s, _time.perf_counter() - c0
+                    )
+
+                committer.submit_commit(name, _bg_multihost)
+                return path
+
+            def _bg_commit():
+                c0 = _time.perf_counter()
+                with telemetry_spans.span(
+                    "ckpt_commit", track="ckpt", ckpt=name,
+                    epoch=int(epoch_cursor),
+                ):
+                    _commit(path, payload, epoch_cursor, post_commit,
+                            fsync_payload=True)
+                committer.emit_commit_record(
+                    name, snapshot_s, _time.perf_counter() - c0
+                )
+
+            committer.submit_commit(name, _bg_commit)
+            return path
     # span covers payload + manifest commit: the save duration an operator
     # budgets the preemption grace window against (tools/run_report.py
     # reports count/mean/max per rank from these)
@@ -361,9 +447,14 @@ def prune_preempts(upto: int):
 
 def _write_best(params, batch_stats, epoch: int) -> str:
     """The weights-only ``best`` side-write: payload then manifest, same
-    commit ordering as a full save. Accepts device OR host arrays."""
+    commit ordering as a full save. Accepts device OR host arrays. Runs
+    solo (process-local orbax barriers) when invoked from the multihost
+    async commit's post-commit hook — the peers are at the cross-host
+    barrier, not inside orbax."""
     best = {"params": params, "batch_stats": batch_stats}
-    ocp.PyTreeCheckpointer().save(get_best_checkpoint(), best, force=True)
+    ckptr = _solo_checkpointer() if _state.get("solo") else \
+        ocp.PyTreeCheckpointer()
+    ckptr.save(get_best_checkpoint(), best, force=True)
     if jax.process_index() == 0:
         manifest_lib.write_manifest(
             get_best_checkpoint(), best, kind="weights", epoch=epoch
@@ -379,7 +470,10 @@ def save_best_checkpoint(params, batch_stats, epoch: int) -> str:
     commit; ``params``/``batch_stats`` must then be snapshot copies the
     train loop will not donate (asyncplane/evalloop.device_snapshot)."""
     path = get_best_checkpoint()
-    if async_enabled():
+    # the standalone async side-write rides the single-process committer
+    # only (its caller, the concurrent-eval join, is single-process); a
+    # multi-host best write goes through the collective path below
+    if async_enabled() and jax.process_count() == 1:
         from distribuuuu_tpu.asyncplane import committer
 
         committer.submit_commit(

@@ -34,6 +34,15 @@ survive into a reproducible, config-driven event, so tests and
                          payload is written but before its manifest
                          commits (CHECKPOINT.ASYNC): the walk-back must
                          recover from the previous intact checkpoint;
+  wedged dispatcher      ``FAULTS.WEDGE_DISPATCH/WEDGE_S`` — hold the
+                         sequencer's dispatch token (asyncplane/
+                         sequencer.py) for WEDGE_S seconds so the wedge
+                         watchdog must flag a ``dispatch.wedge`` record;
+  killed at barrier      ``FAULTS.KILL_AT_COMMIT_BARRIER`` — SIGKILL the
+                         primary host between the cross-host commit
+                         barrier (all payloads durable) and the manifest
+                         commit (multi-host CHECKPOINT.ASYNC): the
+                         restart walks back over the manifest-less dir;
   recompile storm        ``FAULTS.RECOMPILE_AT_BATCH/RECOMPILE_N`` —
                          N real backend compiles mid-run (trivial jits
                          at distinct shapes; the shape-leak signature
@@ -58,8 +67,9 @@ from distribuuuu_tpu.config import cfg
 __all__ = [
     "InjectedFault", "enabled", "nan_injection_step", "maybe_decode_error",
     "maybe_kill", "maybe_stall", "maybe_corrupt_checkpoint",
-    "maybe_kill_mid_async_save", "maybe_preempt", "maybe_truncate_shard",
-    "maybe_recompile", "maybe_slowdown", "reset",
+    "maybe_kill_mid_async_save", "maybe_kill_at_commit_barrier",
+    "maybe_preempt", "maybe_truncate_shard",
+    "maybe_recompile", "maybe_slowdown", "maybe_wedge_dispatch", "reset",
 ]
 
 
@@ -68,7 +78,8 @@ class InjectedFault(RuntimeError):
 
 
 _state: dict = {"decode_raised": set(), "preempted": False,
-                "truncated_shards": set(), "recompiled": False}
+                "truncated_shards": set(), "recompiled": False,
+                "wedged": False}
 
 
 def reset() -> None:
@@ -77,6 +88,7 @@ def reset() -> None:
     _state["preempted"] = False
     _state["truncated_shards"] = set()
     _state["recompiled"] = False
+    _state["wedged"] = False
 
 
 def enabled() -> bool:
@@ -216,6 +228,41 @@ def maybe_stall(epoch: int, batch: int) -> None:
         and cfg.FAULTS.STALL_S > 0
     ):
         time.sleep(float(cfg.FAULTS.STALL_S))
+
+
+def maybe_wedge_dispatch(token: int) -> None:
+    """Hold dispatch token #``FAULTS.WEDGE_DISPATCH`` for ``WEDGE_S``
+    seconds before the dispatch proceeds (the sequencer calls this while
+    HOLDING the token — asyncplane/sequencer.py): a wedged dispatcher
+    thread. Every other stream's acquire blocks behind it, so the wedge
+    watchdog must flag (``kind="dispatch.wedge"``) while the run itself
+    survives and completes once the hold ends. One-shot per process."""
+    if not enabled() or cfg.FAULTS.WEDGE_DISPATCH < 0 or _state["wedged"]:
+        return
+    if int(token) >= int(cfg.FAULTS.WEDGE_DISPATCH) and cfg.FAULTS.WEDGE_S > 0:
+        _state["wedged"] = True
+        time.sleep(float(cfg.FAULTS.WEDGE_S))
+
+
+def maybe_kill_at_commit_barrier(path: str, epoch: int) -> None:
+    """SIGKILL the PRIMARY host inside the multi-host async-commit crash
+    window: every host has arrived at the cross-host commit barrier (all
+    payload bytes durable everywhere), ``MANIFEST.json`` has NOT been
+    written (asyncplane/committer.py places this hook between the
+    barrier completing and the manifest commit). The restart must
+    quarantine the manifest-less directory and walk back to the previous
+    intact save (tools/resilience_drill.py multihost_async_save_kill).
+    Epoch checkpoints only, primary only."""
+    if not enabled() or cfg.FAULTS.KILL_AT_COMMIT_BARRIER < 0:
+        return
+    if not os.path.basename(path).startswith("ckpt_ep_"):
+        return
+    import jax
+
+    if jax.process_index() != 0:
+        return
+    if epoch == int(cfg.FAULTS.KILL_AT_COMMIT_BARRIER):
+        os.kill(os.getpid(), signal.SIGKILL)
 
 
 def maybe_kill_mid_async_save(path: str, epoch: int) -> None:

@@ -1,10 +1,19 @@
-"""Async execution plane (distribuuuu_tpu/asyncplane/, ISSUE 10):
+"""Async execution plane (distribuuuu_tpu/asyncplane/, ISSUEs 10+11):
 committer ordering (manifest strictly last) + join-barrier correctness,
 async-vs-sync checkpoint payload equality, concurrent-eval result parity
 with sync eval, compile-cache hit/miss counters (unit + a real cold/warm
 restart pair), config validation, the new schema kinds, the run_report
 on/off-path checkpoint section, BENCH_r06 indexing — and the hard
 contract: async-everything on ≡ fully-sync run bit-identical.
+
+ISSUE 11 additions: the dispatch sequencer (token FIFO + fence-on-switch
++ wedge watchdog), the cross-host commit barrier protocol (single- and
+2-process), the subprocess-isolated AOT memory probe (byte-identical to
+in-process; coexists with the compile cache), snapshot materialization
+of process-spanning leaves, and the deadlock-regression pins: the
+async-everything trajectory bit-identical to sync at 8 devices (the
+previously-deadlocking configuration) and a real 2-process multi-host
+async commit.
 """
 
 import json
@@ -140,10 +149,16 @@ def test_async_payload_bitwise_equals_sync(tmp_path):
         assert ok, (out, reason)
 
 
-def test_async_multi_host_degrades_to_sync(tmp_path, monkeypatch):
+def test_async_multi_host_gate_lifted_with_sequencer(monkeypatch):
+    """ISSUE 11: multi-host async commit is ON by default (the
+    cross-host barrier handles it); ASYNC.SEQUENCER=False is the
+    explicit escape hatch restoring the PR 10 single-host gate."""
     cfg.CHECKPOINT.ASYNC = True
     monkeypatch.setattr(jax, "process_count", lambda: 2)
-    assert ckpt.async_enabled() is False  # collective saves stay sync
+    assert ckpt.async_enabled() is True  # barrier-backed multi-host
+    cfg.ASYNC.SEQUENCER = False
+    assert ckpt.async_enabled() is False  # the escape hatch
+    cfg.ASYNC.SEQUENCER = True
     monkeypatch.setattr(jax, "process_count", lambda: 1)
     assert ckpt.async_enabled() is True
 
@@ -355,10 +370,314 @@ def test_warm_restart_hits_cache_zero_compiles(tmp_path):
     assert warm.get("jit.cache_hits", 0) >= 2
 
 
+# ----------------------------------------------------- dispatch sequencer
+def test_sequencer_passthrough_when_not_installed():
+    from distribuuuu_tpu.asyncplane import sequencer
+
+    sequencer.shutdown()
+    assert not sequencer.installed()
+    # zero-overhead path: the fn runs directly, fence kwarg ignored
+    assert sequencer.dispatch("train", lambda a, b: a + b, 2, 3,
+                              fence=True) == 5
+
+
+def test_sequencer_token_order_fence_and_stats():
+    """Two streams hammering the ring: every dispatch serialized, token
+    grants strictly FIFO, the stream switches recorded, and the eval
+    stream's per-dispatch fence clears its own fence (train never
+    inherits an eval fence)."""
+    import threading
+
+    import jax.numpy as jnp
+
+    from distribuuuu_tpu.asyncplane import sequencer
+
+    sequencer.shutdown()
+    seq = sequencer.install(wedge_timeout=0.0)
+    active = []  # critical-section occupancy probe
+    overlap = []
+
+    def make(stream, n, fence):
+        def run():
+            for i in range(n):
+                def prog(i=i):
+                    active.append(stream)
+                    if len(active) > 1:
+                        overlap.append(tuple(active))
+                    out = jnp.ones(()) * i
+                    active.remove(stream)
+                    return out
+                sequencer.dispatch(stream, prog, fence=fence)
+        return run
+
+    threads = [
+        threading.Thread(target=make("train", 40, False)),
+        threading.Thread(target=make("eval", 40, True)),
+    ]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=60)
+    assert not any(t.is_alive() for t in threads)
+    assert overlap == []  # token held exclusively for every dispatch
+    st = seq.snapshot_stats()
+    assert st["tokens"] == 80
+    assert st["streams"] == {"train": 40, "eval": 40}
+    assert st["switches"] >= 1  # the streams interleaved at least once
+    assert st["wedges"] == 0
+    sequencer.shutdown()
+
+
+def test_sequencer_wedge_flag_and_record(tmp_path):
+    """A dispatcher that holds the token past the watchdog timeout is
+    flagged — kind=\"dispatch.wedge\" record + counter — while the other
+    stream's dispatch completes once the hold ends (alert, not hang)."""
+    import threading
+    import time as _time
+
+    from distribuuuu_tpu.asyncplane import sequencer
+
+    path = spans.setup_telemetry(str(tmp_path), rank=0)
+    reg = registry_lib.get_registry()
+    reg.reset()
+    sequencer.shutdown()
+    sequencer.install(wedge_timeout=0.2)
+
+    def wedged():
+        _time.sleep(0.9)  # the stuck dispatch, holding the token
+        return 1
+
+    t = threading.Thread(
+        target=lambda: sequencer.dispatch("train", wedged), daemon=True
+    )
+    t.start()
+    _time.sleep(0.1)  # let the wedged stream take the token first
+    out = sequencer.dispatch("eval", lambda: 2)  # blocks behind the wedge
+    t.join(timeout=30)
+    assert out == 2  # the run survived the wedge
+    assert reg.snapshot()["counters"].get("dispatch.wedges", 0) >= 1
+    spans.close_telemetry()
+    recs = [json.loads(ln) for ln in open(path).read().splitlines()]
+    wedge = [r for r in recs if r.get("kind") == "dispatch.wedge"]
+    assert wedge and wedge[0]["holder"] == "train"
+    for r in wedge:
+        schema.validate_record(r)
+    sequencer.shutdown()
+
+
+def test_wedge_fault_injection_sleeps_once(monkeypatch):
+    from distribuuuu_tpu.utils import faults
+
+    config.reset_cfg()
+    cfg.FAULTS.ENABLED = True
+    cfg.FAULTS.WEDGE_DISPATCH = 5
+    cfg.FAULTS.WEDGE_S = 0.05
+    faults.reset()
+    import time as _time
+
+    t0 = _time.perf_counter()
+    faults.maybe_wedge_dispatch(3)  # below the token index: no-op
+    assert _time.perf_counter() - t0 < 0.04
+    t0 = _time.perf_counter()
+    faults.maybe_wedge_dispatch(5)  # wedges once
+    assert _time.perf_counter() - t0 >= 0.05
+    t0 = _time.perf_counter()
+    faults.maybe_wedge_dispatch(6)  # one-shot: never again
+    assert _time.perf_counter() - t0 < 0.04
+    config.reset_cfg()
+    faults.reset()
+
+
+# -------------------------------------------- cross-host commit barrier
+def _barrier_payload(tmp_path, name="ckpt_ep_007"):
+    path = str(tmp_path / "checkpoints" / name)
+    os.makedirs(os.path.dirname(path), exist_ok=True)
+    return path
+
+
+def test_multihost_commit_barrier_protocol(tmp_path):
+    """Both hosts' shares driven in one process (explicit rank/world):
+    the manifest is written strictly AFTER every host arrived, the
+    barrier dir is cleaned up, and both hosts emit ckpt.barrier
+    records."""
+    import threading
+
+    from distribuuuu_tpu.resilience import manifest as manifest_lib
+
+    config.reset_cfg()
+    cfg.OUT_DIR = str(tmp_path)
+    sink = spans.setup_telemetry(str(tmp_path / "telemetry"), rank=0)
+    path = _barrier_payload(tmp_path)
+    payload = {"w": np.arange(4.0)}
+    order = []
+
+    def write_payload():
+        import orbax.checkpoint as ocp
+
+        order.append("payload")
+        ocp.PyTreeCheckpointer().save(path, payload, force=True)
+
+    def write_manifest():
+        # every host must have arrived BEFORE the manifest commits
+        bdir = committer.barrier_dir(path)
+        assert os.path.isfile(os.path.join(bdir, "host0.arrived"))
+        assert os.path.isfile(os.path.join(bdir, "host1.arrived"))
+        order.append("manifest")
+        manifest_lib.write_manifest(path, payload, kind="full", epoch=7)
+
+    peer = threading.Thread(
+        target=committer.multihost_commit,
+        args=(path, None, 7, lambda: None, lambda: None),
+        kwargs={"rank": 1, "world": 2}, daemon=True,
+    )
+    peer.start()
+    committer.multihost_commit(
+        path, payload, 7, write_payload, write_manifest, rank=0, world=2
+    )
+    peer.join(timeout=60)
+    assert not peer.is_alive()
+    assert order == ["payload", "manifest"]  # payload first, marker last
+    ok, reason = manifest_lib.verify_checkpoint(path)
+    assert ok, reason
+    assert not os.path.isdir(committer.barrier_dir(path))  # cleaned up
+    spans.close_telemetry()
+    recs = [json.loads(ln) for ln in open(sink).read().splitlines()]
+    barrier = [r for r in recs if r.get("kind") == "ckpt.barrier"]
+    assert {r["host"] for r in barrier} == {0, 1}
+    for r in barrier:
+        schema.validate_record(r)
+        assert r["hosts"] == 2
+
+
+def test_multihost_barrier_stale_attempt_cannot_satisfy(tmp_path):
+    """A barrier dir left by a killed previous attempt is cleared by the
+    new attempt's open — stale arrivals never satisfy a fresh save."""
+    path = _barrier_payload(tmp_path)
+    bdir = committer.barrier_dir(path)
+    os.makedirs(bdir, exist_ok=True)
+    # stale state from a dead run: OPEN + a peer arrival
+    open(os.path.join(bdir, "OPEN"), "w").write("stale")
+    open(os.path.join(bdir, "host1.arrived"), "w").write("stale")
+    committer.open_barrier(path)
+    assert os.path.isfile(os.path.join(bdir, "OPEN"))
+    assert not os.path.isfile(os.path.join(bdir, "host1.arrived"))
+
+
+def test_multihost_barrier_timeout_is_an_error(tmp_path, monkeypatch):
+    """A peer that never arrives surfaces as TimeoutError (→
+    AsyncCommitError at the join barrier), bounded by
+    ASYNC.BARRIER_TIMEOUT_S — never a silent hang."""
+    config.reset_cfg()
+    cfg.OUT_DIR = str(tmp_path)
+    cfg.ASYNC.BARRIER_TIMEOUT_S = 0.3
+    path = _barrier_payload(tmp_path)
+    with pytest.raises(TimeoutError, match="BARRIER_TIMEOUT"):
+        committer.multihost_commit(
+            path, None, 7, lambda: None, lambda: None, rank=0, world=2
+        )
+    config.reset_cfg()
+
+
+def test_snapshot_tree_materializes_and_refuses():
+    """The multi-host snapshot assembly: replicated shards (same index,
+    many devices) dedup and cover; split shards assemble in place; a
+    cross-host-sharded leaf (local shards cannot cover) refuses with
+    MultiHostSnapshotError — the degrade-to-sync trigger."""
+    import jax.numpy as jnp
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from distribuuuu_tpu.parallel import mesh as mesh_lib
+
+    # the real thing on the live mesh (fully-addressable fast path)
+    mesh = mesh_lib.build_mesh()
+    arr = jax.device_put(
+        jnp.arange(16.0).reshape(4, 4), NamedSharding(mesh, P())
+    )
+    snap = committer.snapshot_tree({"a": arr, "b": 3})
+    np.testing.assert_array_equal(snap["a"], np.arange(16.0).reshape(4, 4))
+    assert snap["b"] == 3
+
+    # replicated process-spanning leaf: every local shard is the full
+    # array under the same index — assembles, covered once
+    full = np.arange(6.0)
+    out = committer._assemble_shards(
+        (6,), np.float32,
+        [((slice(None),), full), ((slice(None),), full)],
+    )
+    np.testing.assert_array_equal(out, full)
+
+    # locally-sharded leaf: disjoint slices assemble in place
+    out = committer._assemble_shards(
+        (4,), np.float32,
+        [((slice(0, 2),), np.array([0.0, 1.0])),
+         ((slice(2, 4),), np.array([2.0, 3.0]))],
+    )
+    np.testing.assert_array_equal(out, np.arange(4.0))
+
+    # cross-host-sharded: local coverage is partial — refuse
+    with pytest.raises(committer.MultiHostSnapshotError, match="2/4"):
+        committer._assemble_shards(
+            (4,), np.float32, [((slice(0, 2),), np.array([0.0, 1.0]))]
+        )
+
+
+# --------------------------------------- subprocess-isolated AOT probe
+def test_memory_probe_subprocess_matches_inprocess():
+    """The isolated AOT probe's memory ledger is byte-identical to the
+    in-process lowered.compile().memory_analysis() — same StableHLO,
+    same SPMD options, a pristine child heap."""
+    import jax.numpy as jnp
+
+    from distribuuuu_tpu.telemetry import costmodel
+
+    @jax.jit
+    def step(x, w):
+        return ((x @ w) ** 2).sum()
+
+    x = jnp.ones((8, 4))
+    w = jnp.ones((4, 4))
+    lowered = step.lower(x, w)
+    inproc = costmodel.normalize_memory(
+        lowered.compile().memory_analysis()
+    )
+    probed = costmodel.probe_memory_subprocess(lowered)
+    assert probed == inproc
+
+
+def test_memory_ledger_coexists_with_compile_cache(tmp_path):
+    """PR 10 caveat #2 deleted: with the persistent compilation cache
+    ACTIVE, the memory half of the ledger still lands (via the
+    subprocess probe) — a run gets the cache AND the HBM ledger."""
+    import jax.numpy as jnp
+
+    from distribuuuu_tpu.telemetry import costmodel
+
+    config.reset_cfg()
+    cfg.COMPILE_CACHE.ENABLED = True
+    cfg.COMPILE_CACHE.DIR = str(tmp_path / "cc")
+    compile_cache.setup_from_cfg(cfg)
+    assert jax.config.jax_compilation_cache_dir  # the hazard is armed
+    try:
+        @jax.jit
+        def step(x):
+            return (x * 2.0).sum()
+
+        analyses = costmodel.analyze_jitted(
+            step, (jnp.ones((16, 16)),), with_memory=True
+        )
+        assert analyses["memory"] is not None
+        assert analyses["memory"]["total_bytes"] > 0
+    finally:
+        config.reset_cfg()
+        compile_cache.setup_from_cfg(cfg)  # clears the process-global dir
+
+
 # ------------------------------------------------- schema / report / index
 def test_new_kinds_declared_and_static_check_clean():
     assert "ckpt.async" in schema.KINDS
     assert "compile.cache" in schema.KINDS
+    for kind in ("dispatch.token", "dispatch.wedge", "ckpt.barrier"):
+        assert kind in schema.KINDS  # ISSUE 11 sequencer/barrier kinds
     import check_telemetry_schema as chk
 
     violations, seen = chk.check_tree(
@@ -366,6 +685,7 @@ def test_new_kinds_declared_and_static_check_clean():
     )
     assert violations == [], violations
     assert "ckpt.async" in seen and "compile.cache" in seen
+    assert {"dispatch.token", "dispatch.wedge", "ckpt.barrier"} <= seen
 
 
 def test_run_report_splits_on_vs_off_path(tmp_path):
@@ -394,6 +714,68 @@ def test_run_report_splits_on_vs_off_path(tmp_path):
         schema.validate_record(r)
 
 
+def test_run_report_sequencer_and_barrier_sections(tmp_path):
+    """run_report surfaces the sequencer's token stats (last
+    dispatch.token record wins) and the per-host commit-barrier waits."""
+    tdir = tmp_path / "telemetry"
+    spans.setup_telemetry(str(tdir), rank=0)
+    spans.emit_span("step", 1.0, 1.1, track="pipeline", phase="train",
+                    epoch=1, batch=0, n=8)
+    spans.emit_event("dispatch.token", tokens=10, streams={"train": 9},
+                     max_wait_s=0.01, total_wait_s=0.02, fence_waits=1,
+                     fence_wait_s=0.005, max_fence_wait_s=0.005,
+                     switches=2, wedges=0)
+    spans.emit_event("dispatch.token", tokens=40,
+                     streams={"train": 30, "eval": 10},
+                     max_wait_s=0.02, total_wait_s=0.09, fence_waits=4,
+                     fence_wait_s=0.03, max_fence_wait_s=0.01,
+                     switches=8, wedges=0)
+    spans.emit_event("ckpt.barrier", ckpt="ckpt_ep_000", host=0, hosts=2,
+                     wait_s=0.12)
+    spans.emit_event("ckpt.barrier", ckpt="ckpt_ep_000", host=1, hosts=2,
+                     wait_s=0.34)
+    spans.close_telemetry()
+    rep = run_report.build_report(str(tmp_path))
+    seq = rep["sequencer"]
+    assert seq["tokens"] == 40  # the LAST record's running aggregate
+    assert seq["streams"] == {"train": 30, "eval": 10}
+    assert seq["max_wait_s"] == pytest.approx(0.02)
+    assert seq["fence_waits"] == 4
+    barrier = rep["checkpoint"]["barrier"]
+    assert barrier["hosts"] == 2
+    assert barrier["per_host"]["1"]["max_wait_s"] == pytest.approx(0.34)
+
+
+def test_dispatch_wedge_rule_fires_and_dedups():
+    """The monitor's dispatch-wedge rule: aggregator counts
+    dispatch.wedge records into the snapshot, the rule fires on the
+    first one, dedups while active, and the shipped rules file declares
+    it (the RULE_KINDS pin in test_monitor covers the full set)."""
+    from distribuuuu_tpu.telemetry import live
+
+    agg = live.LiveAggregator()
+    agg.consume([{"kind": "dispatch.wedge", "age_s": 1.2,
+                  "holder": "train", "count": 1, "rank": 0}])
+    snap = agg.snapshot(window_s=5.0)
+    assert snap["dispatch_wedges"] == 1
+    engine = live.RuleEngine(
+        [live.AlertRule({"kind": "dispatch-wedge", "threshold": 1})],
+        interval_s=5.0,
+    )
+    fired = engine.evaluate(snap)
+    assert [f["rule"] for f in fired] == ["dispatch-wedge"]
+    # active alert dedups on the next breached window
+    agg.consume([{"kind": "dispatch.wedge", "age_s": 2.0,
+                  "holder": "eval", "count": 2, "rank": 0}])
+    assert engine.evaluate(agg.snapshot(window_s=5.0)) == []
+    # wedge-free windows: value 0, rule calm
+    assert engine.evaluate(agg.snapshot(window_s=5.0)) == []
+    rules = live.load_rules(
+        os.path.join(REPO, "config", "monitor_rules.yaml")
+    )
+    assert "dispatch-wedge" in {r.kind for r in rules}
+
+
 def test_bench_index_carries_asyncplane_series():
     """BENCH_r06.json indexed (regeneration pin: tests/test_monitor.py
     asserts committed == rebuilt; here the asyncplane series exist and
@@ -413,6 +795,11 @@ def test_bench_index_carries_asyncplane_series():
     cold = series["cold_start_compiles"][-1]["value"]
     assert warm <= max(2.0, 0.1 * cold)
     assert series["warm_restart_cache_hits"][-1]["value"] >= 2
+    # r07: the sequencer overhead series (concurrent eval at 8 devices
+    # — the previously-deadlocking config — completed and was measured)
+    assert series["sequencer_tokens_issued"][-1]["value"] > 0
+    assert "sequencer_trainer_blocked_s" in series
+    assert "sequencer_token_max_wait_s" in series
     # none of the new series can poison the throughput gate
     mapped = run_report.comparable_metrics(
         json.load(open(os.path.join(REPO, "BENCH_INDEX.json")))
@@ -425,7 +812,15 @@ def test_bench_index_carries_asyncplane_series():
 _PIN_SCRIPT = """
 import os, sys, json
 os.environ["JAX_PLATFORMS"] = "cpu"
-os.environ.pop("XLA_FLAGS", None)  # ONE device: concurrent eval must run
+ndev = int(sys.argv[4])
+if ndev <= 1:
+    os.environ.pop("XLA_FLAGS", None)  # ONE device
+else:
+    # the multi-device mesh — the configuration whose concurrent eval
+    # DEADLOCKED before the dispatch sequencer (ISSUE 11)
+    os.environ["XLA_FLAGS"] = (
+        "--xla_force_host_platform_device_count=%d" % ndev
+    )
 import jax
 jax.config.update("jax_platforms", "cpu")
 import distribuuuu_tpu.config as config
@@ -455,20 +850,14 @@ if mode == "async":
     cfg.COMPILE_CACHE.ENABLED = True
     cfg.COMPILE_CACHE.DIR = cc_dir
 best = trainer.train_model()
-assert jax.device_count() == 1
+assert jax.device_count() == ndev
 print(f"PIN_DONE best={best}", flush=True)
 """
 
 
-def test_async_everything_trajectory_bit_identical(tmp_path):
-    """ISSUE 10 hard contract, same style as the PR 7 monitor pin: a run
-    with background checkpoint commit + concurrent eval + persistent
-    compile cache all ON produces BIT-IDENTICAL checkpoint state trees
-    and eval metrics as the fully synchronous run. Fresh single-device
-    subprocesses: concurrent eval is gated to one device (two
-    multi-device programs dispatched from two threads can deadlock
-    their collectives), so the 8-virtual-device test mesh would
-    silently degrade it — a real 1-device run is the only honest pin."""
+def _run_pin_pair(tmp_path, ndev: int):
+    """Run the async-everything vs fully-sync pin pair at ``ndev``
+    virtual devices; returns ((out_dir, evals), (out_dir, evals))."""
     script = tmp_path / "pin.py"
     script.write_text(_PIN_SCRIPT)
     env = {**os.environ, "PYTHONPATH": REPO + os.pathsep
@@ -478,12 +867,16 @@ def test_async_everything_trajectory_bit_identical(tmp_path):
         out_dir = str(tmp_path / mode)
         proc = subprocess.run(
             [sys.executable, str(script), out_dir, mode,
-             str(tmp_path / "cc")],
+             str(tmp_path / "cc"), str(ndev)],
             capture_output=True, text=True, env=env, cwd=REPO, timeout=540,
         )
         assert proc.returncode == 0, proc.stdout[-3000:] + proc.stderr[-2000:]
         if mode == "async":  # the overlapped paths genuinely engaged
-            assert "concurrent eval: validate() overlaps" in proc.stderr                 or "concurrent eval: validate() overlaps" in proc.stdout
+            assert "concurrent eval: validate() overlaps" in proc.stderr \
+                or "concurrent eval: validate() overlaps" in proc.stdout
+            if ndev > 1:  # ...under the sequencer, not a silent degrade
+                assert "dispatch sequencer active" in proc.stderr \
+                    or "dispatch sequencer active" in proc.stdout
         evals = [
             (r["epoch"], r["loss"], r["top1"], r["topk"], r["samples"])
             for r in (json.loads(ln)
@@ -492,8 +885,10 @@ def test_async_everything_trajectory_bit_identical(tmp_path):
         ]
         return out_dir, evals
 
-    out_async, ev_async = run("async")
-    out_sync, ev_sync = run("sync")
+    return run("async"), run("sync")
+
+
+def _assert_pin_pair_identical(out_async, ev_async, out_sync, ev_sync):
     assert len(ev_async) == 2 and ev_async == ev_sync  # per-epoch metrics
     for name in ("ckpt_ep_000", "ckpt_ep_001", "best"):
         a = ckpt.load_checkpoint(os.path.join(out_async, "checkpoints", name))
@@ -511,3 +906,123 @@ def test_async_everything_trajectory_bit_identical(tmp_path):
                 np.asarray(va), np.asarray(vb),
                 err_msg=f"{name}:{jax.tree_util.keystr(key)}",
             )
+
+
+def test_async_everything_trajectory_bit_identical(tmp_path):
+    """ISSUE 10 hard contract, same style as the PR 7 monitor pin: a run
+    with background checkpoint commit + concurrent eval + persistent
+    compile cache all ON produces BIT-IDENTICAL checkpoint state trees
+    and eval metrics as the fully synchronous run, on one device."""
+    (out_async, ev_async), (out_sync, ev_sync) = _run_pin_pair(tmp_path, 1)
+    _assert_pin_pair_identical(out_async, ev_async, out_sync, ev_sync)
+
+
+def test_async_everything_multidevice_bit_identical(tmp_path):
+    """ISSUE 11 acceptance: the previously-DEADLOCKING configuration —
+    concurrent eval + async save + compile cache on the 8-virtual-device
+    CPU mesh — completes under the dispatch sequencer (bounded by the
+    subprocess timeout: a regression deadlocks and fails the bound) and
+    is bit-identical to the fully synchronous 8-device run."""
+    (out_async, ev_async), (out_sync, ev_sync) = _run_pin_pair(tmp_path, 8)
+    _assert_pin_pair_identical(out_async, ev_async, out_sync, ev_sync)
+
+
+_MH_SCRIPT = """
+import os, sys
+os.environ["JAX_PLATFORMS"] = "cpu"
+os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=2"
+import jax
+jax.config.update("jax_platforms", "cpu")
+import distribuuuu_tpu.config as config
+from distribuuuu_tpu.config import cfg
+from distribuuuu_tpu import trainer
+
+config.reset_cfg()
+cfg.MODEL.ARCH = "resnet18"
+cfg.MODEL.NUM_CLASSES = 10
+cfg.MODEL.DUMMY_INPUT = True
+cfg.DEVICE.COMPUTE_DTYPE = "float32"
+cfg.TRAIN.BATCH_SIZE = 2
+cfg.TRAIN.IM_SIZE = 16
+cfg.TRAIN.PRINT_FREQ = 32
+cfg.TEST.BATCH_SIZE = 16
+cfg.TEST.IM_SIZE = 16
+cfg.OPTIM.MAX_EPOCH = 1
+cfg.RNG_SEED = 0
+cfg.OUT_DIR = sys.argv[1]
+cfg.CHECKPOINT.ASYNC = True
+best = trainer.train_model()
+print(f"MH_PIN_DONE rank={jax.process_index()} best={best}", flush=True)
+"""
+
+
+def test_multihost_async_commit_two_processes(tmp_path):
+    """ISSUE 11 acceptance, the multi-host half: a REAL 2-process run
+    with CHECKPOINT.ASYNC commits its checkpoints through the
+    cross-host barrier — both hosts complete, every save has a durable
+    manifest, the barrier dirs are cleaned up, and each host left its
+    ckpt.barrier telemetry record."""
+    import socket
+
+    from distribuuuu_tpu.resilience import manifest as manifest_lib
+
+    script = tmp_path / "mh.py"
+    script.write_text(_MH_SCRIPT)
+    out = str(tmp_path / "out")
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        port = s.getsockname()[1]
+    procs, logs = [], []
+    for rank in range(2):
+        env = dict(os.environ)
+        env.pop("JAX_PLATFORMS", None)
+        env.update(
+            MASTER_ADDR="127.0.0.1", COORDINATOR_PORT=str(port),
+            WORLD_SIZE="2", RANK=str(rank),
+            PYTHONPATH=REPO + os.pathsep + os.environ.get("PYTHONPATH", ""),
+        )
+        log = open(tmp_path / f"mh{rank}.log", "w+")
+        logs.append(log)
+        procs.append(subprocess.Popen(
+            [sys.executable, str(script), out], env=env, cwd=REPO,
+            stdout=log, stderr=subprocess.STDOUT, text=True,
+        ))
+    outs = []
+    for p, log in zip(procs, logs):
+        try:
+            p.wait(timeout=420)
+        except subprocess.TimeoutExpired:
+            p.kill()
+            p.wait()
+        log.seek(0)
+        outs.append(log.read())
+        log.close()
+    assert [p.returncode for p in procs] == [0, 0], outs[0][-3000:]
+    assert all("MH_PIN_DONE" in o for o in outs)
+    # every committed save verifies; no barrier litter left behind
+    ckpt_dir = os.path.join(out, "checkpoints")
+    names = sorted(os.listdir(ckpt_dir))
+    assert "ckpt_ep_000" in names
+    assert not any(n.endswith(".barrier") for n in names)
+    for name in names:
+        if name.startswith("."):
+            continue
+        ok, reason = manifest_lib.verify_checkpoint(
+            os.path.join(ckpt_dir, name)
+        )
+        assert ok, (name, reason)
+    # each host recorded its barrier wait
+    barrier_hosts = set()
+    tdir = os.path.join(out, "telemetry")
+    for fname in os.listdir(tdir):
+        if not fname.endswith(".jsonl"):
+            continue
+        for ln in open(os.path.join(tdir, fname)):
+            try:
+                r = json.loads(ln)
+            except json.JSONDecodeError:
+                continue
+            if r.get("kind") == "ckpt.barrier":
+                schema.validate_record(r)
+                barrier_hosts.add(r["host"])
+    assert barrier_hosts == {0, 1}

@@ -1,9 +1,10 @@
-"""Async-plane proof bench: measure what ISSUE 10 claims, commit it as
-``BENCH_r06.json``.
+"""Async-plane proof bench: measure what ISSUEs 10 and 11 claim —
+``BENCH_r06.json`` (ckpt split + compile cache) and ``BENCH_r07.json``
+(``--sequencer``: dispatch-sequencer overhead at 8 devices).
 
-Two measurements, both against the REAL trainer in fresh interpreters
-(the compile cache and the committer are process-lifetime state — only a
-genuine restart proves a warm restart):
+Measurements, all against the REAL trainer in fresh interpreters
+(the compile cache, the committer, and the sequencer are
+process-lifetime state — only a genuine restart proves a restart):
 
 1. **Checkpoint stall split.** The same short run twice — synchronous
    saves vs ``CHECKPOINT.ASYNC`` — and from each run's telemetry the
@@ -18,13 +19,24 @@ genuine restart proves a warm restart):
    near zero with ``jit.cache_hits`` ≈ the cold compile count — the
    compile storm PR 5's counter made visible, gone.
 
+3. **Sequencer overhead** (``--sequencer`` → BENCH_r07.json, ISSUE 11).
+   On the 8-virtual-device mesh — the configuration whose concurrent
+   eval DEADLOCKED before the dispatch sequencer — run sync eval vs
+   concurrent eval under the sequencer and read the ``dispatch.token``
+   stats: tokens issued per stream, max/total token-acquire wait (the
+   trainer-blocked time the ring adds), and fence waits. The acceptance
+   shape is the concurrent run COMPLETING at all (it used to hang),
+   with token waits a small fraction of the wall.
+
 Output rides the BENCH_r*.json naming so ``tools/bench_history.py``
 folds it into BENCH_INDEX.json (series ``ckpt_trainer_blocked_s_*``,
-``warm_restart_compiles``, ...) — deliberately WITHOUT a ``parsed``
-img/s block: CPU-container seconds must never become the throughput
-reference run_report gates against.
+``warm_restart_compiles``, ``sequencer_*``, ...) — deliberately WITHOUT
+a ``parsed`` img/s block: CPU-container seconds must never become the
+throughput reference run_report gates against.
 
     JAX_PLATFORMS=cpu python tools/asyncplane_bench.py --out BENCH_r06.json
+    JAX_PLATFORMS=cpu python tools/asyncplane_bench.py --sequencer \\
+        --out BENCH_r07.json
 """
 
 from __future__ import annotations
@@ -73,12 +85,18 @@ print(f"BENCH_RUN_DONE best={best:.3f}", flush=True)
 """
 
 
-def _run(work: str, out_dir: str, overrides=(), tag="run", timeout=1800):
+def _run(work: str, out_dir: str, overrides=(), tag="run", timeout=1800,
+         ndev: int | None = None):
     script = os.path.join(work, "worker.py")
     with open(script, "w") as f:
         f.write(WORKER)
     env = dict(os.environ)
     env["PYTHONPATH"] = ROOT + os.pathsep + env.get("PYTHONPATH", "")
+    if ndev:
+        env["XLA_FLAGS"] = (
+            env.get("XLA_FLAGS", "")
+            + f" --xla_force_host_platform_device_count={ndev}"
+        ).strip()
     t0 = time.time()
     proc = subprocess.run(
         [sys.executable, script, out_dir, *map(str, overrides)],
@@ -187,13 +205,100 @@ def bench_compile_cache(work: str) -> dict:
     }
 
 
+def _last_record(recs: list[dict], kind: str) -> dict | None:
+    out = None
+    for r in recs:
+        if r.get("kind") == kind:
+            out = r
+    return out
+
+
+def bench_sequencer(work: str, ndev: int = 8) -> dict:
+    """Sync-eval vs concurrent-eval-under-the-sequencer on the
+    multi-device mesh that used to deadlock (ISSUE 11). Reads the
+    ``dispatch.token`` stats from the concurrent run's telemetry."""
+    rows = {}
+    for mode, overrides in (
+        ("sync_eval", ()),
+        ("concurrent", ("TRAIN.CONCURRENT_EVAL", "True",
+                        "CHECKPOINT.ASYNC", "True")),
+    ):
+        out = os.path.join(work, f"seq_{mode}")
+        wall = _run(work, out, overrides, tag=f"seq_{mode}", ndev=ndev)
+        recs = _telemetry_records(out)
+        steps = _span_durs(recs, "step")
+        rows[mode] = {
+            "wall_s": wall,
+            "steps": len(steps),
+            "step_total_s": round(sum(steps), 4),
+        }
+    out = os.path.join(work, "seq_concurrent")
+    recs = _telemetry_records(out)
+    tok = _last_record(recs, "dispatch.token") or {}
+    conc, sync = rows["concurrent"], rows["sync_eval"]
+    return {
+        "devices": ndev,
+        "runs": rows,
+        # the headline: the previously-deadlocking configuration finished
+        "concurrent_completed": True,
+        "tokens": tok.get("tokens"),
+        "tokens_per_stream": tok.get("streams"),
+        "token_max_wait_s": tok.get("max_wait_s"),
+        # trainer-blocked time the ring adds: every token wait, summed
+        # (train-stream dispatches never fence — eval absorbs its own)
+        "token_total_wait_s": tok.get("total_wait_s"),
+        "fence_waits": tok.get("fence_waits"),
+        "fence_wait_s": tok.get("fence_wait_s"),
+        "wall_overhead_x": round(conc["wall_s"] / max(sync["wall_s"], 1e-9), 3),
+    }
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
     ap.add_argument("--out", default="BENCH_r06.json")
     ap.add_argument("--work-dir", default=None)
+    ap.add_argument("--sequencer", action="store_true",
+                    help="measure the dispatch-sequencer overhead at 8 "
+                         "virtual devices instead of the r06 pair "
+                         "(writes the BENCH_r07 shape)")
+    ap.add_argument("--ndev", type=int, default=8,
+                    help="virtual device count for --sequencer")
     args = ap.parse_args(argv)
     work = args.work_dir or tempfile.mkdtemp(prefix="asyncplane_bench_")
     os.makedirs(work, exist_ok=True)
+
+    if args.sequencer:
+        print(f"[asyncplane_bench] dispatch sequencer overhead at "
+              f"{args.ndev} devices (sync eval vs concurrent)...",
+              flush=True)
+        seq = bench_sequencer(work, ndev=args.ndev)
+        print(
+            f"  concurrent eval COMPLETED on {seq['devices']} devices "
+            f"(previously deadlocked): {seq['tokens']} tokens, max "
+            f"token-wait {seq['token_max_wait_s']}s, total "
+            f"{seq['token_total_wait_s']}s trainer-blocked; "
+            f"{seq['fence_waits']} fence waits "
+            f"({seq['fence_wait_s']}s); wall x{seq['wall_overhead_x']} "
+            "vs sync eval", flush=True,
+        )
+        report = {
+            "schema": 1,
+            "generated_by": "tools/asyncplane_bench.py --sequencer",
+            "platform": "cpu",
+            "note": (
+                "CPU container numbers on the 8-virtual-device mesh (1 "
+                "physical core - device compute time-shares). The claim "
+                "is the SHAPE: the previously-deadlocking concurrent-"
+                "eval configuration completes under the sequencer with "
+                "token waits a small fraction of wall. No `parsed` "
+                "img/s block by design."
+            ),
+            "asyncplane": {"sequencer": seq},
+        }
+        with open(args.out, "w") as f:
+            json.dump(report, f, indent=1)
+        print(f"wrote {args.out}")
+        return 0
 
     print("[asyncplane_bench] checkpoint stall split (sync vs async)...",
           flush=True)

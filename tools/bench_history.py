@@ -3,7 +3,8 @@ trajectory: ``BENCH_INDEX.json``.
 
 Rounds of ``BENCH_r*.json`` (single-chip training throughput; r06 adds
 the ``asyncplane`` section — checkpoint stall seconds + warm-restart
-compile counts, tools/asyncplane_bench.py), ``BENCH_serve.json``
+compile counts — and r07 its ``sequencer`` overhead numbers,
+tools/asyncplane_bench.py), ``BENCH_serve.json``
 (serving latency/throughput frontier + fleet scaling), and
 ``COSTMODEL_r*.json`` (the XLA cost-model ledger: measured MFU + HBM
 headroom, tools/costmodel_report.py) each have their own ad-hoc shape;
@@ -75,6 +76,17 @@ def index_asyncplane(path: str, doc: dict, series: dict) -> None:
            cc.get("warm_compiles"))
     _point(series, "warm_restart_cache_hits", rnd, src,
            cc.get("warm_cache_hits"))
+    # r07+ dispatch-sequencer overhead (asyncplane_bench --sequencer):
+    # token/fence waits of the concurrent-eval-at-8-devices run — again
+    # named so nothing matches the throughput-gate patterns
+    seq = ap.get("sequencer") or {}
+    _point(series, "sequencer_tokens_issued", rnd, src, seq.get("tokens"))
+    _point(series, "sequencer_token_max_wait_s", rnd, src,
+           seq.get("token_max_wait_s"), "s")
+    _point(series, "sequencer_trainer_blocked_s", rnd, src,
+           seq.get("token_total_wait_s"), "s")
+    _point(series, "sequencer_fence_wait_s", rnd, src,
+           seq.get("fence_wait_s"), "s")
 
 
 def index_train_bench(path: str, series: dict) -> None:

@@ -37,6 +37,17 @@ way an operator would verify a production incident:
                         boundary commit becomes durable inside the grace
                         window), then commits synchronously; the restart
                         resumes from the preempt checkpoint
+  dispatch_wedge        concurrent eval + async save on a 2-virtual-
+                        device mesh (the dispatch sequencer active);
+                        FAULTS.WEDGE_DISPATCH holds a dispatch token
+                        1.2 s past TRAIN.STALL_TIMEOUT → the wedge
+                        watchdog flags (kind="dispatch.wedge") and the
+                        run completes — a stall alert, not a hang
+  multihost_async_kill  2-process CHECKPOINT.ASYNC run committing via
+                        the cross-host barrier; the PRIMARY is SIGKILLed
+                        between barrier completion and the manifest
+                        commit → the group restart quarantines the
+                        manifest-less dir and walks back to ckpt_ep_000
   shards_midepoch       real shard corpus (DATA.FORMAT=shards): the
                         scheduler preempts (SIGTERM) mid-epoch-1 and the
                         process is SIGKILLed right after the preempt
@@ -411,6 +422,151 @@ def drill_async_save_then_preempt(work):
     return all(checks.values()), checks
 
 
+@_drill("dispatch_wedge_recovery")
+def drill_dispatch_wedge_recovery(work):
+    """A wedged dispatcher under the sequencer (ISSUE 11): concurrent
+    eval + async save run on a 2-virtual-device mesh (the sequencer is
+    active), and FAULTS.WEDGE_DISPATCH holds a dispatch token for 1.2 s
+    — well past TRAIN.STALL_TIMEOUT=0.4. The wedge watchdog must flag
+    (kind="dispatch.wedge" + the log line) while the run itself
+    completes once the hold ends: a stall alert instead of a hang."""
+    import json as _json
+
+    out = os.path.join(work, "out")
+    rc, log = _run_worker(
+        work, out,
+        # token ~20 lands just after the epoch-0→1 boundary, where the
+        # concurrent-eval worker (launched at the boundary) and the
+        # epoch-1 train loop are both actively dispatching — whichever
+        # stream wedges, the other's blocked acquire trips the watchdog
+        ("OPTIM.MAX_EPOCH", 2, "TRAIN.CONCURRENT_EVAL", "True",
+         "CHECKPOINT.ASYNC", "True", "TRAIN.STALL_TIMEOUT", 0.4,
+         "FAULTS.ENABLED", "True", "FAULTS.WEDGE_DISPATCH", 20,
+         "FAULTS.WEDGE_S", 1.5),
+        tag="wedge", env_extra={"DTPU_DRILL_NDEV": "2"},
+    )
+    wedge_records = 0
+    tdir = os.path.join(out, "telemetry")
+    if os.path.isdir(tdir):
+        for name in os.listdir(tdir):
+            if not name.endswith(".jsonl"):
+                continue
+            for line in open(os.path.join(tdir, name)):
+                try:
+                    if _json.loads(line).get("kind") == "dispatch.wedge":
+                        wedge_records += 1
+                except _json.JSONDecodeError:
+                    pass
+    checks = {
+        "rc==0": rc == 0,
+        "sequencer_active": "dispatch sequencer active" in log,
+        "wedge_flagged": "dispatch token wedged" in log,
+        "wedge_record_emitted": wedge_records >= 1,
+        "completed": "DRILL_DONE" in log,
+        "both_epochs_saved": {"ckpt_ep_000", "ckpt_ep_001"}
+        <= set(_ckpts(out)),
+    }
+    return all(checks.values()), checks
+
+
+@_drill("multihost_async_save_kill")
+def drill_multihost_async_save_kill(work):
+    """The multi-host async-commit crash window (ISSUE 11): a 2-process
+    run with CHECKPOINT.ASYNC commits through the cross-host barrier;
+    FAULTS.KILL_AT_COMMIT_BARRIER SIGKILLs the PRIMARY between barrier
+    completion (every host's payload durable) and the manifest commit.
+    The group restart must quarantine the manifest-less ckpt_ep_001
+    ("no committed manifest"), walk back to the intact ckpt_ep_000,
+    re-train epoch 1, and complete — async commit on, again."""
+    out = os.path.join(work, "out")
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        port = s.getsockname()[1]
+    script = os.path.join(work, "worker.py")
+    with open(script, "w") as f:
+        f.write(WORKER)
+
+    def spawn(overrides, tag):
+        procs, logs = [], []
+        for rank in range(2):
+            env = dict(os.environ)
+            env.pop("JAX_PLATFORMS", None)
+            env.update(
+                MASTER_ADDR="127.0.0.1", COORDINATOR_PORT=str(port),
+                WORLD_SIZE="2", RANK=str(rank), DTPU_DRILL_NDEV="2",
+                PYTHONPATH=ROOT + os.pathsep
+                + os.environ.get("PYTHONPATH", ""),
+            )
+            log = open(os.path.join(work, f"{tag}{rank}.log"), "w+")
+            logs.append(log)
+            procs.append(subprocess.Popen(
+                [sys.executable, script, out, *map(str, overrides)],
+                env=env, cwd=ROOT, stdout=log, stderr=subprocess.STDOUT,
+                text=True,
+            ))
+        return procs, logs
+
+    kill_over = ("OPTIM.MAX_EPOCH", 2, "CHECKPOINT.ASYNC", "True",
+                 # a short barrier timeout so the surviving peer's
+                 # manifest wait fails fast instead of idling 600 s
+                 "ASYNC.BARRIER_TIMEOUT_S", 20,
+                 "FAULTS.ENABLED", "True",
+                 "FAULTS.KILL_AT_COMMIT_BARRIER", 1)
+    procs, logs = spawn(kill_over, "kill")
+    try:
+        procs[0].wait(timeout=1800)  # the primary SIGKILLs itself
+    except subprocess.TimeoutExpired:
+        procs[0].kill()
+    deadline = time.time() + 120
+    while time.time() < deadline and procs[1].poll() is None:
+        time.sleep(1.0)
+    if procs[1].poll() is None:  # wedged with a dead peer: reap it
+        procs[1].kill()
+        procs[1].wait(timeout=60)
+    for log in logs:
+        log.close()
+    names = _ckpts(out)
+    checks = {
+        "primary_sigkilled": procs[0].returncode == -signal.SIGKILL,
+        "epoch0_committed": os.path.isfile(
+            os.path.join(out, "checkpoints", "ckpt_ep_000", "MANIFEST.json")
+        ),
+        # the crash window: payload on disk everywhere, manifest NOT
+        "payload_written_no_manifest": "ckpt_ep_001" in names
+        and not os.path.isfile(
+            os.path.join(out, "checkpoints", "ckpt_ep_001", "MANIFEST.json")
+        ),
+    }
+    if not all(checks.values()):
+        return False, checks
+
+    procs, logs = spawn(
+        ("OPTIM.MAX_EPOCH", 2, "CHECKPOINT.ASYNC", "True"), "recover"
+    )
+    outs = []
+    for p, log in zip(procs, logs):
+        try:
+            p.wait(timeout=1800)
+        except subprocess.TimeoutExpired:
+            p.kill()
+            p.wait()
+        log.seek(0)
+        outs.append(log.read())
+        log.close()
+    names = _ckpts(out)
+    checks.update({
+        "recover_rc==0": all(p.returncode == 0 for p in procs),
+        "quarantined_as_uncommitted": "no committed manifest" in outs[0]
+        and any(n.startswith("ckpt_ep_001.corrupt") for n in names),
+        "walked_back": "resumed from" in outs[0] and "ckpt_ep_000" in outs[0],
+        "epoch1_retrained": "ckpt_ep_001" in names
+        and os.path.isfile(os.path.join(
+            out, "checkpoints", "ckpt_ep_001", "MANIFEST.json")),
+        "completed": all("DRILL_DONE" in o for o in outs),
+    })
+    return all(checks.values()), checks
+
+
 @_drill("stall_watchdog")
 def drill_stall_watchdog(work):
     out = os.path.join(work, "out")
@@ -761,12 +917,13 @@ def main():
         drill_nan_skip, drill_nan_rollback,
         drill_decode_error_retry, drill_decode_error_skip,
         drill_killed_mid_async_save, drill_async_save_then_preempt,
+        drill_dispatch_wedge_recovery,
         drill_stall_watchdog, drill_partition_elastic,
         drill_shards_midepoch_resume,
         drill_fleet_replica_kill,
     ]
     if not args.skip_multiprocess:
-        drills.append(drill_killed_rank)
+        drills += [drill_killed_rank, drill_multihost_async_save_kill]
     if args.only:
         keep = set(args.only.split(","))
         drills = [d for d in drills if d._drill_name in keep]

@@ -260,6 +260,46 @@ def build_report(run_dir: str, phase: str = "train") -> dict:
         if fracs:
             data_wait_frac = round(sum(fracs) / len(fracs), 4)
 
+    # -- dispatch sequencer (asyncplane/sequencer.py) --------------------
+    # running aggregates: the LAST dispatch.token record per rank wins;
+    # dispatch.wedge flags are counted outright
+    seq_last: dict[int, dict] = {}
+    wedges = 0
+    barrier_waits: dict[str, list[float]] = {}
+    for rank, recs in sorted(ranks.items()):
+        for r in recs:
+            kind = r.get("kind")
+            if kind == "dispatch.token":
+                seq_last[rank] = r
+            elif kind == "dispatch.wedge":
+                wedges += 1
+            elif kind == "ckpt.barrier":
+                barrier_waits.setdefault(
+                    str(r.get("host", rank)), []
+                ).append(float(r.get("wait_s", 0.0)))
+    sequencer = None
+    if seq_last:
+        sequencer = {
+            "tokens": sum(int(s.get("tokens", 0)) for s in seq_last.values()),
+            "streams": {
+                k: v for s in seq_last.values()
+                for k, v in (s.get("streams") or {}).items()
+            },
+            "max_wait_s": max(
+                float(s.get("max_wait_s", 0.0)) for s in seq_last.values()
+            ),
+            "total_wait_s": round(sum(
+                float(s.get("total_wait_s", 0.0)) for s in seq_last.values()
+            ), 6),
+            "fence_waits": sum(
+                int(s.get("fence_waits", 0)) for s in seq_last.values()
+            ),
+            "fence_wait_s": round(sum(
+                float(s.get("fence_wait_s", 0.0)) for s in seq_last.values()
+            ), 6),
+            "wedges": wedges,
+        }
+
     # -- recompiles / checkpoints / resilience events --------------------
     compiles = {"count": 0, "wall_s": 0.0}
     cache = {"hits": 0, "misses": 0}
@@ -304,6 +344,20 @@ def build_report(run_dir: str, phase: str = "train") -> dict:
                     commit_max_s=round(max(commits), 6))
     ckpt["on_path_s"] = round(sum(saves) + sum(snaps), 6)
     ckpt["off_path_s"] = round(sum(commits), 6)
+    # multi-host async commit: the cross-host barrier wait per host
+    # (ckpt.barrier records — asyncplane/committer.py multihost_commit)
+    if barrier_waits:
+        ckpt["barrier"] = {
+            "hosts": len(barrier_waits),
+            "per_host": {
+                host: {
+                    "saves": len(ws),
+                    "mean_wait_s": round(sum(ws) / len(ws), 6),
+                    "max_wait_s": round(max(ws), 6),
+                }
+                for host, ws in sorted(barrier_waits.items())
+            },
+        }
 
     step_summary = _summary_ms(pooled)
     mean_step_s = (
@@ -325,6 +379,7 @@ def build_report(run_dir: str, phase: str = "train") -> dict:
         "recompiles": compiles,
         "compile_cache": cache if (cache["hits"] or cache["misses"]) else None,
         "checkpoint": ckpt,
+        "sequencer": sequencer,
     }
     return report
 
@@ -498,6 +553,23 @@ def _print_report(rep: dict) -> None:
               f"{ck['snapshot_mean_s']}s) vs {off}s committed in the "
               f"background ({ck['commits']} commits, mean "
               f"{ck['commit_mean_s']}s)")
+    barrier = ck.get("barrier")
+    if barrier:
+        print(f"  cross-host commit barrier ({barrier['hosts']} host(s)):")
+        for host, row in barrier["per_host"].items():
+            print(f"    host {host}: {row['saves']} save(s), barrier "
+                  f"wait mean {row['mean_wait_s']}s max {row['max_wait_s']}s")
+    seq = rep.get("sequencer")
+    if seq:
+        streams = ", ".join(
+            f"{k}={v}" for k, v in sorted(seq["streams"].items())
+        )
+        print(f"dispatch sequencer: {seq['tokens']} tokens ({streams}), "
+              f"max token-wait {seq['max_wait_s']}s (total "
+              f"{seq['total_wait_s']}s), {seq['fence_waits']} fence "
+              f"wait(s) ({seq['fence_wait_s']}s)"
+              + (f", {seq['wedges']} WEDGE flag(s)" if seq["wedges"]
+                 else ""))
 
 
 def _print_compare(cmp: dict, baseline_path: str) -> None:
