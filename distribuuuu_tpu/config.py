@@ -341,6 +341,48 @@ _C.OPTIM.MIN_LR = 0.0
 # (fp32 master params + half-traffic momentum; utils/optim.py)
 _C.OPTIM.MOMENTUM_DTYPE = "float32"
 
+# ------------------------------- language model -----------------------------
+# Decoder-only LM workload plane (distribuuuu_tpu/lm/, models/gpt.py —
+# ISSUE 12). The gpt_* archs train through the SAME trainer/partition
+# lowering the image zoo uses: batches are {"image": tokens [B, S] int32,
+# "label": next-tokens [B, S] int32, "mask": [B]} from token shards
+# (DATA.FORMAT=tokens), the loss is the same cross-entropy — computed per
+# token — and placement comes from the LM SpecTable rules
+# (parallel/partition/specs.LM_TABLE).
+_C.LM = CfgNode()
+# Trained context length. Token shards must be packed with
+# ``--pack-len SEQ_LEN`` (each record holds SEQ_LEN+1 tokens: input =
+# [:-1], next-token targets = [1:]); a mismatch is refused at loader
+# construction with the repack command. Also the learned-position table
+# size, so generation prompts + new tokens must fit under it.
+_C.LM.SEQ_LEN = 256
+# -------------------------------- generation --------------------------------
+# Autoregressive serving (lm/generate.py): paged per-request KV cache,
+# prefill/decode split, continuous batching. The serve engine's AOT-bucket
+# idea generalizes to (batch, cache-len) TILES: decode is compiled once
+# per (batch_tile, cache_tile) pair and a step runs the smallest tile
+# covering the live slots / longest sequence, so steady-state decoding
+# never recompiles.
+_C.GENERATE = CfgNode()
+# Hard cap on generated tokens per request (requests may ask for fewer).
+_C.GENERATE.MAX_NEW_TOKENS = 64
+# Batch tiles: concurrent-sequence capacities decode is compiled for.
+# The largest is the continuous-batching slot count. [] ⇒ powers of two
+# up to 4.
+_C.GENERATE.BATCH_TILES = []
+# KV-cache length tiles. The largest must cover PROMPT_LEN + MAX_NEW_TOKENS
+# (validated with the exact arithmetic at engine build) and every tile
+# must be ≤ LM.SEQ_LEN (positions beyond the learned table don't exist).
+# [] ⇒ [LM.SEQ_LEN].
+_C.GENERATE.CACHE_TILES = []
+# Longest admissible prompt (tokens). Prefill pads to this length.
+_C.GENERATE.PROMPT_LEN = 64
+# Token id that terminates a sequence early (the byte tokenizer's EOS
+# document-boundary token). -1 = generate exactly max_new_tokens.
+_C.GENERATE.EOS_ID = 256
+# Scheduler admission poll (seconds) while decode slots are free.
+_C.GENERATE.POLL_S = 0.002
+
 # ------------------------------- device / mesh (TPU-native additions) -------
 _C.DEVICE = CfgNode()
 # "tpu" | "cpu" | "auto" — jax platform selection.
@@ -405,7 +447,13 @@ _C.DATA = CfgNode()
 # order, and exact mid-epoch resume — the preemption checkpoint embeds the
 # loader's global cursor, so a restart continues at the exact next batch
 # instead of re-running the epoch. TRAIN/TEST.DATASET point at the shards
-# root (the directory holding <split>/MANIFEST.json).
+# root (the directory holding <split>/MANIFEST.json). "tokens" streams
+# packed-sequence TOKEN shards (data/shards/tokens.py, packed by
+# tools/make_token_shards.py) for the gpt_* LM archs: same record
+# container, same window-shuffled order, same exact mid-epoch resume —
+# batches become {"image": tokens [B,S] int32, "label": next-tokens}
+# (LM.SEQ_LEN must match the pack length; refused with the repack
+# command otherwise).
 _C.DATA.FORMAT = "imagefolder"
 # Shard-streaming order knobs (data/shards/order.py): storage order is cut
 # into SHARDS_BLOCK-record sequential runs, the runs are permuted, and a

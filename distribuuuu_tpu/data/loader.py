@@ -138,6 +138,11 @@ class Loader:
         }
         if hasattr(self.sampler, "order_state"):
             sd["order"] = self.sampler.order_state()
+        # dataset-species identity (the token pipeline's tokenizer/pack
+        # fingerprint): a cursor must not survive a tokenizer or pack-len
+        # change — the same byte stream would mean different tokens
+        if hasattr(self.dataset, "identity"):
+            sd["dataset_identity"] = self.dataset.identity()
         return sd
 
     def load_state_dict(self, sd: dict) -> int:
@@ -176,6 +181,18 @@ class Loader:
                     f"(fields: {', '.join(diff)}) — the cursor would point "
                     "into a different permutation"
                 )
+        saved_ident = sd.get("dataset_identity")
+        if saved_ident is not None:
+            live_ident = (
+                self.dataset.identity()
+                if hasattr(self.dataset, "identity") else None
+            )
+            if live_ident != saved_ident:
+                raise ValueError(
+                    f"dataset identity changed since the save (saved "
+                    f"{saved_ident}, live {live_ident}) — a tokenizer/"
+                    "pack-len drift makes the cursor meaningless"
+                )
         cursor = int(sd["cursor"])
         global_batch = self.batch_size * self.sampler.num_replicas
         skip, rem = divmod(cursor, global_batch)
@@ -212,8 +229,13 @@ class Loader:
         n = len(images)
         images = np.asarray(images)
         # DATA.DEVICE_NORMALIZE ships uint8 (4× fewer H2D bytes; the
-        # trainer normalizes in-graph); otherwise float32 as before
-        img_dtype = np.uint8 if images.dtype == np.uint8 else np.float32
+        # trainer normalizes in-graph); otherwise float32 as before. A
+        # dataset may pin the payload dtype instead (BATCH_DTYPE — the
+        # token species ships int32 ids that must NOT be float-cast or
+        # in-graph-normalized, data/shards/tokens.py).
+        img_dtype = getattr(self.dataset, "BATCH_DTYPE", None) or (
+            np.uint8 if images.dtype == np.uint8 else np.float32
+        )
         batch = {
             "image": images.astype(img_dtype, copy=False),
             "label": labels.astype(np.int32),
@@ -225,7 +247,12 @@ class Loader:
                 [batch["image"],
                  np.zeros((pad,) + batch["image"].shape[1:], img_dtype)]
             )
-            batch["label"] = np.concatenate([batch["label"], np.zeros(pad, np.int32)])
+            # label shape is [B] for classification, [B, S] for the LM —
+            # pad shape-generically
+            batch["label"] = np.concatenate(
+                [batch["label"],
+                 np.zeros((pad,) + batch["label"].shape[1:], np.int32)]
+            )
             batch["mask"] = np.concatenate([batch["mask"], np.zeros(pad, np.float32)])
         asm1 = time.perf_counter()
         if telemetry_spans.enabled() and cfg.TELEMETRY.STEP_SPANS:
@@ -433,6 +460,18 @@ def _build_dataset(split: str, train: bool):
         backend=cfg.DATA.BACKEND,
         raw_u8=raw_u8,
     )
+    if cfg.DATA.FORMAT == "tokens":
+        # packed-sequence token shards (data/shards/tokens.py, packed by
+        # tools/make_token_shards.py) — the LM pipeline. Same container,
+        # same window-shuffled order, same exact mid-epoch resume; the
+        # image-specific transform knobs don't apply. Pack/seq-len and
+        # tokenizer/vocab identity are refused here, before any compile.
+        from distribuuuu_tpu.data.shards.tokens import TokenShardDataset
+
+        return TokenShardDataset(
+            root, split, seq_len=int(cfg.LM.SEQ_LEN),
+            num_classes=int(cfg.MODEL.NUM_CLASSES),
+        )
     if cfg.DATA.FORMAT == "shards":
         # indexed record shards (data/shards/) — DATASET points at the
         # packed root (tools/make_shards.py); sequential IO + exact
@@ -442,7 +481,8 @@ def _build_dataset(split: str, train: bool):
         return ShardDataset(root, split, **common)
     if cfg.DATA.FORMAT != "imagefolder":
         raise ValueError(
-            f"DATA.FORMAT must be imagefolder|shards, got {cfg.DATA.FORMAT!r}"
+            f"DATA.FORMAT must be imagefolder|shards|tokens, got "
+            f"{cfg.DATA.FORMAT!r}"
         )
     from distribuuuu_tpu.data.imagefolder import ImageFolderDataset
 

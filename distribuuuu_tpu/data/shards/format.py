@@ -144,11 +144,19 @@ class ShardWriter:
 
 
 def write_shard_manifest(split_dir: str, shards: list[dict], classes: list[str],
-                         target_bytes: int, source: str = "") -> str:
+                         target_bytes: int, source: str = "",
+                         extra: dict | None = None) -> str:
     """Commit marker for a completed pack — written AFTER every shard is
     durable (same tmp+fsync+``os.replace`` discipline as
     ``resilience/manifest.py``). Digests are computed here so ``--verify``
-    and the truncated-shard fault injection have ground truth."""
+    and the truncated-shard fault injection have ground truth.
+
+    ``extra`` merges species-specific fields into the manifest — the token
+    species (data/shards/tokens.py) declares ``kind="tokens"`` plus its
+    pack length and tokenizer identity there, so a reader opening the
+    wrong species refuses with the reason instead of mis-decoding records.
+    Image packs carry no ``kind`` (readers treat its absence as
+    ``"images"`` — every pre-r13 manifest stays valid)."""
     from distribuuuu_tpu.resilience.manifest import sha256_file
 
     for s in shards:
@@ -161,6 +169,7 @@ def write_shard_manifest(split_dir: str, shards: list[dict], classes: list[str],
         "target_shard_bytes": int(target_bytes),
         "shards": shards,
         "source": source,
+        **(extra or {}),
     }
     dest = os.path.join(split_dir, MANIFEST_NAME)
     tmp = dest + ".tmp"

@@ -43,35 +43,37 @@ from distribuuuu_tpu.data.transforms import train_transform, val_transform
 from distribuuuu_tpu.telemetry import registry as telemetry_registry
 
 
-class ShardDataset:
-    FORMAT = "shards"
+class RecordShards:
+    """The species-independent half of a shard reader: manifest load,
+    global-index→(shard, record) mapping, lazy per-shard fd + index, and
+    the lockless positioned record read. :class:`ShardDataset` (images)
+    and the token species (data/shards/tokens.TokenShardDataset) both
+    stream through exactly this core, so footer recovery, the
+    ``ShardReadError``→``DATA.SKIP_CORRUPT`` containment path, and the
+    shard-IO telemetry tallies are one implementation."""
 
-    def __init__(
-        self,
-        root: str,
-        split: str,
-        im_size: int,
-        train: bool,
-        base_seed: int = 0,
-        crop_size: int | None = None,
-        backend: str = "auto",
-        raw_u8: bool = False,
-    ):
+    FORMAT = "shards"
+    # the manifest species this reader decodes (absence in an old image
+    # manifest reads as "images")
+    KIND = "images"
+
+    def _open_split(self, root: str, split: str) -> None:
+        from distribuuuu_tpu.data.shards.format import ShardFormatError
         from distribuuuu_tpu.utils import faults
 
         self.dir = os.path.join(root, split)
         faults.maybe_truncate_shard(self.dir)  # injection no-op (FAULTS.*)
         self.manifest = read_shard_manifest(self.dir)
-        self.classes = list(self.manifest["classes"])
-        self.im_size = im_size
-        self.crop_size = im_size if crop_size is None else crop_size
-        self.train = train
-        self.base_seed = base_seed
-        self._epoch_seed = 0
-        if backend not in ("auto", "native", "pil"):
-            raise ValueError(f"DATA.BACKEND must be auto|native|pil, got {backend}")
-        self.backend = backend
-        self.raw_u8 = raw_u8
+        kind = self.manifest.get("kind", "images")
+        if kind != self.KIND:
+            raise ShardFormatError(
+                f"{self.dir} holds {kind!r} shards but DATA.FORMAT selects "
+                f"the {self.KIND!r} reader — point TRAIN/TEST.DATASET at a "
+                f"{self.KIND} pack ("
+                + ("tools/make_shards.py" if self.KIND == "images"
+                   else "tools/make_token_shards.py")
+                + " writes one) or switch DATA.FORMAT"
+            )
         self._shards = self.manifest["shards"]
         # global index i → shard s where cum[s] <= i < cum[s+1]
         counts = [int(s["records"]) for s in self._shards]
@@ -135,7 +137,7 @@ class ShardDataset:
             self._fds.clear()
             self._offsets.clear()
 
-    # ------------------------------------------------------- loader surface
+    # ------------------------------------------- shared loader surface
     def __len__(self):
         return self._n
 
@@ -146,7 +148,10 @@ class ShardDataset:
                      seed: int, drop_last: bool = False):
         """The loader's sampler hook: train (shuffle) gets the
         window-shuffled sequential order; val returns None → the plain
-        DistributedSampler (storage order — already sequential)."""
+        DistributedSampler (storage order — already sequential). Shared by
+        both species — which is what carries exact mid-epoch resume to the
+        token pipeline for free (the cursor protocol only needs
+        ``order_state``)."""
         if not shuffle:
             return None
         from distribuuuu_tpu.config import cfg
@@ -158,6 +163,34 @@ class ShardDataset:
             window=int(cfg.DATA.SHARDS_WINDOW),
             drop_last=drop_last,
         )
+
+
+class ShardDataset(RecordShards):
+    """The IMAGE shard species: encoded image bytes per record, decoded
+    through PIL or the C++ kernel's memory-buffer API (module docstring)."""
+
+    def __init__(
+        self,
+        root: str,
+        split: str,
+        im_size: int,
+        train: bool,
+        base_seed: int = 0,
+        crop_size: int | None = None,
+        backend: str = "auto",
+        raw_u8: bool = False,
+    ):
+        self._open_split(root, split)
+        self.classes = list(self.manifest["classes"])
+        self.im_size = im_size
+        self.crop_size = im_size if crop_size is None else crop_size
+        self.train = train
+        self.base_seed = base_seed
+        self._epoch_seed = 0
+        if backend not in ("auto", "native", "pil"):
+            raise ValueError(f"DATA.BACKEND must be auto|native|pil, got {backend}")
+        self.backend = backend
+        self.raw_u8 = raw_u8
 
     def _rng(self, idx: int) -> np.random.Generator:
         # identical stream to ImageFolderDataset._rng — same (seed, epoch,

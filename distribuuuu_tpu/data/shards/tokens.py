@@ -1,0 +1,181 @@
+"""Token shard species: packed LM sequences over the existing shard format.
+
+Documents → byte tokens → one ``EOS`` per document boundary → the
+concatenated stream cut into fixed ``pack_len + 1``-token records (the +1
+is the next-token shift: input = ``[:-1]``, targets = ``[1:]``, so one
+record feeds one training example with NO cross-record dependency — any
+shuffle order is valid). The container is ``data/shards/format.py``
+verbatim — length-prefixed CRC'd records, index footer, atomically-
+committed manifest — so footer recovery, ``--verify``, the
+``FAULTS.TRUNCATE_SHARD`` drill, and the loader's
+``DATA.RETRIES``/``SKIP_CORRUPT`` containment all apply unchanged.
+
+Record body reuse: the image record's ``label`` field counts the document
+boundaries inside the sequence (free observability), ``key`` is the
+global sequence id, and the payload bytes are the little-endian uint16
+token array instead of encoded image bytes.
+
+Manifest extras (``format.write_shard_manifest(extra=...)``):
+``kind="tokens"`` (the species guard — the image reader refuses these),
+``pack_len``, ``total_tokens``, and the tokenizer identity
+(lm/tokenizer.ByteTokenizer.identity) — which :class:`TokenShardDataset`
+checks against the live config so a seq-len or vocab/tokenizer mismatch
+refuses at loader construction with the repack command, not as a garbage
+loss curve three hours in (ISSUE 12 satellite).
+
+Exact mid-epoch resume is inherited, not reimplemented: the dataset is a
+``reader.RecordShards`` (``FORMAT="shards"`` + the shared window-shuffle
+sampler), so ``Loader.state_dict``'s global-cursor protocol applies
+verbatim; :meth:`TokenShardDataset.identity` additionally rides the
+cursor so a tokenizer/pack change invalidates it loudly.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from distribuuuu_tpu.data.shards.format import (
+    ShardFormatError,
+    ShardReadError,
+    ShardWriter,
+    write_shard_manifest,
+)
+from distribuuuu_tpu.data.shards.reader import RecordShards
+from distribuuuu_tpu.lm.tokenizer import ByteTokenizer
+
+TOKEN_DTYPE = np.dtype("<u2")  # little-endian uint16 payload on disk
+
+
+# ------------------------------------------------------------------ packing
+
+
+def pack_token_stream(docs, pack_len: int, tokenizer: ByteTokenizer | None = None):
+    """Documents → fixed-length packed sequences.
+
+    Yields ``(seq, n_docs)``: ``seq`` a ``pack_len + 1`` uint16 array from
+    the EOS-joined document stream, ``n_docs`` the number of document
+    boundaries (EOS tokens) inside it. The trailing partial window is
+    DROPPED (a short record would break the fixed-shape batch contract);
+    the packer reports how many tokens that cost.
+    """
+    tok = tokenizer or ByteTokenizer()
+    if pack_len < 1:
+        raise ValueError(f"pack_len must be >= 1, got {pack_len}")
+    width = pack_len + 1
+    buf = np.empty((0,), np.uint16)
+    for doc in docs:
+        ids = tok.encode(doc) if not isinstance(doc, np.ndarray) else doc
+        buf = np.concatenate(
+            [buf, ids.astype(np.uint16), np.array([tok.eos_id], np.uint16)]
+        )
+        while len(buf) >= width:
+            seq, buf = buf[:width].copy(), buf[width:]
+            yield seq, int((seq == tok.eos_id).sum())
+
+
+def write_token_shards(
+    out_dir: str,
+    seqs,
+    pack_len: int,
+    *,
+    tokenizer: ByteTokenizer | None = None,
+    target_bytes: int = 4 * 1024 * 1024,
+    source: str = "",
+) -> str:
+    """Write packed sequences into ``out_dir`` (one split directory) and
+    commit the token manifest. Returns the manifest path."""
+    tok = tokenizer or ByteTokenizer()
+    writer = ShardWriter(out_dir, target_bytes=target_bytes)
+    n = 0
+    for seq, n_docs in seqs:
+        seq = np.asarray(seq, TOKEN_DTYPE)
+        if len(seq) != pack_len + 1:
+            raise ValueError(
+                f"sequence {n} has {len(seq)} tokens, want pack_len+1="
+                f"{pack_len + 1}"
+            )
+        writer.add(seq.tobytes(), int(n_docs), f"seq-{n:08d}")
+        n += 1
+    shards = writer.close()
+    return write_shard_manifest(
+        out_dir, shards, classes=[], target_bytes=target_bytes, source=source,
+        extra={
+            "kind": "tokens",
+            "pack_len": int(pack_len),
+            "total_tokens": n * (pack_len + 1),
+            **tok.identity(),
+        },
+    )
+
+
+# ------------------------------------------------------------------ reading
+
+
+class TokenShardDataset(RecordShards):
+    """Loader-facing token shard reader: ``dataset[i]`` returns
+    ``(input_tokens [S] int32, next_tokens [S] int32)`` — the loader's
+    generic ``(image, label)`` contract, so batches arrive as
+    ``{"image": [B, S] int32, "label": [B, S] int32, "mask": [B]}``
+    through the unchanged assembly/prefetch/sharding stack.
+
+    ``BATCH_DTYPE`` tells the loader to keep the stacked payload int32
+    (the embedding lookup input) instead of the image float/uint8 cast.
+    """
+
+    KIND = "tokens"
+    BATCH_DTYPE = np.int32
+
+    def __init__(self, root: str, split: str, seq_len: int,
+                 num_classes: int | None = None):
+        self._open_split(root, split)
+        self.seq_len = int(seq_len)
+        pack = int(self.manifest.get("pack_len", -1))
+        if pack != self.seq_len:
+            raise ShardFormatError(
+                f"{self.dir}: token shards are packed at pack_len={pack} "
+                f"but LM.SEQ_LEN={self.seq_len} — set LM.SEQ_LEN {pack} or "
+                f"repack: python tools/make_token_shards.py --src <corpus> "
+                f"--out <root> --pack-len {self.seq_len}"
+            )
+        self.tokenizer = ByteTokenizer()
+        live = self.tokenizer.identity()
+        packed = {k: self.manifest.get(k) for k in live}
+        if packed != live:
+            raise ShardFormatError(
+                f"{self.dir}: tokenizer identity drift — pack says "
+                f"{packed}, live tokenizer is {live}; a cursor/weights "
+                "trained on one cannot continue on the other (repack with "
+                "tools/make_token_shards.py)"
+            )
+        if num_classes is not None and int(num_classes) < live["vocab_size"]:
+            raise ShardFormatError(
+                f"MODEL.NUM_CLASSES={num_classes} is smaller than the "
+                f"pack's tokenizer vocab {live['vocab_size']} — the head "
+                "could never emit every token id; set MODEL.NUM_CLASSES "
+                f"{live['vocab_size']} (the gpt_*.yaml default)"
+            )
+
+    def identity(self) -> dict:
+        """Rides the Loader's exact-resume cursor: a restored cursor is
+        honored only when the live pack/tokenizer identity matches."""
+        return {
+            "kind": "tokens",
+            "pack_len": self.seq_len,
+            **self.tokenizer.identity(),
+        }
+
+    def seq_tokens(self, idx: int) -> np.ndarray:
+        """The full packed ``[pack_len + 1]`` uint16 sequence of record
+        ``idx`` (round-trip surface for tests and the bench)."""
+        payload, _, _ = self.record(int(idx))
+        seq = np.frombuffer(payload, TOKEN_DTYPE)
+        if len(seq) != self.seq_len + 1:
+            raise ShardReadError(
+                f"record {idx}: {len(seq)} tokens, manifest pack_len says "
+                f"{self.seq_len + 1}"
+            )
+        return seq
+
+    def __getitem__(self, idx: int):
+        seq = self.seq_tokens(int(idx)).astype(np.int32)
+        return seq[:-1], seq[1:]

@@ -1,0 +1,22 @@
+"""Language-model workload plane (ISSUE 12).
+
+The second workload family on top of the framework's shared layers —
+proof that the partition lowering, the shard store, the async plane, and
+the serving fleet are workload-agnostic, and the memory-bound dynamic-
+shape consumer the roofline ledger and the bucket-AOT engine needed
+(arXiv:2204.06514 LM-under-pjit; arXiv:2605.25645 TPU LM serving):
+
+  * ``tokenizer``  — the in-repo byte-level tokenizer (no external vocab
+    download; identity-fingerprinted so resume/serving detect drift);
+  * ``generate``   — KV-cache autoregressive generation: prefill/decode
+    split, (batch, cache-len) AOT tiles, continuous batching;
+  * ``service``    — the replica-side generation service speaking the
+    serve fleet's length-prefixed protocol with streamed token frames.
+
+Training has NO module here by design: a ``gpt_*`` arch trains through
+``trainer.train_model`` exactly like the image zoo (models/gpt.py +
+data/shards/tokens.py + the LM SpecTable rules in
+parallel/partition/specs.py are the complete training-side delta).
+"""
+
+from distribuuuu_tpu.lm.tokenizer import ByteTokenizer  # noqa: F401

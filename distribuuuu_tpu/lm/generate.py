@@ -1,0 +1,827 @@
+"""KV-cache autoregressive generation with continuous batching (ISSUE 12).
+
+The serving half of the LM workload plane, reproducing the production TPU
+LM-serving pattern (arXiv:2605.25645) at miniature scale:
+
+**Prefill/decode split.** A request's prompt runs ONCE through a
+teacher-forced forward (``GPTDecoder`` against an empty cache) — compute-
+bound, one pass, produces the prompt's K/V and the first generated token.
+Every subsequent token is a ``decode`` step: one token per sequence
+against the cached K/V — tiny flops over the whole cache + params, i.e.
+memory-bound by construction (the cost-model ledger attributes exactly
+that; ROADMAP #3's future kernels get their canonical target here).
+
+**Paged per-request KV cache.** The cache is ``[L, B, H, C, Dh]`` with
+one PAGE (row) per request slot: admitting a request claims a free slot
+and overwrites its page via the prefill insert; retiring frees the slot
+with no data movement — other requests' pages are never touched, which is
+what makes admit/retire contamination-free (pinned by tests).
+
+**(batch, cache-len) tiles — the serve engine's AOT buckets generalized.**
+``serve/engine.py`` compiles one executable per batch bucket; generation
+needs TWO dynamic dims, so the engine AOT-compiles a decode executable
+per ``(batch_tile, cache_tile)`` pair (``GENERATE.BATCH_TILES`` ×
+``CACHE_TILES``), prefill per prompt tile, and the insert/grow glue per
+shape pair — all at startup, so steady-state generation NEVER recompiles
+(the fleet pool's warm-up gate reads the same ``n_compiles``/``buckets``
+stats contract the image engine exposes). A step runs the smallest tile
+covering the live slots and the longest sequence; crossing a tile
+boundary pays one precompiled cache grow.
+
+**Continuous batching.** The scheduler admits and retires per DECODE STEP
+— a finishing request frees its slot for a waiting one while its former
+batch-mates keep decoding (ragged completions, zero idle slots, zero
+drops). Tokens stream to each requester the step they're produced
+(``GenStream``), and through the fleet router as streaming ctrl frames
+(serve/protocol.py + fleet/router.py).
+
+**Exactness.** ``GPTDecoder`` reuses the training modules (vit.Mlp,
+MoeMlp's reference path, the same Dense/LayerNorm layers under the same
+param names), so it applies the TRAINING param tree directly, and
+prefill+decode logits are pinned logit-identical (within float tolerance)
+to the full teacher-forced ``GPT.__call__`` forward — the test
+``tests/test_lm.py`` asserts it position by position.
+"""
+
+from __future__ import annotations
+
+import functools
+import threading
+import time
+from collections import deque
+from typing import Any
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from distribuuuu_tpu.config import cfg
+from distribuuuu_tpu.models.layers import Dense, head_dtype
+from distribuuuu_tpu.models.vit import Mlp, MoeMlp
+from distribuuuu_tpu.serve.admission import AdmissionController
+from distribuuuu_tpu.telemetry import registry as telemetry_registry
+
+
+# --------------------------------------------------------- decode modules
+#
+# Structural mirrors of models/gpt.GPT: same submodule NAMES, same layer
+# types, same dtypes — so ``GPTDecoder.apply({"params": gpt_params}, ...)``
+# consumes the training checkpoint unchanged. The only new math is the
+# cache write (per-row dynamic_update_slice at each row's length) and the
+# per-row causal mask over cached positions.
+
+
+class CachedAttention(nn.Module):
+    """vit.Attention's math against a KV cache: the qkv/out projections
+    are the same ``Dense_0``/``Dense_1`` params; K/V of the T new tokens
+    are written into the cache at each row's current length; queries
+    attend every cached position ≤ their own."""
+
+    dim: int
+    num_heads: int
+    dtype: Any
+
+    @nn.compact
+    def __call__(self, x, cache_k, cache_v, lengths):
+        B, T, _ = x.shape
+        H = self.num_heads
+        D = self.dim // H
+        C = cache_k.shape[2]
+        qkv = Dense(3 * self.dim, dtype=self.dtype, name="Dense_0")(x)
+        qkv = qkv.reshape(B, T, 3, H, D).transpose(2, 0, 3, 1, 4)
+        q, k, v = qkv[0], qkv[1], qkv[2]  # [B, H, T, D]
+
+        def write(c, new, start):  # [H, C, D], [H, T, D], scalar
+            return jax.lax.dynamic_update_slice(c, new, (0, start, 0))
+
+        cache_k = jax.vmap(write)(cache_k, k, lengths)
+        cache_v = jax.vmap(write)(cache_v, v, lengths)
+        scale = D ** -0.5
+        s = jnp.einsum(
+            "bhtd,bhcd->bhtc",
+            q.astype(jnp.float32), cache_k.astype(jnp.float32),
+        ) * scale
+        # key j is visible to new-token t iff j ≤ lengths[b] + t (the new
+        # token itself sits at absolute position lengths[b] + t)
+        j = jnp.arange(C)[None, None, None, :]
+        t = jnp.arange(T)[None, None, :, None]
+        visible = j <= (lengths[:, None, None, None] + t)
+        s = jnp.where(visible, s, jnp.float32(-1e30))
+        w = jax.nn.softmax(s, axis=-1)
+        out = jnp.einsum("bhtc,bhcd->bhtd", w, cache_v.astype(jnp.float32))
+        out = out.astype(self.dtype).transpose(0, 2, 1, 3).reshape(B, T, self.dim)
+        return Dense(self.dim, dtype=self.dtype, name="Dense_1")(out), \
+            cache_k, cache_v
+
+
+class DecodeBlock(nn.Module):
+    """vit.Block with the attention swapped for :class:`CachedAttention`;
+    the FFN is the SAME module (vit.Mlp, or MoeMlp's exact single-device
+    reference path for the *_moe archs) under the same name."""
+
+    dim: int
+    num_heads: int
+    mlp_ratio: float
+    dtype: Any
+    moe_experts: int = 0
+    moe_top_k: int = 2
+    moe_capacity_factor: float = 2.0
+
+    @nn.compact
+    def __call__(self, x, cache_k, cache_v, lengths):
+        y = nn.LayerNorm(
+            dtype=self.dtype, param_dtype=jnp.float32, name="LayerNorm_0"
+        )(x)
+        a, cache_k, cache_v = CachedAttention(
+            self.dim, self.num_heads, self.dtype, name="Attention_0"
+        )(y, cache_k, cache_v, lengths)
+        x = x + a
+        y = nn.LayerNorm(
+            dtype=self.dtype, param_dtype=jnp.float32, name="LayerNorm_1"
+        )(x)
+        if self.moe_experts > 0:
+            # mesh=None selects MoeMlp's exact dense reference formulation
+            # (replicated experts — the single-device serving layout)
+            ffn = MoeMlp(
+                self.dim, int(self.dim * self.mlp_ratio), self.moe_experts,
+                self.moe_top_k, self.dtype, None,
+                capacity_factor=self.moe_capacity_factor, name="MoeMlp_0",
+            )
+        else:
+            ffn = Mlp(
+                int(self.dim * self.mlp_ratio), self.dim, 0.0, self.dtype,
+                name="Mlp_0",
+            )
+        return x + ffn(y, train=False), cache_k, cache_v
+
+
+class GPTDecoder(nn.Module):
+    """Applies the GPT param tree to T new tokens per row against a KV
+    cache. ``lengths[b]`` tokens are already cached for row b; positions
+    and causal visibility follow from it. Returns per-new-token logits
+    and the updated cache."""
+
+    vocab_size: int
+    seq_len: int
+    dim: int
+    depth: int
+    num_heads: int
+    mlp_ratio: float = 4.0
+    dtype: Any = jnp.bfloat16
+    moe_experts: int = 0
+    moe_top_k: int = 2
+    moe_every: int = 2
+    moe_capacity_factor: float = 2.0
+
+    @nn.compact
+    def __call__(self, tokens, lengths, cache):
+        B, T = tokens.shape
+        x = nn.Embed(
+            self.vocab_size, self.dim, name="tok_embed",
+            dtype=self.dtype, param_dtype=jnp.float32,
+            embedding_init=nn.initializers.normal(0.02),
+        )(tokens)
+        pos_table = self.param(
+            "pos_embed",
+            nn.initializers.normal(0.02),
+            (1, self.seq_len, self.dim), jnp.float32,
+        )
+        pos_idx = jnp.clip(
+            lengths[:, None] + jnp.arange(T)[None, :], 0, self.seq_len - 1
+        )
+        x = x + jnp.take(pos_table[0], pos_idx, axis=0).astype(self.dtype)
+        ks, vs = [], []
+        for i in range(self.depth):
+            moe = (
+                self.moe_experts
+                if self.moe_experts > 0
+                and i % self.moe_every == self.moe_every - 1
+                else 0
+            )
+            x, ck, cv = DecodeBlock(
+                self.dim, self.num_heads, self.mlp_ratio, self.dtype,
+                moe_experts=moe, moe_top_k=self.moe_top_k,
+                moe_capacity_factor=self.moe_capacity_factor,
+                name=f"Block_{i}",
+            )(x, cache["k"][i], cache["v"][i], lengths)
+            ks.append(ck)
+            vs.append(cv)
+        x = nn.LayerNorm(
+            dtype=self.dtype, param_dtype=jnp.float32, name="LayerNorm_0"
+        )(x)
+        hd = head_dtype(x.dtype)
+        logits = Dense(self.vocab_size, dtype=hd, name="head")(x.astype(hd))
+        return logits, {"k": jnp.stack(ks), "v": jnp.stack(vs)}
+
+
+def decoder_for(model) -> GPTDecoder:
+    """The decode mirror of a ``models/gpt.GPT`` instance (same hyper
+    fields, so the param trees coincide)."""
+    return GPTDecoder(
+        vocab_size=model.vocab_size, seq_len=model.seq_len, dim=model.dim,
+        depth=model.depth, num_heads=model.num_heads,
+        mlp_ratio=model.mlp_ratio, dtype=model.dtype,
+        moe_experts=model.moe_experts, moe_top_k=model.moe_top_k,
+        moe_every=model.moe_every,
+        moe_capacity_factor=model.moe_capacity_factor,
+    )
+
+
+# ----------------------------------------------------------- tile algebra
+
+
+def default_tiles(cap: int) -> list[int]:
+    """Powers of two up to ``cap`` plus ``cap`` itself (the serve-bucket
+    rule, serve/engine.default_buckets)."""
+    out, b = [], 1
+    while b < cap:
+        out.append(b)
+        b *= 2
+    out.append(int(cap))
+    return sorted(set(out))
+
+
+def tile_for(tiles: list[int], n: int) -> int:
+    """Smallest tile ≥ n (tiles sorted ascending)."""
+    for t in tiles:
+        if t >= n:
+            return t
+    raise ValueError(f"no tile covers {n} (tiles: {tiles})")
+
+
+def validate_generate_cfg(seq_len: int, prompt_len: int, max_new: int,
+                          batch_tiles: list[int], cache_tiles: list[int]):
+    """The GENERATE config refusals, with the exact arithmetic in each
+    message (ISSUE 12 satellite). Returns (batch_tiles, cache_tiles)."""
+    if prompt_len < 1 or max_new < 1:
+        raise ValueError(
+            f"GENERATE.PROMPT_LEN={prompt_len} and MAX_NEW_TOKENS={max_new} "
+            "must be >= 1"
+        )
+    batch_tiles = sorted(set(int(b) for b in batch_tiles)) or default_tiles(4)
+    cache_tiles = sorted(set(int(c) for c in cache_tiles)) or [int(seq_len)]
+    if batch_tiles[0] < 1:
+        raise ValueError(f"GENERATE.BATCH_TILES {batch_tiles} must be >= 1")
+    for c in cache_tiles:
+        if c > seq_len:
+            raise ValueError(
+                f"GENERATE.CACHE_TILES contains {c} > LM.SEQ_LEN={seq_len}: "
+                "the learned position table has no entry past the trained "
+                "context — lower the tile or retrain with a longer LM.SEQ_LEN"
+            )
+    need = prompt_len + max_new
+    if cache_tiles[-1] < need:
+        raise ValueError(
+            f"largest GENERATE.CACHE_TILES entry {cache_tiles[-1]} cannot "
+            f"hold a full request: GENERATE.PROMPT_LEN={prompt_len} + "
+            f"MAX_NEW_TOKENS={max_new} = {need} cached positions — raise "
+            f"CACHE_TILES to >= {need} (and <= LM.SEQ_LEN={seq_len}) or "
+            "lower MAX_NEW_TOKENS/PROMPT_LEN"
+        )
+    return batch_tiles, cache_tiles
+
+
+# -------------------------------------------------------------- the engine
+
+
+class GenStream:
+    """Per-request streamed result: iterate for tokens as they decode, or
+    ``result()`` for the full list. Closed exactly once at retire."""
+
+    def __init__(self, request_id: int, prompt_len: int):
+        self.request_id = request_id
+        self.prompt_len = prompt_len
+        self._q: deque = deque()
+        self._cond = threading.Condition()
+        self._done = False
+        self._error: Exception | None = None
+        self.reason: str | None = None
+
+    # engine side
+    def _emit(self, token: int) -> None:
+        with self._cond:
+            self._q.append(int(token))
+            self._cond.notify_all()
+
+    def _close(self, reason: str, error: Exception | None = None) -> None:
+        with self._cond:
+            self._done = True
+            self.reason = reason
+            self._error = error
+            self._cond.notify_all()
+
+    # client side
+    def __iter__(self):
+        while True:
+            with self._cond:
+                while not self._q and not self._done:
+                    self._cond.wait(timeout=0.1)
+                if self._q:
+                    yield self._q.popleft()
+                    continue
+                if self._error is not None:
+                    raise self._error
+                return
+
+    def result(self, timeout: float | None = 60.0) -> list[int]:
+        deadline = None if timeout is None else time.perf_counter() + timeout
+        out = []
+        with self._cond:
+            while True:
+                out.extend(self._q)
+                self._q.clear()
+                if self._done:
+                    if self._error is not None:
+                        raise self._error
+                    return out
+                wait = (
+                    None if deadline is None
+                    else max(0.0, deadline - time.perf_counter())
+                )
+                if wait == 0.0:
+                    raise TimeoutError(
+                        f"generation {self.request_id} incomplete after "
+                        f"{timeout}s"
+                    )
+                self._cond.wait(timeout=wait)
+
+
+class _Slot:
+    __slots__ = ("stream", "length", "last_token", "new_tokens", "max_new")
+
+    def __init__(self, stream, length, last_token, max_new):
+        self.stream = stream
+        self.length = length          # cached positions (prompt + generated-1)
+        self.last_token = last_token  # feeds the next decode step
+        self.new_tokens = 0
+        self.max_new = max_new
+
+
+class GenerateEngine:
+    """Continuous-batching generation over one device.
+
+    ``variables`` is ``{"params": ...}`` — the TRAINING param tree (no
+    batch_stats: the LM is LayerNorm-only). All tile executables compile
+    AOT at construction; ``start()`` runs the scheduler thread; ``submit``
+    returns a :class:`GenStream`.
+    """
+
+    def __init__(
+        self,
+        model,
+        variables: dict,
+        *,
+        max_new_tokens: int | None = None,
+        prompt_len: int | None = None,
+        batch_tiles: list[int] | None = None,
+        cache_tiles: list[int] | None = None,
+        eos_id: int | None = None,
+        max_queue: int | None = None,
+        poll_s: float | None = None,
+        emit_interval_s: float = 10.0,
+    ):
+        self.model = model
+        self.decoder = decoder_for(model)
+        self._variables = {"params": variables["params"]}
+        self.max_new = int(
+            max_new_tokens if max_new_tokens is not None
+            else cfg.GENERATE.MAX_NEW_TOKENS
+        )
+        self.prompt_len = int(
+            prompt_len if prompt_len is not None else cfg.GENERATE.PROMPT_LEN
+        )
+        self.eos_id = int(
+            eos_id if eos_id is not None else cfg.GENERATE.EOS_ID
+        )
+        self._poll_s = float(
+            poll_s if poll_s is not None else cfg.GENERATE.POLL_S
+        )
+        self.batch_tiles, self.cache_tiles = validate_generate_cfg(
+            model.seq_len, self.prompt_len, self.max_new,
+            list(batch_tiles if batch_tiles is not None
+                 else cfg.GENERATE.BATCH_TILES),
+            list(cache_tiles if cache_tiles is not None
+                 else cfg.GENERATE.CACHE_TILES),
+        )
+        self.prompt_tiles = [
+            t for t in default_tiles(self.prompt_len)
+        ]
+        self.n_slots = self.batch_tiles[-1]
+        self._admission = AdmissionController(
+            max_queue if max_queue is not None else cfg.SERVE.MAX_QUEUE
+        )
+        self._emit_interval_s = emit_interval_s
+        self._dtype = model.dtype
+        self._heads = model.num_heads
+        self._head_dim = model.dim // model.num_heads
+        self._depth = model.depth
+
+        # -- AOT compile every tile shape, exactly once, at startup -------
+        # (the serve-engine bucket discipline generalized to 2D tiles)
+        self.n_compiles = 0
+        self._decode_exec: dict[tuple[int, int], Any] = {}
+        self._prefill_exec: dict[int, Any] = {}
+        self._insert_exec: dict[tuple[int, int, int], Any] = {}
+        self._grow_exec: dict[tuple, Any] = {}
+        self._compile_tiles()
+
+        # -- live state ----------------------------------------------------
+        self._lock = threading.Condition()
+        self._waiting: deque = deque()
+        self._slots: list[_Slot | None] = [None] * self.n_slots
+        self._b_tile = self.batch_tiles[0]
+        self._c_tile = self.cache_tiles[0]
+        self._cache = self._zero_cache(self._b_tile, self._c_tile)
+        self._draining = False
+        self._started = False
+        self._next_id = 0
+        self._t0 = time.perf_counter()
+        self._counters = {
+            "prompt_tokens": 0, "new_tokens": 0, "decode_steps": 0,
+            "requests": 0, "retired": 0,
+        }
+        self._decode_ms: deque = deque(maxlen=4096)
+        self._prefill_ms: deque = deque(maxlen=1024)
+        self._thread = threading.Thread(
+            target=self._scheduler, name="gen-scheduler", daemon=True
+        )
+
+    # ------------------------------------------------------------ compiles
+    def _cache_sds(self, b: int, c: int):
+        shape = (self._depth, b, self._heads, c, self._head_dim)
+        return {
+            "k": jax.ShapeDtypeStruct(shape, self._dtype),
+            "v": jax.ShapeDtypeStruct(shape, self._dtype),
+        }
+
+    def _compile_tiles(self) -> None:
+        from distribuuuu_tpu.serve.engine import COMPILE_EVENTS
+
+        def decode_fn(variables, tokens, lengths, cache):
+            logits, cache = self.decoder.apply(
+                variables, tokens[:, None], lengths, cache
+            )
+            return logits[:, 0], cache
+
+        def prefill_fn(variables, tokens):
+            # fresh page: the prompt's K/V builds in a zeros cache sized
+            # exactly to the prompt tile; insert_fn pages it into the slot
+            B, P = tokens.shape
+            zero = {
+                "k": jnp.zeros(
+                    (self._depth, B, self._heads, P, self._head_dim),
+                    self._dtype,
+                ),
+                "v": jnp.zeros(
+                    (self._depth, B, self._heads, P, self._head_dim),
+                    self._dtype,
+                ),
+            }
+            lengths = jnp.zeros((B,), jnp.int32)
+            return self.decoder.apply(variables, tokens, lengths, zero)
+
+        def insert_fn(cache, kv, slot):
+            return jax.tree.map(
+                lambda c, n: jax.lax.dynamic_update_slice(
+                    c, n, (0, slot, 0, 0, 0)
+                ),
+                cache, kv,
+            )
+
+        def grow_fn(cache, b, c):
+            def pad(x):
+                db = b - x.shape[1]
+                dc = c - x.shape[3]
+                return jnp.pad(x, ((0, 0), (0, db), (0, 0), (0, dc), (0, 0)))
+
+            return jax.tree.map(pad, cache)
+
+        vars_sds = jax.tree.map(
+            lambda x: jax.ShapeDtypeStruct(jnp.shape(x), x.dtype),
+            self._variables,
+        )
+        tok1 = functools.partial(jax.ShapeDtypeStruct, dtype=jnp.int32)
+        for b in self.batch_tiles:
+            for c in self.cache_tiles:
+                self._decode_exec[(b, c)] = (
+                    jax.jit(decode_fn, donate_argnums=(3,))
+                    .lower(vars_sds, tok1((b,)), tok1((b,)),
+                           self._cache_sds(b, c))
+                    .compile()
+                )
+                self.n_compiles += 1
+                COMPILE_EVENTS.append(b)
+        for p in self.prompt_tiles:
+            self._prefill_exec[p] = (
+                jax.jit(prefill_fn)
+                .lower(vars_sds, tok1((1, p)))
+                .compile()
+            )
+            self.n_compiles += 1
+        for p in self.prompt_tiles:
+            for b in self.batch_tiles:
+                for c in self.cache_tiles:
+                    if p > c:
+                        continue
+                    self._insert_exec[(p, b, c)] = (
+                        jax.jit(insert_fn, donate_argnums=(0,))
+                        .lower(self._cache_sds(b, c), self._cache_sds(1, p),
+                               jax.ShapeDtypeStruct((), jnp.int32))
+                        .compile()
+                    )
+                    self.n_compiles += 1
+        tiles = [(b, c) for b in self.batch_tiles for c in self.cache_tiles]
+        for (b1, c1) in tiles:
+            for (b2, c2) in tiles:
+                if (b2, c2) != (b1, c1) and b2 >= b1 and c2 >= c1:
+                    self._grow_exec[(b1, c1, b2, c2)] = (
+                        jax.jit(functools.partial(grow_fn, b=b2, c=c2))
+                        .lower(self._cache_sds(b1, c1))
+                        .compile()
+                    )
+                    self.n_compiles += 1
+        telemetry_registry.get_registry().counter(
+            "serve.aot_compiles"
+        ).inc(self.n_compiles)
+        # cost-model ledger per tile (telemetry/costmodel.py): read off the
+        # executables just built — free. Decode's verdict is the point:
+        # per-token flops over the whole cache+params traffic is far below
+        # any ridge, i.e. memory-bound — the canonical kernel target.
+        if cfg.TELEMETRY.COSTMODEL:
+            from distribuuuu_tpu.telemetry import costmodel
+
+            for (b, c), ex in self._decode_exec.items():
+                costmodel.capture_compiled(
+                    ex, label=f"gen_decode_b{b}_c{c}", phase="generate",
+                    images=b, arch=cfg.MODEL.ARCH,
+                )
+            for p, ex in self._prefill_exec.items():
+                costmodel.capture_compiled(
+                    ex, label=f"gen_prefill_p{p}", phase="generate",
+                    images=1, arch=cfg.MODEL.ARCH,
+                )
+
+    def _zero_cache(self, b: int, c: int):
+        shape = (self._depth, b, self._heads, c, self._head_dim)
+        return {
+            "k": jnp.zeros(shape, self._dtype),
+            "v": jnp.zeros(shape, self._dtype),
+        }
+
+    # ------------------------------------------------------- client surface
+    def start(self) -> "GenerateEngine":
+        self._thread.start()
+        self._started = True
+        return self
+
+    def __enter__(self) -> "GenerateEngine":
+        return self.start()
+
+    def __exit__(self, *exc) -> None:
+        self.drain()
+
+    def submit(self, prompt, max_new_tokens: int | None = None) -> GenStream:
+        """Enqueue one prompt (iterable of token ids). Returns the token
+        stream. Raises ``QueueFullError``/``EngineClosedError`` like the
+        image engine's admission contract."""
+        ids = np.asarray(list(prompt), np.int32)
+        if ids.ndim != 1 or len(ids) < 1:
+            raise ValueError("prompt must be a non-empty 1-D token list")
+        if len(ids) > self.prompt_len:
+            raise ValueError(
+                f"prompt of {len(ids)} tokens exceeds "
+                f"GENERATE.PROMPT_LEN={self.prompt_len}"
+            )
+        if int(ids.max()) >= self.model.vocab_size or int(ids.min()) < 0:
+            raise ValueError(
+                f"prompt token ids must lie in [0, {self.model.vocab_size})"
+            )
+        max_new = min(
+            self.max_new,
+            int(max_new_tokens) if max_new_tokens else self.max_new,
+        )
+        with self._lock:
+            self._admission.admit(len(self._waiting), self._retry_after_ms())
+            stream = GenStream(self._next_id, len(ids))
+            self._next_id += 1
+            self._waiting.append((stream, ids, max_new))
+            self._counters["requests"] += 1
+            self._lock.notify_all()
+        return stream
+
+    def drain(self, timeout: float | None = 60.0) -> None:
+        """Stop admitting, finish every queued and in-flight request,
+        stop the scheduler. Idempotent."""
+        with self._lock:
+            self._draining = True
+            self._admission.close()
+            self._lock.notify_all()
+        if self._started:
+            self._thread.join(timeout)
+            self._started = False
+        else:
+            from distribuuuu_tpu.serve.admission import EngineClosedError
+
+            with self._lock:
+                while self._waiting:
+                    stream, _, _ = self._waiting.popleft()
+                    stream._close(
+                        "drained",
+                        EngineClosedError("engine drained before start()"),
+                    )
+
+    def _retry_after_ms(self) -> float:
+        ms = list(self._decode_ms)[-64:]
+        per_tok = (sum(ms) / len(ms)) if ms else 10.0
+        return max(50.0, per_tok * self.max_new / max(1, self.n_slots))
+
+    def stats(self) -> dict:
+        """The fleet pool/router stats contract (pool.warmed_up reads
+        ``buckets``/``n_compiles``; the router reads ``queue_depth``) plus
+        the generation-plane view."""
+        with self._lock:
+            waiting = len(self._waiting)
+            active = sum(1 for s in self._slots if s is not None)
+        dm = sorted(self._decode_ms)
+        pm = sorted(self._prefill_ms)
+
+        def pct(v, q):
+            return round(v[min(len(v) - 1, int(q * len(v)))], 3) if v else 0.0
+
+        el = max(time.perf_counter() - self._t0, 1e-9)
+        return {
+            "queue_depth": waiting,
+            "active": active,
+            "slots": self.n_slots,
+            "n_compiles": self.n_compiles,
+            "buckets": [list(t) for t in sorted(self._decode_exec)],
+            "max_batch": self.n_slots,
+            "batch_occupancy": active / max(1, self.n_slots),
+            "decode_p50_ms": pct(dm, 0.50),
+            "decode_p99_ms": pct(dm, 0.99),
+            "prefill_p50_ms": pct(pm, 0.50),
+            "prefill_p99_ms": pct(pm, 0.99),
+            "tokens_per_s": round(self._counters["new_tokens"] / el, 2),
+            **self._counters,
+        }
+
+    # ---------------------------------------------------------- scheduling
+    def _free_slot(self) -> int | None:
+        for i, s in enumerate(self._slots):
+            if s is None:
+                return i
+        return None
+
+    def _ensure_tile(self, b_need: int, c_need: int) -> None:
+        """Grow the live cache to the smallest tile covering the need
+        (precompiled pad — never a recompile, never a shrink mid-flight)."""
+        b = tile_for(self.batch_tiles, max(b_need, self._b_tile))
+        c = tile_for(self.cache_tiles, max(c_need, self._c_tile))
+        if (b, c) == (self._b_tile, self._c_tile):
+            return
+        self._cache = self._grow_exec[(self._b_tile, self._c_tile, b, c)](
+            self._cache
+        )
+        self._b_tile, self._c_tile = b, c
+
+    def _admit(self, stream: GenStream, ids: np.ndarray, max_new: int) -> None:
+        from distribuuuu_tpu.telemetry import spans
+
+        slot = self._free_slot()
+        assert slot is not None
+        t0 = time.perf_counter()
+        plen = len(ids)
+        ptile = tile_for(self.prompt_tiles, plen)
+        self._ensure_tile(slot + 1, plen + max_new)
+        padded = np.zeros((1, ptile), np.int32)
+        padded[0, :plen] = ids
+        logits, kv = self._prefill_exec[ptile](
+            self._variables, jnp.asarray(padded)
+        )
+        first = int(np.asarray(logits[0, plen - 1]).argmax())
+        self._cache = self._insert_exec[(ptile, self._b_tile, self._c_tile)](
+            self._cache, kv, jnp.int32(slot)
+        )
+        self._slots[slot] = _Slot(stream, plen, first, max_new)
+        self._counters["prompt_tokens"] += plen
+        ms = (time.perf_counter() - t0) * 1e3
+        self._prefill_ms.append(ms)
+        stream._emit(first)
+        self._slots[slot].new_tokens = 1  # prefill produced token #1
+        self._counters["new_tokens"] += 1
+        if spans.enabled():
+            spans.emit_event(
+                "gen.admit", slot=slot, prompt_tokens=plen,
+                request=stream.request_id,
+            )
+            spans.emit_event(
+                "gen.prefill", tokens=plen, tile=ptile, ms=round(ms, 3),
+            )
+        self._maybe_finish(slot, first)
+
+    def _retire(self, slot: int, reason: str) -> None:
+        from distribuuuu_tpu.telemetry import spans
+
+        s = self._slots[slot]
+        self._slots[slot] = None
+        self._counters["retired"] += 1
+        s.stream._close(reason)
+        if spans.enabled():
+            spans.emit_event(
+                "gen.retire", slot=slot, new_tokens=s.new_tokens,
+                reason=reason, request=s.stream.request_id,
+            )
+
+    def _maybe_finish(self, slot: int, token: int) -> bool:
+        s = self._slots[slot]
+        if token == self.eos_id:
+            self._retire(slot, "eos")
+            return True
+        if s.new_tokens >= s.max_new:
+            self._retire(slot, "max_new_tokens")
+            return True
+        if s.length + 1 >= self.cache_tiles[-1]:
+            self._retire(slot, "cache_full")
+            return True
+        return False
+
+    def _decode_step(self) -> None:
+        from distribuuuu_tpu.telemetry import spans
+
+        t0 = time.perf_counter()
+        live = [i for i, s in enumerate(self._slots) if s is not None]
+        c_need = max(self._slots[i].length for i in live) + 1
+        self._ensure_tile(max(live) + 1, c_need)
+        b = self._b_tile
+        tokens = np.zeros((b,), np.int32)
+        lengths = np.zeros((b,), np.int32)
+        for i in live:
+            tokens[i] = self._slots[i].last_token
+            lengths[i] = self._slots[i].length
+        logits, self._cache = self._decode_exec[(b, self._c_tile)](
+            self._variables, jnp.asarray(tokens), jnp.asarray(lengths),
+            self._cache,
+        )
+        logits = np.asarray(logits)
+        ms = (time.perf_counter() - t0) * 1e3
+        self._decode_ms.append(ms)
+        self._counters["decode_steps"] += 1
+        for i in live:
+            s = self._slots[i]
+            s.length += 1
+            nxt = int(logits[i].argmax())
+            s.last_token = nxt
+            s.new_tokens += 1
+            self._counters["new_tokens"] += 1
+            s.stream._emit(nxt)
+            self._maybe_finish(i, nxt)
+        if spans.enabled():
+            spans.emit_event(
+                "gen.decode", active=len(live), tile_b=b,
+                tile_c=self._c_tile, ms=round(ms, 3),
+            )
+
+    def _emit_token_counters(self) -> None:
+        from distribuuuu_tpu.telemetry import spans
+
+        if spans.enabled():
+            spans.emit_event(
+                "lm.tokens",
+                prompt_tokens=self._counters["prompt_tokens"],
+                new_tokens=self._counters["new_tokens"],
+                decode_steps=self._counters["decode_steps"],
+                elapsed_s=round(time.perf_counter() - self._t0, 3),
+            )
+
+    def _scheduler(self) -> None:
+        last_emit = time.perf_counter()
+        while True:
+            with self._lock:
+                # CONTINUOUS BATCHING: admit into free slots at every step
+                # boundary — a retired sequence's page is reusable on the
+                # very next step, ragged completions never stall the batch
+                while self._waiting and self._free_slot() is not None:
+                    stream, ids, max_new = self._waiting.popleft()
+                    try:
+                        self._admit(stream, ids, max_new)
+                    except Exception as e:  # noqa: BLE001 — fail ONE request
+                        stream._close("error", e)
+                active = any(s is not None for s in self._slots)
+                if not active:
+                    if self._draining and not self._waiting:
+                        break
+                    self._lock.wait(timeout=self._poll_s)
+                    continue
+                try:
+                    self._decode_step()
+                except Exception as e:  # noqa: BLE001 — device fault: fail
+                    # every in-flight request loudly, keep serving new ones
+                    for i, s in enumerate(self._slots):
+                        if s is not None:
+                            self._slots[i] = None
+                            s.stream._close("error", e)
+            if time.perf_counter() - last_emit >= self._emit_interval_s:
+                self._emit_token_counters()
+                last_emit = time.perf_counter()
+        self._emit_token_counters()

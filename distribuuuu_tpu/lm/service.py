@@ -1,0 +1,149 @@
+"""Replica-side LM generation service — the fleet-compatible engine.
+
+``serve_net.py`` builds this instead of the image engine whenever
+``MODEL.ARCH`` is a ``gpt_*`` arch: the SAME length-prefixed socket, the
+SAME stats control frame (so the fleet pool's warm-up gate, health
+probes, and the router's load snapshots work unchanged), plus the NEW
+streaming ctrl frames generation needs:
+
+  request:   ctrl ``op="generate"`` ``{"tokens": [...]}`` or
+             ``{"text": "..."}`` (byte-tokenized server-side),
+             optional ``max_new_tokens``
+  response:  a SEQUENCE of frames on the same connection —
+             ``{"stream": "token", "token": t, "i": k}`` per decoded
+             token, terminated by ``{"stream": "done", "tokens": [...],
+             "text": "...", "reason": ...}`` (or a single
+             ``{"error": ...}`` frame — backpressure keeps the image
+             engine's retry-after shape verbatim).
+
+The router (serve/fleet/router.py) recognizes ``op="generate"`` and
+relays the whole frame sequence from the picked replica to the client —
+tokens stream THROUGH the fleet, they don't buffer in it.
+"""
+
+from __future__ import annotations
+
+import json
+import socket
+
+from distribuuuu_tpu.config import cfg
+from distribuuuu_tpu.lm.generate import GenerateEngine
+from distribuuuu_tpu.lm.tokenizer import ByteTokenizer
+from distribuuuu_tpu.serve import protocol
+from distribuuuu_tpu.serve.admission import EngineClosedError, QueueFullError
+
+
+def engine_from_cfg() -> GenerateEngine:
+    """Build the generation engine from the global cfg: the configured
+    gpt_* arch on one device, weights from ``MODEL.WEIGHTS`` (orbax dir)
+    when set, GENERATE.* tiles AOT-compiled. The single-replica sibling of
+    ``serve/engine.engine_from_cfg``."""
+    import jax
+
+    from distribuuuu_tpu import trainer
+    from distribuuuu_tpu.parallel import mesh as mesh_lib
+
+    if not cfg.MODEL.ARCH.startswith("gpt"):
+        raise ValueError(
+            f"lm.service serves the gpt_* archs, got {cfg.MODEL.ARCH!r} — "
+            "image archs serve through serve/engine.py"
+        )
+    mesh_lib.apply_backend_flags(
+        cfg.DEVICE.DETERMINISTIC or cfg.CUDNN.DETERMINISTIC
+    )
+    mesh_lib.apply_platform(cfg.DEVICE.PLATFORM)
+    devices = jax.local_devices()
+    idx = cfg.SERVE.DEVICE
+    if not 0 <= idx < len(devices):
+        raise ValueError(
+            f"SERVE.DEVICE={idx} out of range: {len(devices)} local devices"
+        )
+    mesh = mesh_lib.build_mesh(data=1, model=1, seq=1, pipe=1,
+                               devices=[devices[idx]])
+    model = trainer.build_model_from_cfg()
+    state = trainer.create_train_state(
+        model, jax.random.key(cfg.RNG_SEED or 0), mesh, cfg.TRAIN.IM_SIZE
+    )
+    if cfg.MODEL.WEIGHTS:
+        state = trainer._with_restored_weights(state, cfg.MODEL.WEIGHTS, model)
+    return GenerateEngine(model, {"params": state.params})
+
+
+def handle_generate(engine: GenerateEngine, ctrl: dict, send) -> None:
+    """Serve one ``op="generate"`` ctrl request: submit, then stream one
+    frame per token and a final done frame through ``send(payload_bytes)``.
+    Error shapes mirror the image protocol (queue_full carries the
+    retry-after hint verbatim)."""
+    tok = ByteTokenizer()
+    if "tokens" in ctrl:
+        ids = [int(t) for t in ctrl["tokens"]]
+    elif "text" in ctrl:
+        ids = [int(t) for t in tok.encode(ctrl["text"])]
+    else:
+        send(json.dumps(
+            {"error": "generate needs 'tokens' or 'text'"}
+        ).encode())
+        return
+    try:
+        stream = engine.submit(ids, ctrl.get("max_new_tokens"))
+    except QueueFullError as e:
+        send(json.dumps({
+            "error": "queue_full",
+            "retry_after_ms": round(e.retry_after_ms, 1),
+        }).encode())
+        return
+    except EngineClosedError:
+        send(json.dumps({"error": "draining"}).encode())
+        return
+    except ValueError as e:
+        send(json.dumps({"error": f"ValueError: {e}"}).encode())
+        return
+    out = []
+    try:
+        for token in stream:
+            out.append(token)
+            send(json.dumps(
+                {"stream": "token", "token": token, "i": len(out) - 1}
+            ).encode())
+    except Exception as e:  # noqa: BLE001 — fail THIS request only
+        send(json.dumps(
+            {"stream": "done", "error": f"{type(e).__name__}: {e}",
+             "tokens": out, "n": len(out)}
+        ).encode())
+        return
+    send(json.dumps({
+        "stream": "done",
+        "tokens": out,
+        "n": len(out),
+        "text": tok.decode(out),
+        "reason": stream.reason,
+    }).encode())
+
+
+def generate_request(host: str, port: int, *, tokens=None, text=None,
+                     max_new_tokens: int | None = None, timeout: float = 60.0):
+    """Client helper (tests/bench/RUNBOOK): send one generate request to a
+    replica OR the fleet router and yield the decoded frames — token
+    frames as they stream, the done frame last. Raises on error frames."""
+    fields = {}
+    if tokens is not None:
+        fields["tokens"] = [int(t) for t in tokens]
+    if text is not None:
+        fields["text"] = text
+    if max_new_tokens is not None:
+        fields["max_new_tokens"] = int(max_new_tokens)
+    with socket.create_connection((host, port), timeout=timeout) as conn:
+        conn.settimeout(timeout)
+        protocol.send_frame(conn, protocol.ctrl_request("generate", **fields))
+        while True:
+            payload = protocol.recv_frame(conn)
+            if payload is None:
+                raise ConnectionResetError(
+                    "peer closed mid-generation (no done frame)"
+                )
+            frame = json.loads(payload)
+            if "error" in frame and "stream" not in frame:
+                raise RuntimeError(f"generate failed: {frame}")
+            yield frame
+            if frame.get("stream") == "done":
+                return

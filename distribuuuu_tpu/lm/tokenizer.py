@@ -1,0 +1,55 @@
+"""Byte-level tokenizer — the in-repo vocabulary (no external download).
+
+Token ids ARE the input bytes (0..255), plus one reserved ``EOS_ID`` = 256
+marking document boundaries in the packed stream (and terminating
+generation). The vocab is PADDED to ``VOCAB_SIZE`` = 320 — a multiple of
+64 — so the embedding/head vocab dim shards evenly over any model-axis
+size (an uneven sharding constraint silently degrades to replication on
+this jax line; the stanza drift gate would flag it). Ids in
+``[257, 320)`` are never produced by :meth:`encode` and decode to nothing.
+
+Identity: :meth:`identity` is the fingerprint token-shard manifests embed
+and the loader/cursor/config validation compare — a resumed run whose
+tokenizer doesn't match the pack refuses with the reason instead of
+silently training on re-interpreted bytes (ISSUE 12 satellite).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+VOCAB_BYTES = 256
+EOS_ID = 256          # document boundary / end-of-sequence
+VOCAB_SIZE = 320      # padded to a multiple of 64 for even TP sharding
+TOKENIZER_NAME = "byte-v1"
+
+
+class ByteTokenizer:
+    """Stateless byte-level codec. All instances are identical — identity
+    lives in the class constants above."""
+
+    name = TOKENIZER_NAME
+    vocab_size = VOCAB_SIZE
+    eos_id = EOS_ID
+
+    def encode(self, text: str | bytes) -> np.ndarray:
+        """Text → uint16 token ids (one per utf-8 byte; no EOS appended —
+        the packer owns document boundaries)."""
+        data = text.encode("utf-8") if isinstance(text, str) else bytes(text)
+        return np.frombuffer(data, np.uint8).astype(np.uint16)
+
+    def decode(self, ids) -> str:
+        """Token ids → text: byte ids render; EOS and padding ids drop.
+        Invalid utf-8 (a generation cut mid-codepoint) replaces rather
+        than raises — streamed output must never crash the client."""
+        arr = np.asarray(ids).reshape(-1)
+        data = bytes(int(i) for i in arr if 0 <= int(i) < VOCAB_BYTES)
+        return data.decode("utf-8", errors="replace")
+
+    def identity(self) -> dict:
+        """The drift fingerprint manifests/cursors embed."""
+        return {
+            "tokenizer": self.name,
+            "vocab_size": self.vocab_size,
+            "eos_id": self.eos_id,
+        }

@@ -38,6 +38,7 @@ from distribuuuu_tpu.models.vit import (  # noqa: F401
     vit_tiny,
     vit_tiny_moe,
 )
+from distribuuuu_tpu.models.gpt import gpt_nano, gpt_nano_moe  # noqa: F401
 
 _REGISTRY = {}
 
@@ -71,6 +72,10 @@ for _fn in (
     vit_small,
     # expert-parallel MoE variant (ops/moe.py over the model axis)
     vit_tiny_moe,
+    # decoder-only LM workload plane (models/gpt.py, ISSUE 12): token
+    # batches, causal attention, next-token CE through the same trainer
+    gpt_nano,
+    gpt_nano_moe,
 ):
     register_model(_fn)
 

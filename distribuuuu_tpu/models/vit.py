@@ -278,6 +278,13 @@ class Attention(nn.Module):
     # blockwise path off-TPU — same exact math).
     attn_impl: str = "xla"
     mesh: Any = None        # required for ring/ulysses
+    # Causal (autoregressive) masking — the decoder-only LM (models/gpt.py)
+    # reuses this exact module with causal=True; position i attends to
+    # positions ≤ i. Every impl honors it: the dense path adds the
+    # triangular mask before softmax, flash/blockwise/ring already take a
+    # ``causal`` flag (ops/*_attention.py). Default False: image ViTs are
+    # bidirectional and their programs are untouched.
+    causal: bool = False
 
     # sequence length at/above which "auto" picks the flash kernel (the
     # kernel wins from ~1-2k tokens on a v5e; dense XLA wins below)
@@ -326,23 +333,28 @@ class Attention(nn.Module):
                 if impl == "ring"
                 else ra.ulysses_attention
             )
-            out = fn(q, k, v, self.mesh, causal=False)
+            out = fn(q, k, v, self.mesh, causal=self.causal)
         elif impl == "flash":
             from distribuuuu_tpu.ops import flash_attention as fa
 
             # Pallas flash kernel on TPU; blockwise scan fallback elsewhere
-            out = fa.flash_attention(q, k, v)
+            out = fa.flash_attention(q, k, v, causal=self.causal)
         elif impl == "blockwise":
             from distribuuuu_tpu.ops import ring_attention as ra
 
             # O(L·chunk) memory — high-resolution single-chip training
-            out = ra.blockwise_attention(q, k, v, causal=False)
+            out = ra.blockwise_attention(q, k, v, causal=self.causal)
         else:
             scale = D ** -0.5
             s = jnp.einsum(
                 "bhqd,bhkd->bhqk",
                 q.astype(jnp.float32), k.astype(jnp.float32),
             ) * scale
+            if self.causal:
+                s = jnp.where(
+                    jnp.tril(jnp.ones((S, S), bool))[None, None],
+                    s, jnp.float32(-1e30),
+                )
             w = jax.nn.softmax(s, axis=-1)
             w = nn.Dropout(self.dropout, deterministic=not train)(w)
             out = jnp.einsum("bhqk,bhkd->bhqd", w, v.astype(jnp.float32))
@@ -367,13 +379,14 @@ class Block(nn.Module):
     moe_axes_bound: bool = False  # inside a pipeline stage's shard_map
     moe_experts_local: int = 0  # PP×EP sharded entry (MoeMlp.experts_local)
     moe_axis: str = "model"  # mesh axis EP rides (MoeMlp.moe_axis)
+    causal: bool = False  # autoregressive masking (models/gpt.py decoder)
 
     @nn.compact
     def __call__(self, x, train: bool = False):
         y = nn.LayerNorm(dtype=self.dtype, param_dtype=jnp.float32)(x)
         x = x + Attention(
             self.dim, self.num_heads, self.dropout, self.dtype,
-            self.attn_impl, self.mesh,
+            self.attn_impl, self.mesh, causal=self.causal,
         )(y, train=train)
         y = nn.LayerNorm(dtype=self.dtype, param_dtype=jnp.float32)(x)
         if self.moe_experts > 0:

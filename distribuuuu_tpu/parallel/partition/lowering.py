@@ -298,12 +298,22 @@ def make_eval_step(model, topk: int):
                 train=False,
             )
         mask = batch["mask"]
+        labels = batch["label"]
+        if logits.ndim == 3:
+            # per-token logits (the LM's [B, S, V]): every token of a
+            # masked-in sequence is one example — flatten the token dim
+            # and broadcast the per-sequence mask over it. The image path
+            # ([B, C]) is byte-identical to before; this is the same
+            # one-eval-step generalization utils/metrics.py applies.
+            mask = jnp.broadcast_to(mask[:, None], labels.shape).reshape(-1)
+            logits = logits.reshape(-1, logits.shape[-1])
+            labels = labels.reshape(-1)
         logp = jax.nn.log_softmax(
             logits.astype(head_dtype(logits.dtype)), axis=-1
         )
-        nll = -jnp.take_along_axis(logp, batch["label"][:, None], axis=-1)[:, 0]
+        nll = -jnp.take_along_axis(logp, labels[:, None], axis=-1)[:, 0]
         _, pred = jax.lax.top_k(logits, topk)  # topk pre-clamped (effective_topk)
-        hits = pred == batch["label"][:, None]
+        hits = pred == labels[:, None]
         c1 = (hits[:, :1].any(axis=1) * mask).sum()
         ck = (hits.any(axis=1) * mask).sum()
         return {

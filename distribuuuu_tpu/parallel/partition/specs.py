@@ -202,6 +202,97 @@ BATCH_TABLE = SpecTable(
 ACTIVATION_SPEC = P("data")
 
 
+def leaf_path(path) -> str:
+    """A tree_flatten_with_path key path as the slash form the spec-table
+    rules are written against (``tok_embed/embedding`` — readable in error
+    messages, stable across jax keystr cosmetics)."""
+    parts = []
+    for k in path:
+        name = getattr(k, "key", None)
+        if name is None:
+            name = getattr(k, "name", None)
+        if name is None:
+            name = getattr(k, "idx", None)
+        parts.append(str(name) if name is not None else str(k))
+    return "/".join(parts)
+
+
+def lm_spec_table(moe_axis: str = "model") -> SpecTable:
+    """The decoder-only LM's per-leaf placement rules (ISSUE 12): one
+    path-pattern declaration per LM parameter family, applied by
+    :func:`state_layout` on top of the flax annotations — which is ALL the
+    new placement machinery an LM needs (zero new lowering code).
+
+    Three leaf families are LM-specific and carry no flax annotation:
+
+      * ``tok_embed/embedding`` ``[V, D]`` — feature-sharded over
+        ``model`` (the same column family every Dense kernel uses, so the
+        embedded activation arrives in the layout the first block's qkv
+        matmul wants);
+      * ``pos_embed`` ``[1, S, D]`` — replicated (tiny, read every step);
+      * ``head/kernel`` ``[D, V]`` — column-parallel over ``model``:
+        vocab-parallel logits, the transpose-consistent layout to the
+        embedding.
+
+    The attention/MLP kernel rules RESTATE what the shared modules already
+    annotate (``tp.column_init``) — ``state_layout`` cross-checks rule
+    against annotation and refuses on drift, so a renamed module or a
+    silently-dropped annotation fails at layout derivation, not as a wrong
+    compiled sharding. Expert tensors keep their ``MoeMlp`` annotations on
+    ``moe_axis`` (restated here so the table documents the full LM family).
+    """
+    return SpecTable(
+        rules=(
+            SpecRule(r"tok_embed/embedding$", P(None, "model")),
+            SpecRule(r"pos_embed$", P()),
+            # head is a models/layers.Dense (wraps nn.Dense as Dense_0)
+            SpecRule(r"head/Dense_0/kernel$", P(None, "model")),
+            SpecRule(r"head/Dense_0/bias$", P("model")),
+            # restatements of the flax annotations (cross-checked):
+            SpecRule(r"Attention_0/Dense_\d+/Dense_0/kernel$",
+                     P(None, "model")),
+            SpecRule(r"Mlp_0/Dense_\d+/Dense_0/kernel$", P(None, "model")),
+            SpecRule(r"MoeMlp_0/(w_in|w_out)$", P(moe_axis)),
+            SpecRule(r"MoeMlp_0/(b_in|b_out)$", P(moe_axis)),
+        ),
+        default=None,  # unmatched leaves keep their annotation/replication
+        strict=False,
+    )
+
+
+def apply_spec_table(base, table: SpecTable, mesh: Mesh):
+    """Overlay a path-pattern table onto a NamedSharding tree (the
+    annotation-derived base): a leaf a rule matches gets the rule's spec;
+    a leaf whose ANNOTATION disagrees with a matching rule raises
+    :class:`SpecConflictError` — the table is a declaration, and a
+    declaration that contradicts the module annotations is drift, not an
+    override. Unmatched leaves pass through untouched."""
+
+    def _stripped(spec) -> tuple:
+        entries = list(tuple(spec) if spec is not None else ())
+        while entries and entries[-1] is None:
+            entries.pop()
+        return tuple(entries)
+
+    flat, treedef = jax.tree_util.tree_flatten_with_path(base)
+    out = []
+    for path, sh in flat:
+        pstr = leaf_path(path)
+        spec = table.spec_for(pstr)
+        if spec is None:
+            out.append(sh)
+            continue
+        annotated = _stripped(sh.spec)
+        if annotated and annotated != _stripped(spec):
+            raise SpecConflictError(
+                f"leaf {pstr}: spec-table rule declares {spec} but the "
+                f"module annotation says {sh.spec} — fix the rule or the "
+                "annotation; they are one declaration"
+            )
+        out.append(NamedSharding(mesh, spec))
+    return jax.tree.unflatten(treedef, out)
+
+
 def batch_spec(key: str, *, leading_dims: int = 0) -> P:
     """Spec for batch leaf ``key`` with ``leading_dims`` extra leading
     dims (fold / accum stacking) before the batch dim."""
@@ -222,15 +313,26 @@ def base_specs(abstract_variables) -> Any:
     return nn.get_partition_spec(abstract_variables)
 
 
+def model_dummy_input(model, im_size: int):
+    """The init-time dummy for a model: the model's own declaration
+    (``model.dummy_input()`` — token models can't eat images, models/gpt.py)
+    when present, the standard image dummy otherwise. The ONE place init
+    shape assumptions live (abstract_state + trainer.create_train_state)."""
+    import jax.numpy as jnp
+
+    fn = getattr(model, "dummy_input", None)
+    if fn is not None:
+        return fn()
+    return jnp.ones((2, im_size, im_size, 3), jnp.float32)
+
+
 def abstract_state(model, im_size: int):
     """``jax.eval_shape`` of ``model.init`` on the standard dummy input —
     the shape/annotation source for every layout derivation (never runs
     compute)."""
     import functools
 
-    import jax.numpy as jnp
-
-    dummy = jnp.ones((2, im_size, im_size, 3), jnp.float32)
+    dummy = model_dummy_input(model, im_size)
     return jax.eval_shape(
         functools.partial(model.init, train=False), jax.random.key(0), dummy
     )
@@ -260,6 +362,15 @@ def state_layout(model, mesh: Mesh, im_size: int, zero_stage: int) -> dict:
 
     abstract = abstract_state(model, im_size)
     base = tp.param_shardings(mesh, abstract)["params"]
+    # models carrying a path-pattern spec table (the LM — models/gpt.py
+    # ``param_spec_table``) overlay it here: unannotated LM leaves
+    # (embedding/positions/head) get their declared placement, annotated
+    # leaves are cross-checked against the matching rule. The transforms
+    # and validation below are untouched — this is declaration input, not
+    # a new lowering path.
+    table_fn = getattr(model, "param_spec_table", None)
+    if table_fn is not None:
+        base = apply_spec_table(base, table_fn(), mesh)
     axis_sizes = {k: int(v) for k, v in dict(mesh.shape).items()}
     stage = int(zero_stage)
     if not stage:

@@ -320,6 +320,96 @@ class Router:
             {"error": "no_routable_replicas", "retry_after_ms": 1000.0}
         ).encode()
 
+    def dispatch_stream(self, payload: bytes, client: socket.socket) -> None:
+        """Route one STREAMING request (the LM ``op="generate"`` ctrl
+        frame, lm/service.py): pick a replica exactly like ``dispatch``,
+        then relay its whole frame sequence — token frames as they decode,
+        the done frame last — straight to the client. Tokens stream
+        through the router; nothing buffers.
+
+        Retry semantics are necessarily narrower than ``dispatch``'s: a
+        transport failure BEFORE the first frame reroutes (nothing
+        reached the client — still idempotent); after a partial stream
+        the client gets a done frame carrying the error (re-running the
+        prefix would emit duplicate tokens). Busy rejections pass through
+        verbatim when every replica rejects, the admission contract."""
+        t0 = time.perf_counter()
+        tried: set[int] = set()
+        last_busy: bytes | None = None
+        while True:
+            rep = self._pick(tried)
+            if rep is None:
+                break
+            with self._lock:
+                rep.inflight += 1
+            conn = None
+            streamed = 0
+            try:
+                conn = socket.create_connection(
+                    rep.addr, timeout=self.request_timeout_s
+                )
+                conn.settimeout(self.request_timeout_s)
+                protocol.send_frame(conn, payload)
+                busy = False
+                while True:
+                    frame = protocol.recv_frame(conn)
+                    if frame is None:
+                        raise ConnectionResetError(
+                            f"replica {rep.id} closed mid-stream"
+                        )
+                    if streamed == 0 and frame.startswith(_ERROR_PREFIX):
+                        try:
+                            err = json.loads(frame).get("error")
+                        except (ValueError, AttributeError):
+                            err = None
+                        if err in _BUSY_ERRORS:
+                            last_busy = frame
+                            tried.add(rep.id)
+                            busy = True
+                            break  # try the next replica
+                    protocol.send_frame(client, frame)
+                    streamed += 1
+                    if (
+                        b'"stream": "done"' in frame[:64]
+                        or frame.startswith(_ERROR_PREFIX)
+                    ):
+                        self._observe(rep, time.perf_counter() - t0)
+                        self.registry.counter("fleet.streams").inc(1)
+                        return
+                if busy:
+                    continue  # busy rejection: next replica
+            except (OSError, ValueError) as e:
+                self._note_failure(rep)
+                self.registry.counter("fleet.rerouted").inc(1)
+                tried.add(rep.id)
+                if streamed:
+                    # tokens already reached the client — re-running the
+                    # request would duplicate them; fail THIS stream
+                    try:
+                        protocol.send_frame(client, json.dumps({
+                            "stream": "done",
+                            "error": f"replica failed mid-stream: "
+                                     f"{type(e).__name__}: {e}",
+                            "n": streamed - 1,
+                        }).encode())
+                    except OSError:
+                        pass
+                    return
+                continue
+            finally:
+                with self._lock:
+                    rep.inflight -= 1
+                if conn is not None:
+                    conn.close()
+        if last_busy is not None:
+            self.registry.counter("fleet.rejected").inc(1)
+            protocol.send_frame(client, last_busy)
+            return
+        self.registry.counter("fleet.unroutable").inc(1)
+        protocol.send_frame(client, json.dumps(
+            {"error": "no_routable_replicas", "retry_after_ms": 1000.0}
+        ).encode())
+
     # -- observability -----------------------------------------------------
     def window_stats(self, window_s: float) -> dict:
         """Latency percentiles over the trailing ``window_s`` plus total
@@ -410,6 +500,14 @@ class Router:
                     if payload.startswith(protocol.CTRL_MAGIC[:1]) else None
                 )
                 if ctrl is not None:
+                    if ctrl.get("op") == "generate":
+                        # streaming passthrough: the replica's whole frame
+                        # sequence relays on this client connection
+                        try:
+                            self.dispatch_stream(payload, conn)
+                        except OSError:
+                            return
+                        continue
                     if ctrl.get("op") == "stats":
                         snap = self.stats()
                         # a stats request carrying window_s also gets the

@@ -169,6 +169,30 @@ def _handle_conn(engine, conn: socket.socket, transform, topk: int) -> None:
             if ctrl is not None:
                 if ctrl.get("op") == "stats":
                     resp = replica_stats(engine)
+                elif ctrl.get("op") == "generate":
+                    # the LM generation plane's STREAMING ctrl frame
+                    # (lm/service.py): one token frame per decode step on
+                    # this same connection, a done frame last — the fleet
+                    # router relays the whole sequence
+                    if not hasattr(engine, "submit") or not hasattr(
+                        engine, "prompt_len"
+                    ):
+                        resp = {
+                            "error": "not_a_generation_replica",
+                            "detail": "this replica serves an image arch; "
+                                      "generate needs a gpt_* MODEL.ARCH",
+                        }
+                    else:
+                        from distribuuuu_tpu.lm import service as lm_service
+
+                        try:
+                            lm_service.handle_generate(
+                                engine, ctrl,
+                                lambda p: send_frame(conn, p),
+                            )
+                        except OSError:
+                            return
+                        continue
                 else:
                     resp = {"error": f"unknown control op {ctrl.get('op')!r}"}
                 try:

@@ -86,6 +86,21 @@ KINDS: dict[str, frozenset] = {
         {"v", "label", "phase", "arithmetic_intensity", "ridge_intensity",
          "bound", "source"}
     ),
+    # -- LM workload plane (lm/generate.py + lm/service.py, ISSUE 12) ----
+    # cumulative token counters of a generation engine (interval + drain):
+    # run_report's tokens/s source
+    "lm.tokens": frozenset(
+        {"prompt_tokens", "new_tokens", "decode_steps", "elapsed_s"}
+    ),
+    # one per request admission into a continuous-batching slot
+    "gen.admit": frozenset({"slot", "prompt_tokens", "request"}),
+    # one per prompt prefill (the compute-bound half)
+    "gen.prefill": frozenset({"tokens", "tile", "ms"}),
+    # one per decode step over the live (batch, cache-len) tile (the
+    # memory-bound half — run_report's decode p50/p99 source)
+    "gen.decode": frozenset({"active", "tile_b", "tile_c", "ms"}),
+    # one per sequence retirement (reason: eos/max_new_tokens/cache_full)
+    "gen.retire": frozenset({"slot", "new_tokens", "reason", "request"}),
     # -- live observability plane (telemetry/live.py, tools/monitor.py) --
     # one windowed aggregate per monitor tick (MONITOR.jsonl)
     "monitor.snapshot": frozenset(

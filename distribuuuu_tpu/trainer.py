@@ -126,8 +126,9 @@ def build_model_from_cfg(topology=None):
         num_classes=cfg.MODEL.NUM_CLASSES,
         dtype=resolve_dtype(cfg.DEVICE.COMPUTE_DTYPE),
     )
-    if not cfg.MODEL.ARCH.startswith("vit"):
-        # every non-ViT arch in the zoo normalizes with BN
+    if not cfg.MODEL.ARCH.startswith(("vit", "gpt")):
+        # every CNN arch in the zoo normalizes with BN (the transformer
+        # families — ViT, GPT — are LayerNorm-only)
         kwargs["bn_group"] = bn_group_from_cfg()
     if cfg.MODEL.ARCH.startswith(
         ("resnet", "resnext", "wide_resnet", "botnet", "densenet")
@@ -152,6 +153,31 @@ def build_model_from_cfg(topology=None):
         fmap = max(1, -(-cfg.TRAIN.IM_SIZE // 16))
         kwargs["fmap_size"] = (fmap, fmap)
         kwargs["attn_impl"] = cfg.DEVICE.ATTN_IMPL
+    if cfg.MODEL.ARCH.startswith("gpt"):
+        # decoder-only LM (models/gpt.py): token batches, causal attention,
+        # context length from LM.SEQ_LEN, vocab = MODEL.NUM_CLASSES (the
+        # tokenizer's size — token-shard manifests are checked against it).
+        # Same MoE knob plumbing as the ViT family; the partition layer
+        # places everything from the LM spec-table rules + annotations.
+        kwargs["seq_len"] = int(cfg.LM.SEQ_LEN)
+        if cfg.DEVICE.ATTN_IMPL in ("flash", "blockwise"):
+            kwargs["attn_impl"] = cfg.DEVICE.ATTN_IMPL
+        elif cfg.DEVICE.ATTN_IMPL not in ("auto", "xla"):
+            raise ValueError(
+                f"DEVICE.ATTN_IMPL={cfg.DEVICE.ATTN_IMPL!r}: gpt archs "
+                "accept 'auto'/'xla' (dense causal), 'flash', or "
+                "'blockwise' — sequence-sharded ring attention for the LM "
+                "is future work (MESH.SEQ must stay 1)"
+            )
+        if cfg.MODEL.ARCH.endswith("_moe"):
+            kwargs["moe_experts"] = cfg.MODEL.MOE.NUM_EXPERTS
+            kwargs["moe_top_k"] = cfg.MODEL.MOE.TOP_K
+            kwargs["moe_every"] = cfg.MODEL.MOE.EVERY
+            kwargs["moe_impl"] = cfg.MODEL.MOE.IMPL
+            kwargs["moe_capacity_factor"] = cfg.MODEL.MOE.CAPACITY_FACTOR
+            kwargs["moe_axis"] = topology.moe_axis()
+            if topology.expert > 1 or topology.model > 1:
+                kwargs["mesh"] = mesh_lib.mesh_from_cfg(cfg)
     if cfg.MODEL.ARCH.startswith("vit"):
         # seq axis populated means sequence-sharded attention: route
         # through ring attention over the seq axis. On a single chip,
@@ -215,7 +241,9 @@ def create_train_state(model, key, mesh, im_size: int, layout=None) -> TrainStat
     shardings = layout or _state_layout(model, mesh, im_size)
     optimizer = construct_optimizer()
     repl = sharding_lib.replicate(mesh)
-    dummy = jnp.ones((2, im_size, im_size, 3), jnp.float32)
+    # the model's declared init dummy (token models declare their own —
+    # models/gpt.py dummy_input; image models get the standard image dummy)
+    dummy = partition_specs.model_dummy_input(model, im_size)
 
     def init_all(key):
         variables = flax.linen.meta.unbox(model.init(key, dummy, train=False))
@@ -352,8 +380,16 @@ def _capture_step_cost(step_fn, state, batch, *, label: str, phase: str,
         return
     # every leading dim of the image leaf is batch-like: (batch,...) /
     # (fold, batch, ...) / (fold, accum, micro, ...) — their product is
-    # the images per compiled call
-    lead = batch["image"].shape[:-3]
+    # the examples per compiled call. Token batches (the LM — integer
+    # [..., seq]) have ONE trailing payload dim instead of the image's
+    # three; "images" then counts sequences (run_report's lm section
+    # multiplies by seq len for tokens/s).
+    img = batch["image"]
+    lead = (
+        img.shape[:-1]
+        if jnp.issubdtype(img.dtype, jnp.integer)
+        else img.shape[:-3]
+    )
     images_per_call = 1
     for d in lead:
         images_per_call *= int(d)
@@ -1026,7 +1062,10 @@ def check_batch_geometry(mesh, eval_only: bool = False):
                     f"{pipe_mb} GPipe microbatches (MESH.MICROBATCH, 0 → "
                     "2×PIPE); adjust TRAIN.BATCH_SIZE or MESH.MICROBATCH"
                 )
-        bn_g = 0 if cfg.MODEL.ARCH.startswith("vit") else bn_group_from_cfg()
+        bn_g = (
+            0 if cfg.MODEL.ARCH.startswith(("vit", "gpt"))
+            else bn_group_from_cfg()
+        )
         if bn_g > 0 and global_micro > bn_g and global_micro % bn_g:
             # _BNCore would raise the same condition at first train-step trace
             raise ValueError(

@@ -20,8 +20,11 @@ def accuracy(logits, targets, topk=(1,)):
     (semantics: utils.py:265-277).
 
     Args:
-        logits: [batch, classes] float array.
-        targets: [batch] int class labels.
+        logits: [..., classes] float array — ``[batch, classes]`` for the
+            image zoo, ``[batch, seq, vocab]`` for the LM (every leading
+            dim is an example dim; the mean runs over all of them, so the
+            LM reading is next-token accuracy per token).
+        targets: [...] int class labels, matching the leading dims.
         topk: tuple of k values, each ≤ the class count (the trainer clamps
             once via ``effective_topk``; see trainer.py).
     Returns:
@@ -31,10 +34,11 @@ def accuracy(logits, targets, topk=(1,)):
     assert maxk <= logits.shape[-1], (
         f"top-{maxk} needs ≥{maxk} classes, got {logits.shape[-1]}"
     )
-    _, pred = jax.lax.top_k(logits, maxk)  # [batch, maxk], ordered
-    hits = pred == targets[:, None]
+    _, pred = jax.lax.top_k(logits, maxk)  # [..., maxk], ordered
+    hits = pred == targets[..., None]
     return [
-        hits[:, :k].any(axis=1).mean(dtype=jnp.float32) * 100.0 for k in topk
+        hits[..., :k].any(axis=-1).mean(dtype=jnp.float32) * 100.0
+        for k in topk
     ]
 
 
@@ -42,11 +46,16 @@ def cross_entropy(logits, targets):
     """Mean softmax cross-entropy with integer labels (≙ nn.CrossEntropyLoss,
     ref: trainer.py:139). Loss math in fp32 regardless of a low-precision
     compute dtype — promoted, not hard-cast, so f64 logits (the x64
-    equivalence tests) are not re-rounded at the loss boundary."""
+    equivalence tests) are not re-rounded at the loss boundary.
+
+    Leading dims are generic: ``[B, C]`` image logits and ``[B, S, V]``
+    per-token LM logits both reduce to ONE mean over every example dim —
+    the next-token CE task head is this same function, no LM-specific
+    loss path exists (ISSUE 12)."""
     from distribuuuu_tpu.models.layers import head_dtype
 
     logp = jax.nn.log_softmax(logits.astype(head_dtype(logits.dtype)), axis=-1)
-    nll = -jnp.take_along_axis(logp, targets[:, None], axis=-1)[:, 0]
+    nll = -jnp.take_along_axis(logp, targets[..., None], axis=-1)[..., 0]
     return nll.mean()
 
 

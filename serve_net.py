@@ -92,13 +92,34 @@ def main(argv=None):
     from distribuuuu_tpu.asyncplane import compile_cache
 
     compile_cache.setup_from_cfg(cfg)
-    engine = engine_from_cfg()
-    logger.info(
-        "serving %s: buckets %s compiled (%d shapes), max_wait %.1f ms, "
-        "queue bound %d",
-        cfg.MODEL.ARCH, engine.buckets, engine.n_compiles,
-        cfg.SERVE.MAX_WAIT_MS, cfg.SERVE.MAX_QUEUE,
-    )
+    if cfg.MODEL.ARCH.startswith("gpt"):
+        # the LM generation plane (lm/service.py): KV-cache continuous
+        # batching behind the SAME socket/stats/fleet protocol; generate
+        # requests arrive as streaming ctrl frames
+        from distribuuuu_tpu.lm import service as lm_service
+
+        if args.batch_input is not None:
+            raise SystemExit(
+                "--batch-input is the image engine's one-shot mode; "
+                "drive a gpt_* replica with generate ctrl frames "
+                "(lm/service.generate_request) instead"
+            )
+        engine = lm_service.engine_from_cfg()
+        logger.info(
+            "generating with %s: %d tile executables compiled "
+            "(decode tiles %s), %d slots, prompt<=%d, max_new=%d",
+            cfg.MODEL.ARCH, engine.n_compiles,
+            sorted(engine._decode_exec), engine.n_slots,
+            engine.prompt_len, engine.max_new,
+        )
+    else:
+        engine = engine_from_cfg()
+        logger.info(
+            "serving %s: buckets %s compiled (%d shapes), max_wait %.1f ms, "
+            "queue bound %d",
+            cfg.MODEL.ARCH, engine.buckets, engine.n_compiles,
+            cfg.SERVE.MAX_WAIT_MS, cfg.SERVE.MAX_QUEUE,
+        )
     engine.start()
 
     if args.batch_input is not None:

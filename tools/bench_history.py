@@ -89,13 +89,40 @@ def index_asyncplane(path: str, doc: dict, series: dict) -> None:
            seq.get("fence_wait_s"), "s")
 
 
+def index_lm(path: str, doc: dict, series: dict) -> None:
+    """BENCH_r08+ ``lm`` section (tools/lm_bench.py): LM train tokens/s
+    and per-tile prefill/decode latency. Series names deliberately avoid
+    the ``images_per_sec``/``img_per_sec`` throughput-gate patterns (the
+    PR 8 clobbering lesson) — CPU-container token rates must never become
+    the img/s regression reference."""
+    lm = doc.get("lm") or {}
+    rnd, src = _round_of(path), os.path.basename(path)
+    train = lm.get("train") or {}
+    _point(series, "lm_train_tokens_per_s", rnd, src,
+           train.get("tokens_per_s"), "tok/s")
+    _point(series, "lm_train_step_ms", rnd, src, train.get("step_ms"), "ms")
+    gen = lm.get("generate") or {}
+    _point(series, "lm_generate_tokens_per_s", rnd, src,
+           gen.get("tokens_per_s"), "tok/s")
+    for row in gen.get("decode") or []:
+        _point(series,
+               f"lm_decode_step_ms_b{row['tile_b']}_c{row['tile_c']}",
+               rnd, src, row.get("ms_per_step"), "ms")
+    for row in gen.get("prefill") or []:
+        _point(series, f"lm_prefill_ms_p{row['tile']}", rnd, src,
+               row.get("ms"), "ms")
+
+
 def index_train_bench(path: str, series: dict) -> None:
     """BENCH_r*.json: the ``parsed`` block is the metric (r06+ may
-    instead carry an ``asyncplane`` section — indexed separately)."""
+    instead carry an ``asyncplane`` section, r08+ an ``lm`` section —
+    indexed separately)."""
     with open(path) as f:
         doc = json.load(f)
     if doc.get("asyncplane"):
         index_asyncplane(path, doc, series)
+    if doc.get("lm"):
+        index_lm(path, doc, series)
     parsed = doc.get("parsed") or {}
     if "metric" in parsed and "value" in parsed:
         _point(series, str(parsed["metric"]), _round_of(path),

@@ -131,6 +131,12 @@ def build_arch(arch: str, yaml_path: str, *, batch: int, with_memory: bool,
     config.reset_cfg()
     cfg.merge_from_file(yaml_path)  # the exact train_net merge path
     im = cfg.TRAIN.IM_SIZE
+    # the ledger measures the ARCH on the attached device(s); a YAML's
+    # multi-axis MESH stanza (gpt_nano_moe's dp2·tp2·ep2) is the stanza
+    # gate's territory and cannot resolve on fewer devices
+    for axis, default in (("DATA", -1), ("MODEL", 1), ("SEQ", 1),
+                          ("PIPE", 1), ("EXPERT", 1)):
+        cfg.MESH[axis] = default
     mesh = mesh_lib.build_mesh()
     model = trainer.build_model_from_cfg()
     layout = trainer._state_layout(model, mesh, im)
@@ -144,13 +150,29 @@ def build_arch(arch: str, yaml_path: str, *, batch: int, with_memory: bool,
     eval_step = trainer.make_eval_step(model, trainer.effective_topk())
 
     rng = np.random.default_rng(0)
-    batch_tree = sharding_lib.shard_batch(mesh, {
-        "image": rng.standard_normal((batch, im, im, 3)).astype(np.float32),
-        "label": rng.integers(
-            0, cfg.MODEL.NUM_CLASSES, (batch,)
-        ).astype(np.int32),
-        "mask": np.ones((batch,), np.float32),
-    })
+    if arch.startswith("gpt"):
+        # the LM species eats token batches (ISSUE 12); "images" counts
+        # sequences — the lm bench converts to tokens/s with the seq len
+        S = int(cfg.LM.SEQ_LEN)
+        batch_tree = sharding_lib.shard_batch(mesh, {
+            "image": rng.integers(
+                0, cfg.MODEL.NUM_CLASSES, (batch, S)
+            ).astype(np.int32),
+            "label": rng.integers(
+                0, cfg.MODEL.NUM_CLASSES, (batch, S)
+            ).astype(np.int32),
+            "mask": np.ones((batch,), np.float32),
+        })
+    else:
+        batch_tree = sharding_lib.shard_batch(mesh, {
+            "image": rng.standard_normal(
+                (batch, im, im, 3)
+            ).astype(np.float32),
+            "label": rng.integers(
+                0, cfg.MODEL.NUM_CLASSES, (batch,)
+            ).astype(np.int32),
+            "mask": np.ones((batch,), np.float32),
+        })
     peaks = costmodel.peaks_for()
     n_dev = len(jax.devices())
 
@@ -242,6 +264,11 @@ def main(argv=None) -> int:
                     help="arch for the serve-bucket ledger ('' = skip)")
     ap.add_argument("--out", default=None,
                     help="destination (default {repo}/COSTMODEL_r01.json)")
+    ap.add_argument("--update", action="store_true",
+                    help="merge the selected arch entries into an existing "
+                         "artifact instead of rewriting it (append a new "
+                         "arch without re-measuring the whole zoo; "
+                         "unselected entries keep their committed numbers)")
     args = ap.parse_args(argv)
 
     subset = set(args.arch.split(",")) if args.arch else None
@@ -284,6 +311,13 @@ def main(argv=None) -> int:
         print(f"serve buckets ({args.serve_arch}): "
               + ", ".join(doc["serve"]["buckets"]))
     out = args.out or os.path.join(repo, "COSTMODEL_r01.json")
+    if args.update and os.path.exists(out):
+        with open(out) as f:
+            existing = json.load(f)
+        existing["archs"].update(doc["archs"])
+        if "serve" in doc:
+            existing["serve"] = doc["serve"]
+        doc = existing
     with open(out, "w") as f:
         json.dump(doc, f, indent=1)
     print(f"cost-model ledger ({len(doc['archs'])} arch(s)) -> {out}")

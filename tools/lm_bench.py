@@ -1,0 +1,251 @@
+"""LM workload-plane bench → BENCH_r08.json (ISSUE 12 satellite).
+
+Two halves, matching the plane's two phases:
+
+  * **train** — pack a deterministic synthetic byte corpus into token
+    shards (tools/make_token_shards.py machinery), lower ``gpt_nano``
+    through the REAL partition lowering, and time steady-state train
+    steps → tokens/s (= sequences/s × LM.SEQ_LEN, counted after a warmup
+    step so compile time never pollutes the rate);
+  * **generate** — build the KV-cache engine (lm/generate.py), time each
+    prefill prompt tile and each (batch, cache-len) decode tile at
+    steady state, and run a short continuous-batching burst for the
+    end-to-end tokens/s.
+
+Series names are indexed by tools/bench_history.py ``index_lm`` and
+deliberately avoid the ``images_per_sec`` throughput-gate patterns (the
+PR 8 clobbering lesson): CPU token rates are trajectory data, never the
+img/s regression reference.
+
+    python tools/lm_bench.py [--json-out BENCH_r08.json] [--steps 8]
+        [--seq-len 64] [--arch gpt_nano]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import tempfile
+import time
+
+import _path  # noqa: F401  — repo root onto sys.path for the package import
+
+
+def _synthetic_corpus(n_docs: int = 24, words: int = 300):
+    import numpy as np
+
+    rng = np.random.default_rng(7)
+    for _ in range(n_docs):
+        yield " ".join(
+            f"tok{rng.integers(0, 200)}" for _ in range(words)
+        ).encode()
+
+
+def bench_train(arch: str, seq_len: int, steps: int, batch: int) -> dict:
+    import jax
+    import numpy as np
+
+    from distribuuuu_tpu.config import cfg
+    from distribuuuu_tpu import trainer
+    from distribuuuu_tpu.data import construct_train_loader
+    from distribuuuu_tpu.data.shards import tokens as token_shards
+    from distribuuuu_tpu.parallel import mesh as mesh_lib
+    from distribuuuu_tpu.parallel.partition import lowering, topology
+    from distribuuuu_tpu.utils.optim import construct_optimizer
+
+    td = tempfile.mkdtemp(prefix="lm_bench_")
+    split_dir = os.path.join(td, "train")
+    token_shards.write_token_shards(
+        split_dir,
+        token_shards.pack_token_stream(_synthetic_corpus(), seq_len),
+        seq_len, source="lm_bench synthetic",
+    )
+    cfg.MODEL.ARCH = arch
+    cfg.MODEL.NUM_CLASSES = 320
+    cfg.DATA.FORMAT = "tokens"
+    cfg.LM.SEQ_LEN = seq_len
+    cfg.TRAIN.DATASET = td
+    cfg.TRAIN.BATCH_SIZE = batch
+    topo = topology.from_cfg(cfg)
+    mesh = mesh_lib.mesh_from_cfg(cfg)
+    model = trainer.build_model_from_cfg(topo)
+    low = lowering.lower(
+        model, construct_optimizer(), topk=5, mesh=mesh, topology=topo,
+        im_size=cfg.TRAIN.IM_SIZE,
+    )
+    state = low.init_state(jax.random.key(0), cfg.TRAIN.IM_SIZE)
+    loader = construct_train_loader()
+    loader.set_epoch(0)
+    it = iter(loader)
+    seqs_per_step = None
+    t_steady = None
+    n_timed = 0
+    for i in range(steps + 1):
+        try:
+            hb = next(it)
+        except StopIteration:
+            loader.set_epoch(i)
+            it = iter(loader)
+            hb = next(it)
+        seqs_per_step = int(np.shape(hb["image"])[0])
+        db = low.put_batch(hb)
+        state, metrics = low.train_step(state, db)
+        if i == 0:
+            jax.block_until_ready(state.params)  # warmup: compile excluded
+            t_steady = time.perf_counter()
+        else:
+            n_timed += 1
+    jax.block_until_ready(state.params)
+    wall = time.perf_counter() - t_steady
+    step_s = wall / max(1, n_timed)
+    return {
+        "arch": arch,
+        "seq_len": seq_len,
+        "batch_seqs": seqs_per_step,
+        "steps_timed": n_timed,
+        "step_ms": round(step_s * 1e3, 3),
+        "seqs_per_s": round(seqs_per_step / step_s, 3),
+        "tokens_per_s": round(seqs_per_step * seq_len / step_s, 1),
+        "final_loss": round(float(metrics["loss"]), 4),
+    }
+
+
+def bench_generate(arch: str, seq_len: int) -> dict:
+    import jax
+    import numpy as np
+
+    from distribuuuu_tpu.config import cfg
+    from distribuuuu_tpu import models
+    from distribuuuu_tpu.lm.generate import GenerateEngine
+    from distribuuuu_tpu.models.layers import resolve_dtype
+
+    cfg.GENERATE.PROMPT_LEN = min(32, seq_len // 2)
+    cfg.GENERATE.MAX_NEW_TOKENS = min(32, seq_len // 2)
+    cfg.GENERATE.BATCH_TILES = [1, 2, 4]
+    cfg.GENERATE.CACHE_TILES = [seq_len]
+    model = models.build_model(
+        arch, num_classes=320, seq_len=seq_len,
+        dtype=resolve_dtype(cfg.DEVICE.COMPUTE_DTYPE),
+    )
+    params = model.init(
+        jax.random.key(0), jax.numpy.zeros((1, 8), "int32"), train=False
+    )["params"]
+    t0 = time.perf_counter()
+    eng = GenerateEngine(model, {"params": params})
+    compile_s = time.perf_counter() - t0
+    rng = np.random.default_rng(3)
+
+    # per-tile steady-state latencies, measured directly on the AOT
+    # executables (warm call first, then the timed mean)
+    prefill_rows = []
+    for p, ex in sorted(eng._prefill_exec.items()):
+        toks = jax.numpy.asarray(rng.integers(0, 256, (1, p)), "int32")
+        jax.block_until_ready(ex(eng._variables, toks))
+        t0 = time.perf_counter()
+        n = 5
+        for _ in range(n):
+            out = ex(eng._variables, toks)
+        jax.block_until_ready(out)
+        prefill_rows.append({
+            "tile": p,
+            "ms": round((time.perf_counter() - t0) / n * 1e3, 3),
+        })
+    decode_rows = []
+    for (b, c), ex in sorted(eng._decode_exec.items()):
+        cache = eng._zero_cache(b, c)
+        toks = jax.numpy.asarray(rng.integers(0, 256, (b,)), "int32")
+        lens = jax.numpy.asarray(rng.integers(1, c // 2, (b,)), "int32")
+        logits, cache = ex(eng._variables, toks, lens, cache)
+        jax.block_until_ready(logits)
+        t0 = time.perf_counter()
+        n = 10
+        for _ in range(n):
+            logits, cache = ex(eng._variables, toks, lens, cache)
+        jax.block_until_ready(logits)
+        ms = (time.perf_counter() - t0) / n * 1e3
+        decode_rows.append({
+            "tile_b": b, "tile_c": c, "ms_per_step": round(ms, 3),
+            "tokens_per_s_at_tile": round(b / (ms / 1e3), 1),
+        })
+
+    # end-to-end continuous-batching burst through the scheduler
+    eng.start()
+    t0 = time.perf_counter()
+    streams = [
+        eng.submit(
+            rng.integers(0, 256, (4 + 3 * (i % 5),)).astype(np.int32),
+            max_new_tokens=cfg.GENERATE.MAX_NEW_TOKENS,
+        )
+        for i in range(12)
+    ]
+    total = sum(len(s.result(timeout=300.0)) for s in streams)
+    burst_s = time.perf_counter() - t0
+    stats = eng.stats()
+    eng.drain()
+    return {
+        "arch": arch,
+        "compile_s": round(compile_s, 2),
+        "n_executables": eng.n_compiles,
+        "prefill": prefill_rows,
+        "decode": decode_rows,
+        "burst_requests": len(streams),
+        "burst_new_tokens": total,
+        "tokens_per_s": round(total / burst_s, 2),
+        "decode_p50_ms": stats["decode_p50_ms"],
+        "decode_p99_ms": stats["decode_p99_ms"],
+        "prefill_p50_ms": stats["prefill_p50_ms"],
+        "prefill_p99_ms": stats["prefill_p99_ms"],
+    }
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--json-out", default=None,
+                    help="destination (default {repo}/BENCH_r08.json)")
+    ap.add_argument("--arch", default="gpt_nano")
+    ap.add_argument("--seq-len", type=int, default=64)
+    ap.add_argument("--steps", type=int, default=8)
+    ap.add_argument("--batch", type=int, default=8)
+    args = ap.parse_args(argv)
+
+    import jax
+
+    from distribuuuu_tpu import config
+
+    config.reset_cfg()
+    from distribuuuu_tpu.config import cfg
+
+    cfg.TELEMETRY.ENABLED = False  # bench times raw dispatch
+    platform = jax.devices()[0].platform
+    train = bench_train(args.arch, args.seq_len, args.steps, args.batch)
+    print(f"# train: {train['tokens_per_s']} tokens/s "
+          f"({train['step_ms']} ms/step x {train['batch_seqs']} seqs)",
+          flush=True)
+    gen = bench_generate(args.arch, args.seq_len)
+    print(f"# generate: {gen['tokens_per_s']} tokens/s e2e, decode p50 "
+          f"{gen['decode_p50_ms']} ms", flush=True)
+    doc = {
+        "schema": 1,
+        "generated_by": "tools/lm_bench.py",
+        "platform": platform,
+        "note": (
+            "CPU container numbers (1 physical core) — trajectory data "
+            "for the LM plane, never an img/s reference (series names "
+            "avoid the throughput-gate patterns)"
+        ),
+        "lm": {"train": train, "generate": gen},
+    }
+    out = args.json_out or os.path.join(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        "BENCH_r08.json",
+    )
+    with open(out, "w") as f:
+        json.dump(doc, f, indent=1)
+    print(f"# wrote {out}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,151 @@
+"""Pack a text corpus into packed-sequence TOKEN shards; certify a pack.
+
+The LM half of tools/make_shards.py (ISSUE 12): documents are tokenized
+by the in-repo byte-level tokenizer (lm/tokenizer.py — no external vocab
+download), joined with one EOS document-boundary token each, and the
+stream is cut into fixed ``--pack-len + 1``-token records (input =
+``[:-1]``, next-token targets = ``[1:]``) inside the EXISTING shard
+container (data/shards/format.py) — CRC'd records, index footer,
+atomically-committed manifest carrying ``kind="tokens"``, the pack
+length, and the tokenizer identity fingerprint.
+
+Corpus shapes accepted by ``--src``:
+
+  * a directory — every ``*.txt`` file (recursive, sorted) is one
+    document;
+  * a single file — each blank-line-separated paragraph is one document.
+
+``--val-frac`` holds out every k-th document into the ``val`` split (deterministic,
+no RNG — repacking reproduces the same split).
+
+Pack:
+
+    python tools/make_token_shards.py --src ./corpus --out ./data/tokens \
+        [--pack-len 256] [--shard-mb 4] [--val-frac 0.05]
+
+Verify (the shared shard certifier — size, sha256, footer, CRC walk):
+
+    python tools/make_token_shards.py --out ./data/tokens --verify
+
+Then train with:
+
+    python train_net.py --cfg config/gpt_nano.yaml \
+        TRAIN.DATASET ./data/tokens TEST.DATASET ./data/tokens
+
+Exit status is nonzero when --verify finds any problem.
+"""
+
+from __future__ import annotations
+
+import argparse
+import glob
+import json
+import os
+import sys
+import time
+
+import _path  # noqa: F401  — repo root onto sys.path for the package import
+
+
+def iter_documents(src: str):
+    """Documents from a corpus path (see module docstring)."""
+    if os.path.isdir(src):
+        paths = sorted(
+            glob.glob(os.path.join(src, "**", "*.txt"), recursive=True)
+        )
+        if not paths:
+            raise SystemExit(f"no *.txt files under {src}")
+        for p in paths:
+            with open(p, "rb") as f:
+                yield f.read()
+        return
+    with open(src, "rb") as f:
+        text = f.read()
+    for para in text.split(b"\n\n"):
+        if para.strip():
+            yield para
+
+
+def main():
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--src", default="",
+                    help="corpus: a dir of *.txt docs or one text file; "
+                         "required unless --verify")
+    ap.add_argument("--out", required=True,
+                    help="token-shards root to write/verify")
+    ap.add_argument("--pack-len", type=int, default=256,
+                    help="sequence length S (records hold S+1 tokens for "
+                         "the next-token shift); must equal LM.SEQ_LEN at "
+                         "train time")
+    ap.add_argument("--shard-mb", type=float, default=4.0,
+                    help="target shard size in MiB")
+    ap.add_argument("--val-frac", type=float, default=0.05,
+                    help="fraction of documents held out as the val split "
+                         "(deterministic every-k-th; 0 = train only)")
+    ap.add_argument("--verify", action="store_true",
+                    help="verify an existing pack instead of packing")
+    args = ap.parse_args()
+
+    from distribuuuu_tpu.data.shards import format as shards_format
+    from distribuuuu_tpu.data.shards import tokens as token_shards
+    from distribuuuu_tpu.lm.tokenizer import ByteTokenizer
+
+    if args.verify:
+        all_ok = True
+        for split in ("train", "val"):
+            split_dir = os.path.join(args.out, split)
+            if not os.path.isdir(split_dir):
+                continue
+            t0 = time.perf_counter()
+            ok, problems = shards_format.verify_split(split_dir)
+            all_ok &= ok
+            print(json.dumps({
+                "split": split, "ok": ok, "problems": problems,
+                "seconds": round(time.perf_counter() - t0, 2),
+            }), flush=True)
+        if not all_ok:
+            print("# VERIFY FAILED — do not train from this pack", flush=True)
+        return 0 if all_ok else 1
+
+    if not args.src:
+        ap.error("--src is required when packing (omit only with --verify)")
+    target_bytes = max(1, int(args.shard_mb * 1024 * 1024))
+    tok = ByteTokenizer()
+    docs = list(iter_documents(args.src))
+    every = int(round(1.0 / args.val_frac)) if args.val_frac > 0 else 0
+    split_docs = {
+        "train": [d for i, d in enumerate(docs)
+                  if not every or (i + 1) % every],
+        "val": [d for i, d in enumerate(docs) if every and not (i + 1) % every],
+    }
+    t0 = time.perf_counter()
+    for split, sdocs in split_docs.items():
+        if not sdocs:
+            continue
+        split_dir = os.path.join(args.out, split)
+        man_path = token_shards.write_token_shards(
+            split_dir,
+            token_shards.pack_token_stream(sdocs, args.pack_len, tok),
+            args.pack_len, tokenizer=tok, target_bytes=target_bytes,
+            source=os.path.abspath(args.src),
+        )
+        with open(man_path) as f:
+            man = json.load(f)
+        print(json.dumps({
+            "split": split,
+            "documents": len(sdocs),
+            "sequences": man["num_records"],
+            "tokens": man["total_tokens"],
+            "pack_len": man["pack_len"],
+            "tokenizer": man["tokenizer"],
+            "shards": len(man["shards"]),
+            "manifest": man_path,
+        }), flush=True)
+    print(f"# packed in {time.perf_counter() - t0:.1f}s — certify with: "
+          f"python tools/make_token_shards.py --out {args.out} --verify",
+          flush=True)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -213,6 +213,58 @@ def _count_events(ranks: dict[int, list[dict]], metrics: list[dict]) -> dict:
     return out
 
 
+def _lm_section(ranks: dict[int, list[dict]]) -> dict | None:
+    """The LM workload plane (lm/generate.py): generation tokens/s from
+    the cumulative ``lm.tokens`` counters (last record per rank wins) and
+    prefill/decode latency percentiles from the per-step ``gen.*``
+    records — the ISSUE 12 surfacing satellite. None when the run has no
+    LM records (image runs are untouched)."""
+    last_tokens: dict[int, dict] = {}
+    dec_ms: list[float] = []
+    pre_ms: list[float] = []
+    admits = retires = 0
+    reasons: dict[str, int] = {}
+    for rank, recs in sorted(ranks.items()):
+        for r in recs:
+            kind = r.get("kind")
+            if kind == "lm.tokens":
+                last_tokens[rank] = r
+            elif kind == "gen.decode":
+                dec_ms.append(float(r["ms"]))
+            elif kind == "gen.prefill":
+                pre_ms.append(float(r["ms"]))
+            elif kind == "gen.admit":
+                admits += 1
+            elif kind == "gen.retire":
+                retires += 1
+                reason = str(r.get("reason"))
+                reasons[reason] = reasons.get(reason, 0) + 1
+    if not (last_tokens or dec_ms or pre_ms):
+        return None
+    new_tokens = sum(int(r.get("new_tokens", 0)) for r in last_tokens.values())
+    prompt_tokens = sum(
+        int(r.get("prompt_tokens", 0)) for r in last_tokens.values()
+    )
+    decode_steps = sum(
+        int(r.get("decode_steps", 0)) for r in last_tokens.values()
+    )
+    tokens_per_s = round(sum(
+        int(r.get("new_tokens", 0)) / max(float(r.get("elapsed_s", 0.0)), 1e-9)
+        for r in last_tokens.values()
+    ), 3) if last_tokens else None
+    return {
+        "prompt_tokens": prompt_tokens,
+        "new_tokens": new_tokens,
+        "decode_steps": decode_steps,
+        "tokens_per_s": tokens_per_s,
+        "admits": admits,
+        "retires": retires,
+        "retire_reasons": reasons,
+        "decode": _summary_ms([v / 1e3 for v in dec_ms]),
+        "prefill": _summary_ms([v / 1e3 for v in pre_ms]),
+    }
+
+
 def build_report(run_dir: str, phase: str = "train") -> dict:
     ranks = _load_ranks(run_dir)
     metrics_path = os.path.join(run_dir, "metrics.jsonl")
@@ -380,6 +432,7 @@ def build_report(run_dir: str, phase: str = "train") -> dict:
         "compile_cache": cache if (cache["hits"] or cache["misses"]) else None,
         "checkpoint": ckpt,
         "sequencer": sequencer,
+        "lm": _lm_section(ranks),
     }
     return report
 
@@ -559,6 +612,22 @@ def _print_report(rep: dict) -> None:
         for host, row in barrier["per_host"].items():
             print(f"    host {host}: {row['saves']} save(s), barrier "
                   f"wait mean {row['mean_wait_s']}s max {row['max_wait_s']}s")
+    lm = rep.get("lm")
+    if lm:
+        tps = lm["tokens_per_s"]
+        print(
+            f"lm generation: {lm['new_tokens']} new tokens over "
+            f"{lm['decode_steps']} decode steps"
+            + (f" ({tps} tokens/s)" if tps is not None else "")
+            + f", {lm['admits']} admit(s) / {lm['retires']} retire(s) "
+            + str(lm["retire_reasons"])
+        )
+        for name in ("prefill", "decode"):
+            row = lm[name]
+            if row["count"]:
+                print(f"  {name:<8} {row['count']:>6} calls  "
+                      f"mean {row['mean_ms']:.3f}  p50 {row['p50_ms']:.3f}  "
+                      f"p99 {row['p99_ms']:.3f}  max {row['max_ms']:.3f}  (ms)")
     seq = rep.get("sequencer")
     if seq:
         streams = ", ".join(

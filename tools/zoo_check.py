@@ -91,6 +91,14 @@ def main():
             cfg.MODEL.ARCH = label
             cfg.MODEL.NUM_CLASSES = 1000
         cfg.TRAIN.IM_SIZE = args.im_size
+        # zoo_check certifies the ARCH on whatever device(s) are attached;
+        # a YAML's multi-axis MESH stanza (e.g. gpt_nano_moe's dp2·tp2·ep2)
+        # is the stanza gate's job (tests/test_mesh_stanzas.py runs it on
+        # the 8-device mesh) and would refuse to resolve on fewer devices
+        # — certify on the single-device degenerate stanza instead
+        for axis, default in (("DATA", -1), ("MODEL", 1), ("SEQ", 1),
+                              ("PIPE", 1), ("EXPERT", 1)):
+            cfg.MESH[axis] = default
         t0 = time.perf_counter()
         try:
             mesh = mesh_lib.build_mesh()
@@ -98,15 +106,30 @@ def main():
             state = trainer.create_train_state(
                 model, jax.random.key(0), mesh, args.im_size
             )
-            batch = sharding_lib.shard_batch(mesh, {
-                "image": rng.standard_normal(
-                    (args.batch, args.im_size, args.im_size, 3)
-                ).astype(np.float32),
-                "label": rng.integers(
-                    0, cfg.MODEL.NUM_CLASSES, (args.batch,)
-                ).astype(np.int32),
-                "mask": np.ones((args.batch,), np.float32),
-            })
+            if cfg.MODEL.ARCH.startswith("gpt"):
+                # the LM species eats token batches, not images (the PR 7
+                # non-cfg-YAML lesson generalized: certify every shipped
+                # YAML through ITS OWN input contract instead of skipping)
+                S = int(cfg.LM.SEQ_LEN)
+                batch = sharding_lib.shard_batch(mesh, {
+                    "image": rng.integers(
+                        0, cfg.MODEL.NUM_CLASSES, (args.batch, S)
+                    ).astype(np.int32),
+                    "label": rng.integers(
+                        0, cfg.MODEL.NUM_CLASSES, (args.batch, S)
+                    ).astype(np.int32),
+                    "mask": np.ones((args.batch,), np.float32),
+                })
+            else:
+                batch = sharding_lib.shard_batch(mesh, {
+                    "image": rng.standard_normal(
+                        (args.batch, args.im_size, args.im_size, 3)
+                    ).astype(np.float32),
+                    "label": rng.integers(
+                        0, cfg.MODEL.NUM_CLASSES, (args.batch,)
+                    ).astype(np.int32),
+                    "mask": np.ones((args.batch,), np.float32),
+                })
             if args.train_step:
                 step = trainer.make_train_step(
                     model, construct_optimizer(), topk=5
