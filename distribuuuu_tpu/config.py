@@ -383,6 +383,37 @@ _C.GENERATE.EOS_ID = 256
 # Scheduler admission poll (seconds) while decode slots are free.
 _C.GENERATE.POLL_S = 0.002
 
+# ------------------------------- kernel tier ---------------------------------
+# The Pallas kernel tier (ops/pallas/, ISSUE 13): hand-fused kernels for
+# the memory-bound regions the cost ledger pinned, each behind its own
+# impl knob. Values: "auto" (pallas on the TPU backend for supported
+# shapes, XLA elsewhere — interpret mode is the CPU *test* path, never
+# the auto choice), "pallas" (force; interpret mode off-TPU, falls back
+# loudly on unsupported shapes), "xla" (the always-available escape
+# hatch). Every resolution emits a kernel.select record; every
+# forced-but-unsupported site a kernel.fallback record + one warning
+# (run_report's `kernels` section shows what actually ran).
+_C.KERNELS = CfgNode()
+# Fused optimizer update (ops/pallas/opt_update.py): ONE HBM pass over
+# params+grads+moments for SGD-momentum and AdamW, replacing the optax
+# chain's re-read-per-transform traffic in the trainer's
+# optimizer_update scope. Bit-exact vs the optax reference (pinned).
+_C.KERNELS.OPT_UPDATE = "auto"
+# Fused pointwise conv + BN-affine + activation for the eval/inference
+# path (ops/pallas/conv_epilogue.py): 1x1/s1 ungrouped convs with a
+# known activation (ResNet/RegNet bottleneck 1x1s, EfficientNet
+# expand/project/head). Other shapes fall back per call site.
+_C.KERNELS.CONV_EPILOGUE = "auto"
+# Fused decode attention over the paged KV cache
+# (ops/pallas/decode_attn.py): the T=1 decode step of lm/generate's
+# CachedAttention — online softmax per (row, head), ragged block-skip,
+# no fp32 cache copy, no [B,H,1,C] logits round-trip.
+_C.KERNELS.DECODE_ATTN = "auto"
+# Key-block height of the decode kernel (sublane dim; multiple of 8).
+# Each GENERATE.CACHE_TILES entry must be a multiple of it (or fit in
+# one block) — validated with the arithmetic at engine build.
+_C.KERNELS.DECODE_BLOCK = 128
+
 # ------------------------------- device / mesh (TPU-native additions) -------
 _C.DEVICE = CfgNode()
 # "tpu" | "cpu" | "auto" — jax platform selection.

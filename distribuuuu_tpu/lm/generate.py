@@ -98,6 +98,33 @@ class CachedAttention(nn.Module):
         cache_k = jax.vmap(write)(cache_k, k, lengths)
         cache_v = jax.vmap(write)(cache_v, v, lengths)
         scale = D ** -0.5
+        # Kernel tier (KERNELS.DECODE_ATTN, ops/pallas/decode_attn.py):
+        # the T=1 decode step fuses q·K, mask, online softmax and ·V into
+        # one kernel over the cache pages — no fp32 cache copy, no
+        # [B,H,1,C] logits round-trip, masked-out blocks never read.
+        # Prefill (T>1) and unsupported tiles stay on the dense
+        # reference below; selection is trace-time, per (batch, cache)
+        # tile executable.
+        if T == 1:
+            from distribuuuu_tpu.ops import pallas as kernel_tier
+            from distribuuuu_tpu.ops.pallas import decode_attn as decode_kernel
+
+            blk = int(cfg.KERNELS.DECODE_BLOCK)
+            ok, reason = decode_kernel.supported(T, C, D, blk)
+            if kernel_tier.select(
+                "decode_attn", supported=ok, reason=reason
+            ) == "pallas":
+                out = decode_kernel.decode_attention(
+                    q[:, :, 0, :], cache_k, cache_v, lengths,
+                    scale=scale, blk_k=blk,
+                    interpret=kernel_tier.interpret_mode(),
+                )[:, :, None, :]  # [B, H, 1, D] fp32
+                out = out.astype(self.dtype).transpose(
+                    0, 2, 1, 3
+                ).reshape(B, T, self.dim)
+                return Dense(self.dim, dtype=self.dtype, name="Dense_1")(
+                    out
+                ), cache_k, cache_v
         s = jnp.einsum(
             "bhtd,bhcd->bhtc",
             q.astype(jnp.float32), cache_k.astype(jnp.float32),
@@ -404,6 +431,29 @@ class GenerateEngine:
             list(cache_tiles if cache_tiles is not None
                  else cfg.GENERATE.CACHE_TILES),
         )
+        # kernel-tier refusal (KERNELS.DECODE_ATTN=pallas forced): every
+        # decode executable is one (batch, cache) tile, and the fused
+        # kernel tiles each cache page into DECODE_BLOCK-key blocks — a
+        # tile the block cannot cover would silently decode on the dense
+        # path, so the forced knob refuses with the arithmetic up front
+        # (`auto` quietly keeps such tiles on the reference path instead).
+        from distribuuuu_tpu.ops import pallas as kernel_tier
+
+        kernel_tier.validate_kernels_cfg()
+        if kernel_tier.requested("decode_attn") == "pallas":
+            from distribuuuu_tpu.ops.pallas import decode_attn as _dk
+
+            blk = int(cfg.KERNELS.DECODE_BLOCK)
+            for c in self.cache_tiles:
+                if _dk.resolve_block(c, blk) is None:
+                    raise ValueError(
+                        f"KERNELS.DECODE_ATTN=pallas: KERNELS.DECODE_BLOCK="
+                        f"{blk} does not divide GENERATE.CACHE_TILES entry "
+                        f"{c} ({c} % {blk} = {c % blk}) — use cache tiles "
+                        f"that are multiples of {blk} (e.g. "
+                        f"{-(-c // blk) * blk}), a DECODE_BLOCK that "
+                        f"divides {c}, or KERNELS.DECODE_ATTN=auto/xla"
+                    )
         self.prompt_tiles = [
             t for t in default_tiles(self.prompt_len)
         ]

@@ -19,8 +19,10 @@ import jax.numpy as jnp
 from distribuuuu_tpu.models.layers import (
     BatchNorm,
     Dense,
+    PointwiseKernel,
     SqueezeExcite,
     conv_kernel_init,
+    fused_pointwise_path,
     global_avg_pool,
     head_dtype,
 )
@@ -37,20 +39,51 @@ _B0_BLOCKS = (
 )
 
 
-def _BN(dtype, bn_group=0):
+def _BN(dtype, bn_group=0, name=None):
     # torch momentum 0.01 ⇒ flax momentum 0.99; eps 1e-3 (EfficientNet BN)
     return BatchNorm(dtype=dtype, momentum=0.99, epsilon=1e-3,
-                     group_size=bn_group)
+                     group_size=bn_group, name=name)
 
 
-def _conv(features, kernel, strides=1, groups=1, dtype=jnp.bfloat16):
+def _conv(features, kernel, strides=1, groups=1, dtype=jnp.bfloat16,
+          name=None):
     k = (kernel, kernel)
     pad = [(kernel // 2, kernel // 2)] * 2
     return nn.Conv(
         features, k, strides=strides, padding=pad, feature_group_count=groups,
         use_bias=False, dtype=dtype, param_dtype=jnp.float32,
-        kernel_init=conv_kernel_init,
+        kernel_init=conv_kernel_init, name=name,
     )
+
+
+def _conv_bn_act(x, features, kernel, strides, groups, act, idx, dtype,
+                 bn_group, train):
+    """conv → BN → (act) under the canonical ``Conv_{idx}`` /
+    ``BatchNorm_{idx}`` names, routed through the fused Pallas pointwise
+    epilogue (ops/pallas/conv_epilogue.py) when ``KERNELS.CONV_EPILOGUE``
+    selects it for this site — EfficientNet's expand/project/head 1×1s
+    are exactly the memory-bound chains the kernel exists for. Explicit
+    names keep the param tree identical on both paths (and to the
+    pre-tier auto-named tree)."""
+    k = (kernel, kernel)
+    pad = [(kernel // 2, kernel // 2)] * 2
+    if fused_pointwise_path(k, strides, pad, groups, act, train):
+        from distribuuuu_tpu.ops import pallas as kernel_tier
+        from distribuuuu_tpu.ops.pallas import conv_epilogue
+
+        kern = PointwiseKernel(features, name=f"Conv_{idx}")(x.shape[-1])
+        a, c = _BN(dtype, bn_group, name=f"BatchNorm_{idx}")(
+            jnp.zeros((1, features), dtype), fold=True
+        )
+        return conv_epilogue.conv1x1_bn_act(
+            x.astype(dtype), kern.astype(dtype), a, c,
+            conv_epilogue.act_code(act),
+            interpret=kernel_tier.interpret_mode(),
+        )
+    y = _conv(features, kernel, strides, groups, dtype,
+              name=f"Conv_{idx}")(x)
+    y = _BN(dtype, bn_group, name=f"BatchNorm_{idx}")(y, train=train)
+    return act(y) if act is not None else y
 
 
 class MBConv(nn.Module):
@@ -66,18 +99,20 @@ class MBConv(nn.Module):
     def __call__(self, x, train: bool = False):
         inp = x
         ch = self.in_ch * self.expand_ratio
+        idx = 0
         if self.expand_ratio != 1:
-            x = _conv(ch, 1, dtype=self.dtype)(x)
-            x = _BN(self.dtype, self.bn_group)(x, train=train)
-            x = nn.silu(x)
-        x = _conv(ch, self.kernel, self.strides, groups=ch, dtype=self.dtype)(x)
-        x = _BN(self.dtype, self.bn_group)(x, train=train)
-        x = nn.silu(x)
+            x = _conv_bn_act(x, ch, 1, 1, 1, nn.silu, idx, self.dtype,
+                             self.bn_group, train)
+            idx += 1
+        x = _conv_bn_act(x, ch, self.kernel, self.strides, ch, nn.silu, idx,
+                         self.dtype, self.bn_group, train)
+        idx += 1
         # SE, reduction relative to block input channels
         se_ch = max(1, self.in_ch // 4)
         x = SqueezeExcite(se_ch, act=nn.silu, dtype=self.dtype)(x)
-        x = _conv(self.out_ch, 1, dtype=self.dtype)(x)
-        x = _BN(self.dtype, self.bn_group)(x, train=train)
+        # project: 1×1, no activation (the "id" epilogue when fused)
+        x = _conv_bn_act(x, self.out_ch, 1, 1, 1, None, idx, self.dtype,
+                         self.bn_group, train)
         if self.strides == 1 and self.in_ch == self.out_ch:
             x = x + inp
         return x
@@ -95,9 +130,8 @@ class EfficientNet(nn.Module):
     @nn.compact
     def __call__(self, x, train: bool = False):
         x = x.astype(self.dtype)
-        x = _conv(self.stem_ch, 3, 2, dtype=self.dtype)(x)
-        x = _BN(self.dtype, self.bn_group)(x, train=train)
-        x = nn.silu(x)
+        x = _conv_bn_act(x, self.stem_ch, 3, 2, 1, nn.silu, 0, self.dtype,
+                         self.bn_group, train)
         in_ch = self.stem_ch
         for t, c, n, s, k in self.blocks:
             for i in range(n):
@@ -111,9 +145,10 @@ class EfficientNet(nn.Module):
                     bn_group=self.bn_group,
                 )(x, train=train)
                 in_ch = c
-        x = _conv(self.head_ch, 1, dtype=self.dtype)(x)
-        x = _BN(self.dtype, self.bn_group)(x, train=train)
-        x = nn.silu(x)
+        # head 1×1: the zoo's widest pointwise chain (→1280 channels) —
+        # the fused epilogue's flagship site
+        x = _conv_bn_act(x, self.head_ch, 1, 1, 1, nn.silu, 1, self.dtype,
+                         self.bn_group, train)
         x = global_avg_pool(x)
         x = nn.Dropout(self.dropout_rate, deterministic=not train)(x)
         return Dense(self.num_classes, dtype=head_dtype(x.dtype))(

@@ -198,12 +198,55 @@ class UnrolledGroupConv(nn.Module):
         return jnp.concatenate(outs, axis=-1)
 
 
+class PointwiseKernel(nn.Module):
+    """Param-holder for the fused conv epilogue (ops/pallas/): declares
+    exactly nn.Conv's ``kernel`` param — (1, 1, in, features), fp32,
+    conv init — and returns it, so the fused compute path shares the
+    canonical parameter (the StemConv7x7/UnrolledGroupConv discipline:
+    checkpoints are compute-path-independent). Instantiate under the
+    same child name the nn.Conv would have used."""
+
+    features: int
+
+    @nn.compact
+    def __call__(self, in_channels: int):
+        return self.param(
+            "kernel", conv_kernel_init,
+            (1, 1, in_channels, self.features), jnp.float32,
+        )
+
+
+def fused_pointwise_path(kernel_size, strides, padding, groups, act,
+                         train: bool, use_bn: bool = True) -> bool:
+    """Whether THIS conv+BN+act site runs the fused Pallas epilogue
+    (KERNELS.CONV_EPILOGUE): consult the kernel tier's one policy point
+    with the site's qualification + disqualifying reason. Emits the
+    kernel.select / kernel.fallback telemetry as a side effect; training
+    forwards never consult (BN batch stats need the raw conv output, and
+    a forced knob should not warn once per train step site)."""
+    if train or not use_bn:
+        return False
+    from distribuuuu_tpu.ops import pallas as kernel_tier
+    from distribuuuu_tpu.ops.pallas import conv_epilogue
+
+    ok, reason = conv_epilogue.qualifies(
+        kernel_size, strides, padding, groups, act, train
+    )
+    return kernel_tier.select(
+        "conv_epilogue", supported=ok, reason=reason
+    ) == "pallas"
+
+
 class ConvBN(nn.Module):
     """Conv2D (no bias) + BatchNorm, the zoo's basic unit.
 
     ``s2d_stem=True`` (7×7/s2 stems only) swaps the conv computation for the
     space-to-depth path of :class:`StemConv7x7`; wide grouped convs route
-    through :class:`UnrolledGroupConv`. In every case the explicit submodule
+    through :class:`UnrolledGroupConv`; on the eval path, pointwise convs
+    with a kernel-known activation route through the fused Pallas
+    conv+BN+act epilogue when ``KERNELS.CONV_EPILOGUE`` selects it
+    (ops/pallas/conv_epilogue.py — one HBM pass, the BN affine and the
+    activation ride the matmul tile). In every case the explicit submodule
     name keeps the param at the same ``ConvBN_*/Conv_0/kernel`` path with
     the same shape, so checkpoints are compute-path-independent.
     """
@@ -242,6 +285,24 @@ class ConvBN(nn.Module):
         if pad is None:
             # torch-style symmetric "same" padding for odd kernels
             pad = [(k[0] // 2, k[0] // 2), (k[1] // 2, k[1] // 2)]
+        if fused_pointwise_path(k, self.strides, pad, self.groups, self.act,
+                                train, self.use_bn):
+            from distribuuuu_tpu.ops import pallas as kernel_tier
+            from distribuuuu_tpu.ops.pallas import conv_epilogue
+
+            kernel = PointwiseKernel(self.features, name="Conv_0")(
+                x.shape[-1]
+            )
+            a, c = BatchNorm(
+                dtype=self.dtype,
+                scale_init=self.bn_scale_init,
+                group_size=self.bn_group,
+            )(jnp.zeros((1, self.features), self.dtype), fold=True)
+            return conv_epilogue.conv1x1_bn_act(
+                x.astype(self.dtype), kernel.astype(self.dtype), a, c,
+                conv_epilogue.act_code(self.act),
+                interpret=kernel_tier.interpret_mode(),
+            )
         if self.s2d_stem:
             assert (
                 tuple(k) == (7, 7)
@@ -304,7 +365,7 @@ class _BNCore(nn.Module):
     scale_init: Callable = nn.initializers.ones
 
     @nn.compact
-    def __call__(self, x, train: bool = False):
+    def __call__(self, x, train: bool = False, fold: bool = False):
         feat = x.shape[-1]
         scale = self.param("scale", self.scale_init, (feat,), jnp.float32)
         bias = self.param("bias", nn.initializers.zeros, (feat,), jnp.float32)
@@ -314,6 +375,19 @@ class _BNCore(nn.Module):
         ra_var = self.variable(
             "batch_stats", "var", lambda: jnp.ones((feat,), jnp.float32)
         )
+        if fold:
+            # the fused conv-epilogue path (ops/pallas/conv_epilogue.py):
+            # return the eval normalization as per-channel affine
+            # constants (a, c) with y = x·a + c ≡ (x − mean)·inv + bias —
+            # ``x`` only sizes the channel dim. Same params/variables
+            # declared in the same order, so the tree is fold-independent.
+            if train:
+                raise ValueError(
+                    "BatchNorm fold=True is the eval fusion path; batch "
+                    "statistics cannot be folded into an affine"
+                )
+            inv = jax.lax.rsqrt(ra_var.value + self.epsilon) * scale
+            return inv, bias - ra_mean.value * inv
         # stats compute in fp32 — promoted to fp64 only when the input is
         # f64 (the x64 CPU equivalence tests, where reduction-order
         # rounding must vanish); bf16/f32 production inputs stay fp32
@@ -437,7 +511,7 @@ class BatchNorm(nn.Module):
     group_size: int = 0
 
     @nn.compact
-    def __call__(self, x, train: bool = False):
+    def __call__(self, x, train: bool = False, fold: bool = False):
         return _BNCore(
             group_size=self.group_size,
             momentum=self.momentum,
@@ -445,7 +519,7 @@ class BatchNorm(nn.Module):
             dtype=self.dtype,
             scale_init=self.scale_init,
             name="BatchNorm_0",
-        )(x, train=train)
+        )(x, train=train, fold=fold)
 
 
 class SqueezeExcite(nn.Module):

@@ -1,0 +1,166 @@
+"""The Pallas kernel tier (ISSUE 13): fused kernels for the memory-bound
+programs the cost ledger pinned, as ONE subsystem instead of one-offs.
+
+Three kernels, one discipline:
+
+* ``opt_update``     — fused optimizer update (opt_update.py): ONE HBM
+                       pass over params+grads+moments for SGD-momentum
+                       and AdamW, replacing the optax chain's re-read-
+                       per-transform traffic (measured 5.4×/8× the
+                       one-pass bytes on the lowered XLA programs).
+* ``conv_epilogue``  — fused 1×1-conv(matmul)+BN-affine+activation for
+                       the eval/inference path (conv_epilogue.py): the
+                       epilogue rides the matmul tile, the conv output
+                       never round-trips HBM unactivated.
+* ``decode_attn``    — fused decode attention over the paged KV cache
+                       (decode_attn.py): one kernel per (batch, head)
+                       program, online softmax over cache blocks, no
+                       [B,H,T,C] logits materialization and no fp32
+                       cache copy.
+
+Tier discipline (every kernel, no exceptions):
+
+* selection rides a ``KERNELS.*`` config knob — ``auto`` | ``pallas`` |
+  ``xla`` — resolved HERE (:func:`select`) so policy lives in one place:
+  ``auto`` engages the kernel on the TPU backend for supported shapes
+  and stays on XLA elsewhere; ``pallas`` forces it (interpret mode
+  off-TPU — the exact-but-slow CPU test path); ``xla`` is the
+  always-available escape hatch.
+* every resolution emits a ``kernel.select`` telemetry record and every
+  forced-but-unsupported resolution a ``kernel.fallback`` record with
+  the reason (run_report's ``kernels`` section reads both), with a
+  warn-once log so a silently-ignored knob cannot happen.
+* every kernel has an interpret-mode CPU path (this repo's tier-1 story
+  — the same ``pallas_call`` with ``interpret=True``) and a pinned
+  bit-exactness or tolerance A/B test against the XLA reference
+  (tests/test_pallas_kernels.py).
+
+This tier supersedes the repo's earlier one-off Pallas work: the retired
+r5 BoTNet attention kernel (deleted at 0.854× XLA e2e — PERF.md) and the
+r2 flash-attention kernel (ops/flash_attention.py, which stays: the
+decode kernel reuses its block machinery and its lesson — fuse the whole
+memory-bound region or lose to XLA's epilogue fusion at the custom-call
+boundary).
+"""
+
+from __future__ import annotations
+
+VALID_IMPLS = ("auto", "pallas", "xla")
+
+# op name -> KERNELS knob
+KNOBS = {
+    "opt_update": "OPT_UPDATE",
+    "conv_epilogue": "CONV_EPILOGUE",
+    "decode_attn": "DECODE_ATTN",
+}
+
+# process-lifetime emission/warn dedup: one kernel.select per (op, impl,
+# requested) resolution, one kernel.fallback + warning per (op, reason)
+_emitted: set = set()
+_warned: set = set()
+
+
+def reset_selection() -> None:
+    """Forget emitted selections/fallbacks (tests)."""
+    _emitted.clear()
+    _warned.clear()
+
+
+def validate_kernels_cfg(kcfg=None) -> None:
+    """The KERNELS config refusals. An unknown impl name lists the valid
+    set; a bad decode block names the lane constraint it violates."""
+    if kcfg is None:
+        from distribuuuu_tpu.config import cfg
+
+        kcfg = cfg.KERNELS
+    for op, knob in KNOBS.items():
+        v = kcfg[knob]
+        if v not in VALID_IMPLS:
+            raise ValueError(
+                f"KERNELS.{knob}={v!r} is not a known impl for the "
+                f"{op} kernel — valid: {list(VALID_IMPLS)} (auto = pallas "
+                "on TPU for supported shapes, xla elsewhere; xla = the "
+                "always-available escape hatch)"
+            )
+    blk = int(kcfg.DECODE_BLOCK)
+    if blk < 8 or blk % 8:
+        raise ValueError(
+            f"KERNELS.DECODE_BLOCK={blk} must be a positive multiple of "
+            f"8 (the TPU sublane width): {blk} % 8 = {blk % 8} — the "
+            "decode kernel tiles the KV cache into (DECODE_BLOCK, "
+            "head_dim) VMEM blocks, with head_dim on the 128-lane axis "
+            "and the key blocks on the sublane axis"
+        )
+
+
+def requested(op: str) -> str:
+    """The validated KERNELS.* knob value for one op."""
+    from distribuuuu_tpu.config import cfg
+
+    validate_kernels_cfg(cfg.KERNELS)
+    return str(cfg.KERNELS[KNOBS[op]])
+
+
+def interpret_mode() -> bool:
+    """Whether pallas kernels run the interpreter (any non-TPU backend —
+    the tier-1 CPU story; TPU lowers the same call with interpret=False)."""
+    import jax
+
+    return jax.default_backend() != "tpu"
+
+
+def _emit_once(key, kind: str, **fields) -> None:
+    if key in _emitted:
+        return
+    _emitted.add(key)
+    from distribuuuu_tpu.telemetry import spans
+
+    if kind == "kernel.select":
+        spans.emit_event("kernel.select", op=fields["op"],
+                         impl=fields["impl"], requested=fields["requested"])
+    else:
+        spans.emit_event("kernel.fallback", op=fields["op"],
+                         requested=fields["requested"],
+                         reason=fields["reason"])
+
+
+def select(op: str, *, supported: bool = True, reason: str = "") -> str:
+    """Resolve which impl runs for ``op`` right now: ``"pallas"`` or
+    ``"xla"``. The ONE policy point of the tier:
+
+    * ``xla`` requested → xla.
+    * ``pallas`` requested → pallas when ``supported``; otherwise xla
+      with a ``kernel.fallback`` record + ONE warning naming ``reason``
+      (forced-but-impossible must be loud, never silent).
+    * ``auto`` → pallas only on the TPU backend AND ``supported``; the
+      CPU/test backends stay on XLA (interpret mode is exact but orders
+      of magnitude slower — it is the *test* path, not the auto path).
+
+    Every resolution emits ``kernel.select`` once per process (the
+    run_report ``kernels`` section's source).
+    """
+    if op not in KNOBS:
+        raise ValueError(f"unknown kernel op {op!r} — one of {list(KNOBS)}")
+    req = requested(op)
+    if req == "xla":
+        impl = "xla"
+    elif req == "pallas":
+        impl = "pallas" if supported else "xla"
+        if not supported:
+            _emit_once(("fb", op, reason), "kernel.fallback", op=op,
+                       requested=req, reason=reason or "unsupported")
+            wkey = (op, reason)
+            if wkey not in _warned:
+                _warned.add(wkey)
+                from distribuuuu_tpu.utils.logger import get_logger
+
+                get_logger().warning(
+                    "KERNELS.%s=pallas requested but unsupported here "
+                    "(%s): falling back to the XLA reference path",
+                    KNOBS[op], reason or "unsupported shape",
+                )
+    else:  # auto
+        impl = "pallas" if (supported and not interpret_mode()) else "xla"
+    _emit_once(("sel", op, impl, req), "kernel.select", op=op, impl=impl,
+               requested=req)
+    return impl

@@ -129,15 +129,52 @@ def train_step_body(model, optimizer, topk: int, accum_steps: int = 1,
     if layout is not None:
         rs_scope, ag_scope = _collective_scopes(layout)
 
+    # Kernel tier (ops/pallas/, KERNELS.OPT_UPDATE): the fused one-pass
+    # optimizer update, resolved ONCE at step-build time. None ⇒ the
+    # optax reference chain (the xla escape hatch / unsupported
+    # optimizer); non-None is bit-exact vs it (pinned:
+    # tests/test_pallas_kernels.py) and elementwise per leaf, so the
+    # ZeRO layout constraints around it are unchanged.
+    from distribuuuu_tpu.ops.pallas import opt_update as fused_opt
+
+    fused_update = fused_opt.fused_update_for()
+    if fused_update is not None and layout is not None:
+        # Under a ZeRO layout the kernel's operands must be whole
+        # leaves: GSPMD partitions the custom-call region against the
+        # sharded operands INCORRECTLY (measured wrong values, not just
+        # extra traffic — the grid program's indexing does not survive
+        # operand sharding), so the fused region pins its inputs
+        # replicated and the rest-layout constraints below re-shard the
+        # results. The per-shard fused update (shard_map over the data
+        # axis, no gather at all) is exactly ROADMAP #1's overlap work.
+        rep = jax.sharding.NamedSharding(
+            jax.tree.leaves(layout["params"])[0].mesh,
+            jax.sharding.PartitionSpec(),
+        )
+
+        def _whole(tree):
+            return jax.tree.map(
+                lambda x: jax.lax.with_sharding_constraint(x, rep), tree
+            )
+    else:
+        def _whole(tree):
+            return tree
+
     def apply_grads(state, grads, new_stats, metrics):
         if layout is not None:
             # ZeRO: reduce-scatter the grad into the sharded update
             grads = zero.constrain(grads, layout["grads"], scope=rs_scope)
         with jax.named_scope("optimizer_update"):
-            updates, new_opt_state = optimizer.update(
-                grads, state.opt_state, state.params
-            )
-            new_params = optax.apply_updates(state.params, updates)
+            if fused_update is not None:
+                new_params, new_opt_state = fused_update(
+                    _whole(state.params), _whole(grads),
+                    _whole(state.opt_state)
+                )
+            else:
+                updates, new_opt_state = optimizer.update(
+                    grads, state.opt_state, state.params
+                )
+                new_params = optax.apply_updates(state.params, updates)
         if layout is not None:
             # pin rest layouts (stage 1: params re-gathered to replicated;
             # stage 3: params stay data-sharded) — keeps donation stable

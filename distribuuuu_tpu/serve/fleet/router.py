@@ -367,14 +367,20 @@ class Router:
                             tried.add(rep.id)
                             busy = True
                             break  # try the next replica
-                    protocol.send_frame(client, frame)
-                    streamed += 1
-                    if (
+                    done = (
                         b'"stream": "done"' in frame[:64]
                         or frame.startswith(_ERROR_PREFIX)
-                    ):
+                    )
+                    if done:
+                        # account the stream BEFORE forwarding its final
+                        # frame: the client unblocks the moment it reads
+                        # "done", and an after-the-send increment races
+                        # anything that checks the counters then
                         self._observe(rep, time.perf_counter() - t0)
                         self.registry.counter("fleet.streams").inc(1)
+                    protocol.send_frame(client, frame)
+                    streamed += 1
+                    if done:
                         return
                 if busy:
                     continue  # busy rejection: next replica

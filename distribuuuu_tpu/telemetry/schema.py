@@ -101,6 +101,14 @@ KINDS: dict[str, frozenset] = {
     "gen.decode": frozenset({"active", "tile_b", "tile_c", "ms"}),
     # one per sequence retirement (reason: eos/max_new_tokens/cache_full)
     "gen.retire": frozenset({"slot", "new_tokens", "reason", "request"}),
+    # -- Pallas kernel tier (ops/pallas/, ISSUE 13) ----------------------
+    # one per kernel-impl resolution (ops.pallas.select): which impl
+    # actually runs for an op vs what KERNELS.* requested — the source
+    # of run_report's `kernels` section
+    "kernel.select": frozenset({"op", "impl", "requested"}),
+    # a forced-but-unsupported site degrading to the XLA reference, with
+    # the disqualifying reason (also warn-once logged)
+    "kernel.fallback": frozenset({"op", "requested", "reason"}),
     # -- live observability plane (telemetry/live.py, tools/monitor.py) --
     # one windowed aggregate per monitor tick (MONITOR.jsonl)
     "monitor.snapshot": frozenset(

@@ -113,16 +113,41 @@ def index_lm(path: str, doc: dict, series: dict) -> None:
                row.get("ms"), "ms")
 
 
+def index_kernels(path: str, doc: dict, series: dict) -> None:
+    """BENCH_r09+ kernel-tier A/B matrix (tools/kernel_bench.py): per
+    kernel, the xla-vs-pallas bytes ratio and both arms' arithmetic
+    intensity, plus the in-context step ledgers. Every series name is
+    ``kernel_*`` — deliberately outside the img/s gate patterns
+    (run_report --compare must keep gating on the resnet50 reference,
+    the PR 8 clobbering lesson)."""
+    rnd, src = _round_of(path), os.path.basename(path)
+    for name, row in (doc.get("kernels") or {}).items():
+        _point(series, f"kernel_{name}_bytes_ratio", rnd, src,
+               row.get("bytes_ratio_xla_over_pallas"), "x")
+        _point(series, f"kernel_{name}_intensity_xla", rnd, src,
+               (row.get("xla") or {}).get("intensity"), "flop/byte")
+        _point(series, f"kernel_{name}_intensity_pallas", rnd, src,
+               (row.get("pallas") or {}).get("intensity"), "flop/byte")
+    for label, row in (doc.get("step_ab") or {}).items():
+        _point(series, f"kernel_step_{label}_intensity_xla", rnd, src,
+               row.get("intensity_xla"), "flop/byte")
+        _point(series, f"kernel_step_{label}_intensity_with_kernel", rnd,
+               src, row.get("intensity_with_kernel"), "flop/byte")
+
+
 def index_train_bench(path: str, series: dict) -> None:
     """BENCH_r*.json: the ``parsed`` block is the metric (r06+ may
-    instead carry an ``asyncplane`` section, r08+ an ``lm`` section —
-    indexed separately)."""
+    instead carry an ``asyncplane`` section, r08+ an ``lm`` section,
+    r09+ a kernel-tier ``kernels``/``step_ab`` matrix — indexed
+    separately)."""
     with open(path) as f:
         doc = json.load(f)
     if doc.get("asyncplane"):
         index_asyncplane(path, doc, series)
     if doc.get("lm"):
         index_lm(path, doc, series)
+    if doc.get("kernels") or doc.get("step_ab"):
+        index_kernels(path, doc, series)
     parsed = doc.get("parsed") or {}
     if "metric" in parsed and "value" in parsed:
         _point(series, str(parsed["metric"]), _round_of(path),

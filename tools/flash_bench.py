@@ -25,6 +25,12 @@ Usage (defaults are the canonical ViT-Ti/1024px shape [4, 3, 4096, 64]):
     python tools/flash_bench.py [--batch 4] [--heads 3] [--seq 4096]
         [--dim 64] [--iters 20] [--rounds 5] [--skip-dense]
         [--blk-q 1024] [--blk-k 1024]
+
+``--kernel decode`` (ISSUE 13) switches the harness to the kernel
+tier's fused decode attention (ops/pallas/decode_attn.py) vs the dense
+XLA reference of lm/generate.CachedAttention's T=1 step: --seq becomes
+the cache tile, --batch the live rows (ragged lengths drawn per row),
+same interleaved paired-round methodology.
 """
 
 from __future__ import annotations
@@ -131,8 +137,76 @@ def report(tag: str, times: dict, flops: float | None = None):
     return med
 
 
+def run_decode(args):
+    """The --kernel decode arm: fused decode attention vs the dense
+    reference at one (batch, cache, heads, dim) tile."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from distribuuuu_tpu.ops.pallas import decode_attn as da
+
+    B, H, C, D = args.batch, args.heads, args.seq, args.dim
+    print(f"backend={jax.default_backend()} decode tile "
+          f"q[{B},{H},{D}] cache[{B},{H},{C},{D}] iters={args.iters} "
+          f"rounds={args.rounds}")
+    rng = np.random.default_rng(0)
+    q = jnp.asarray(rng.standard_normal((B, H, D)), jnp.bfloat16)
+    ck = jnp.asarray(rng.standard_normal((B, H, C, D)), jnp.bfloat16)
+    cv = jnp.asarray(rng.standard_normal((B, H, C, D)), jnp.bfloat16)
+    lens = jnp.asarray(rng.integers(0, C - 1, (B,)), jnp.int32)
+    sc = D ** -0.5
+    interp = jax.default_backend() != "tpu"
+
+    def dense(q, ck, cv):
+        s = jnp.einsum("bhd,bhcd->bhc", q.astype(jnp.float32),
+                       ck.astype(jnp.float32)) * sc
+        vis = jnp.arange(C)[None, None, :] <= lens[:, None, None]
+        s = jnp.where(vis, s, jnp.float32(-1e30))
+        w = jax.nn.softmax(s, axis=-1)
+        return jnp.einsum("bhc,bhcd->bhd", w, cv.astype(jnp.float32))
+
+    def fused(q, ck, cv):
+        return da.decode_attention(q, ck, cv, lens, scale=sc,
+                                   blk_k=args.blk_k or 128,
+                                   interpret=interp)
+
+    paths = {"pallas": fused, "dense": dense}
+    runners = {}
+    for name, fn in paths.items():
+        @jax.jit
+        def run(q, ck, cv, fn=fn):
+            def body(c, _):
+                o = fn(c.astype(jnp.bfloat16), ck, cv)
+                return o, ()  # output feedback defeats DCE (hazard 1)
+
+            out, _ = jax.lax.scan(body, q.astype(jnp.float32), None,
+                                  length=args.iters)
+            return out
+
+        def window(run=run):
+            t0 = time.perf_counter()
+            o = run(q, ck, cv)
+            float(jnp.sum(o.astype(jnp.float32)))
+            return (time.perf_counter() - t0) / args.iters
+
+        window()
+        runners[name] = window
+    times = interleaved(runners, args.rounds)
+    report("decode ", times)
+    err = float(jnp.abs(
+        paths["pallas"](q, ck, cv) - paths["dense"](q, ck, cv)
+    ).max())
+    print(f"decode  pallas-vs-dense max|d|: {err:.2e}")
+
+
 def main():
     ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--kernel", default="flash",
+                    choices=["flash", "decode"],
+                    help="which tier kernel to benchmark: the flash "
+                         "attention paths (default) or the fused decode "
+                         "attention (--seq = cache tile)")
     ap.add_argument("--batch", type=int, default=4)
     ap.add_argument("--heads", type=int, default=3)
     ap.add_argument("--seq", type=int, default=4096)
@@ -150,6 +224,11 @@ def main():
                     help="benchmark the causal paths (r4 kernels with "
                          "block-skip vs causal scan/dense)")
     args = ap.parse_args()
+
+    if args.kernel == "decode":
+        if args.seq == 4096:
+            args.seq = 256  # decode default: the gen_decode cache tile
+        return run_decode(args)
 
     import jax
     import jax.numpy as jnp

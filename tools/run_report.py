@@ -265,6 +265,45 @@ def _lm_section(ranks: dict[int, list[dict]]) -> dict | None:
     }
 
 
+def _kernels_section(ranks: dict[int, list[dict]]) -> dict | None:
+    """The Pallas kernel tier (ops/pallas/): which impl actually ran per
+    op (``kernel.select``), every forced-but-unsupported fallback with
+    its reason (``kernel.fallback``), and — when the run carried
+    ``kernel_*``-labeled cost records (tools/kernel_bench.py emits them)
+    — the per-kernel A/B deltas. None when the run never consulted the
+    tier (pre-tier runs are untouched)."""
+    selected: dict[str, dict] = {}
+    fallbacks: list[dict] = []
+    ab: dict[str, dict] = {}
+    for recs in ranks.values():
+        for r in recs:
+            kind = r.get("kind")
+            if kind == "kernel.select":
+                op = str(r.get("op"))
+                selected[op] = {
+                    "impl": r.get("impl"), "requested": r.get("requested"),
+                }
+            elif kind == "kernel.fallback":
+                fallbacks.append({
+                    "op": r.get("op"), "requested": r.get("requested"),
+                    "reason": r.get("reason"),
+                })
+            elif kind == "cost.step" and str(r.get("label", "")).startswith(
+                "kernel_"
+            ):
+                ab[str(r["label"])] = {
+                    "flops": r.get("flops"),
+                    "bytes_accessed": r.get("bytes_accessed"),
+                }
+    if not (selected or fallbacks):
+        return None
+    return {
+        "selected": selected,
+        "fallbacks": fallbacks,
+        "ab": ab or None,
+    }
+
+
 def build_report(run_dir: str, phase: str = "train") -> dict:
     ranks = _load_ranks(run_dir)
     metrics_path = os.path.join(run_dir, "metrics.jsonl")
@@ -433,6 +472,7 @@ def build_report(run_dir: str, phase: str = "train") -> dict:
         "checkpoint": ckpt,
         "sequencer": sequencer,
         "lm": _lm_section(ranks),
+        "kernels": _kernels_section(ranks),
     }
     return report
 
@@ -628,6 +668,26 @@ def _print_report(rep: dict) -> None:
                 print(f"  {name:<8} {row['count']:>6} calls  "
                       f"mean {row['mean_ms']:.3f}  p50 {row['p50_ms']:.3f}  "
                       f"p99 {row['p99_ms']:.3f}  max {row['max_ms']:.3f}  (ms)")
+    kern = rep.get("kernels")
+    if kern:
+        chosen = ", ".join(
+            f"{op}={row['impl']}"
+            + (f" (requested {row['requested']})"
+               if row["requested"] not in (row["impl"], "auto") else "")
+            for op, row in sorted(kern["selected"].items())
+        )
+        print(f"kernel tier: {chosen or 'no selections'}"
+              + (f", {len(kern['fallbacks'])} fallback(s)"
+                 if kern["fallbacks"] else ""))
+        for fb in kern["fallbacks"]:
+            print(f"  fallback {fb['op']}: {fb['reason']}")
+        if kern.get("ab"):
+            for label, row in sorted(kern["ab"].items()):
+                ba = row.get("bytes_accessed")
+                print(f"  {label:<28}"
+                      + (f" {ba / 1e6:9.2f} MB accessed" if ba else "")
+                      + (f"  {row['flops'] / 1e6:.2f} MFLOP"
+                         if row.get("flops") else ""))
     seq = rep.get("sequencer")
     if seq:
         streams = ", ".join(
