@@ -1,0 +1,45 @@
+"""The static analysis plane (ISSUE 14).
+
+The repo's worst bugs were all *statically visible* and caught late: a
+prime-vocab dim silently degraded to replication (the PR 12 drift gate
+caught one instance at runtime), GSPMD mispartitioned the fused-update
+custom call against sharded operands (PR 13, pinned by a hand-written
+test), and collective programs dispatched out of token order deadlocked
+the backend (PR 11). This package makes those bug classes fail CI before
+a TPU ever sees them:
+
+* **Program lints** run on the lowered StableHLO / compiled HLO of every
+  shipped config stanza (plus the generated mesh-sweep core cases),
+  built through the existing partition-layer ``lower()`` bundle against
+  abstract, declared-sharding arguments (``Lowered.abstract_args`` — no
+  state is materialized, each program compiles exactly once and every
+  pass reads that one bundle):
+  ``replication`` (declared-sharded leaf rests replicated, with the
+  uneven-dim arithmetic), ``donation`` (threaded state the executable
+  does not alias, with the doubled-footprint bytes), ``collectives``
+  (per-mesh-axis census vs the spec-algebra prediction —
+  ``specs.collective_expectations``), ``dtype`` (bf16→f32 upcasts
+  outside the known-safe scopes).
+
+* **AST lints** run on the package source: ``knobs`` (every ``cfg.X.Y``
+  read declared in config.py and documented, dead declared knobs and
+  stale doc mentions both directions), ``dispatch`` (device-dispatch
+  calls on threads outside the sequencer token ring — the PR 11
+  deadlock class as a lint), ``telemetry`` (the absorbed
+  ``tools/check_telemetry_schema.py`` kind/field discipline).
+
+One findings model (``findings.Finding``: pass id, severity, location,
+message-with-the-arithmetic, stable waiver key), one committed waiver
+file (``ANALYSIS_BASELINE.json`` — justification + date per waiver,
+regeneration-pinned like BENCH_INDEX), one CLI
+(``tools/staticcheck.py`` / ``distribuuuu-staticcheck``: ``--json-out``,
+exit 1 on unwaived findings), and a tier-1 gate at 0 unwaived findings
+with every pass proven live by a seeded-violation fixture
+(tests/test_staticcheck.py).
+"""
+
+from distribuuuu_tpu.analysis.findings import (  # noqa: F401
+    Finding,
+    Report,
+    load_baseline,
+)

@@ -1,0 +1,112 @@
+"""``distribuuuu-staticcheck`` — the static analysis plane's CLI.
+
+    distribuuuu-staticcheck [--ast-only | --program-only]
+                            [--configs SUBSTR] [--no-sweep]
+                            [--json-out REPORT.json]
+                            [--baseline ANALYSIS_BASELINE.json]
+                            [--devices N]
+
+Exit 0 when every finding is waived (with a committed justification in
+the baseline), 1 when any unwaived finding remains — the same gate
+tier-1 pins. ``tools/staticcheck.py`` is the in-repo twin.
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="distribuuuu-staticcheck", description=__doc__,
+        formatter_class=argparse.RawDescriptionHelpFormatter,
+    )
+    ap.add_argument("--repo", default=None,
+                    help="repo root (default: the checkout this package "
+                         "lives in)")
+    ap.add_argument("--ast-only", action="store_true",
+                    help="only the AST passes (knobs/dispatch/telemetry) "
+                         "— seconds, no compiles")
+    ap.add_argument("--program-only", action="store_true",
+                    help="only the program passes over the stanzas")
+    ap.add_argument("--configs", default=None,
+                    help="substring filter over program case names "
+                         "(e.g. 'resnet18')")
+    ap.add_argument("--no-sweep", action="store_true",
+                    help="skip the generated mesh-sweep core cases")
+    ap.add_argument("--json-out", default=None,
+                    help="write the full report JSON here")
+    ap.add_argument("--baseline", default=None,
+                    help="waiver file (default: {repo}/"
+                         "ANALYSIS_BASELINE.json)")
+    ap.add_argument("--devices", type=int, default=8,
+                    help="virtual device count for program lowering "
+                         "(default 8 — the stanza-gate mesh)")
+    ap.add_argument("--knob-index", action="store_true",
+                    help="print the RUNBOOK config-knob index markdown "
+                         "(generated from config.py) and exit")
+    args = ap.parse_args(argv)
+
+    if args.knob_index:
+        from distribuuuu_tpu.analysis import runner as _runner
+        from distribuuuu_tpu.analysis.passes import knobs as _knobs
+
+        repo = args.repo or _runner.repo_root()
+        print(_knobs.knob_index_markdown(
+            os.path.join(repo, "distribuuuu_tpu", "config.py")
+        ))
+        return 0
+
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    if not args.ast_only:
+        flags = os.environ.get("XLA_FLAGS", "")
+        if "host_platform_device_count" not in flags:
+            os.environ["XLA_FLAGS"] = (
+                flags
+                + f" --xla_force_host_platform_device_count={args.devices}"
+            ).strip()
+
+    from distribuuuu_tpu.analysis import runner
+    from distribuuuu_tpu.analysis.findings import write_report
+
+    def progress(record, findings):
+        status = "ok " if record.get("ok") else "FAIL"
+        n = len(findings)
+        print(
+            f"  {status} {record['name']:<44} "
+            f"{record.get('seconds', 0):6.1f}s  "
+            f"{n} finding(s)",
+            flush=True,
+        )
+
+    report = runner.run_all(
+        repo=args.repo,
+        n_devices=args.devices,
+        ast_only=args.ast_only,
+        program_only=args.program_only,
+        configs=args.configs,
+        sweep=not args.no_sweep,
+        baseline_path=args.baseline,
+        progress=progress,
+    )
+
+    for f in report.findings:
+        tag = "waived " if f.waived else f.severity.upper().ljust(7)
+        print(f"{tag} [{f.pass_id}] {f.location}\n        {f.message}")
+    unwaived = report.unwaived
+    print(
+        f"staticcheck: {len(report.findings)} finding(s), "
+        f"{len(unwaived)} unwaived, {len(report.waived)} waived, "
+        f"{len(report.cases)} program case(s), "
+        f"passes: {', '.join(sorted(set(report.passes_run)))}"
+    )
+    if args.json_out:
+        write_report(report, args.json_out)
+        print(f"wrote {args.json_out}")
+    return 1 if unwaived else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

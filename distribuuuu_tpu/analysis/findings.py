@@ -1,0 +1,161 @@
+"""The one findings model every analysis pass reports through.
+
+A :class:`Finding` is one diagnosed defect: which pass, how bad, where,
+the message (carrying the arithmetic that proves it — counts, bytes,
+``dim % axis`` remainders), and a *stable waiver key*. The key is the
+contract with ``ANALYSIS_BASELINE.json``: it must survive line-number
+drift and re-runs, so passes build it from semantic coordinates (pass id
++ stanza/file + leaf path/knob/op class), never from line numbers or
+byte offsets.
+
+Waivers are committed, justified, and dated. A finding whose key appears
+in the baseline is *waived* (reported, but does not gate); everything
+else is *unwaived* and fails the CLI/tier-1 gate. A waiver whose key no
+match produces — a fixed or vanished finding — is *stale* and is itself
+a finding (``baseline`` pass): the baseline is regeneration-pinned like
+BENCH_INDEX, it cannot silently rot.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from dataclasses import asdict, dataclass, field
+
+SCHEMA = 1
+
+SEVERITIES = ("error", "warning")
+
+
+@dataclass
+class Finding:
+    """One diagnosed defect from one pass."""
+
+    pass_id: str       # "replication" | "donation" | "collectives" | ...
+    severity: str      # "error" | "warning"
+    location: str      # "config/resnet18.yaml::<leaf>" or "pkg/file.py:12"
+    message: str       # human message WITH the arithmetic
+    waiver_key: str    # stable key ANALYSIS_BASELINE.json waives by
+    waived: bool = False
+
+    def __post_init__(self):
+        if self.severity not in SEVERITIES:
+            raise ValueError(
+                f"finding severity {self.severity!r} not in {SEVERITIES}"
+            )
+
+    def to_dict(self) -> dict:
+        return asdict(self)
+
+
+def finding_key(pass_id: str, *coords: str) -> str:
+    """The canonical waiver key: ``pass::coord::coord…`` from semantic
+    coordinates (stanza name, leaf path, knob, op class — never line
+    numbers)."""
+    return "::".join((pass_id,) + tuple(str(c) for c in coords))
+
+
+@dataclass
+class Report:
+    """One analyzer run: findings + per-case ledgers + coverage."""
+
+    findings: list = field(default_factory=list)
+    cases: list = field(default_factory=list)      # program-case ledgers
+    ast: dict = field(default_factory=dict)        # AST pass coverage
+    n_devices: int = 0
+    passes_run: list = field(default_factory=list)
+
+    def extend(self, findings) -> None:
+        self.findings.extend(findings)
+
+    @property
+    def unwaived(self) -> list:
+        return [f for f in self.findings if not f.waived]
+
+    @property
+    def waived(self) -> list:
+        return [f for f in self.findings if f.waived]
+
+    def apply_baseline(self, baseline: dict,
+                       check_stale: bool = True) -> None:
+        """Mark findings waived per the baseline and append one
+        ``baseline``-pass finding per STALE waiver (a key no finding
+        produces any more — the fix landed, so the waiver must go).
+        ``check_stale=False`` for partial runs (a filtered scope cannot
+        judge waivers for passes it did not execute)."""
+        waivers = {w["key"]: w for w in baseline.get("waivers", [])}
+        produced = set()
+        for f in self.findings:
+            if f.waiver_key in waivers:
+                f.waived = True
+                produced.add(f.waiver_key)
+        if not check_stale:
+            return
+        for key, w in waivers.items():
+            if key in produced:
+                continue
+            self.findings.append(Finding(
+                pass_id="baseline",
+                severity="error",
+                location="ANALYSIS_BASELINE.json",
+                message=(
+                    f"stale waiver {key!r} (justification: "
+                    f"{w.get('justification', '?')!r}): no pass produces "
+                    "this finding any more — the underlying issue was "
+                    "fixed or renamed; remove the waiver (or re-key it) "
+                    "so the baseline stays regeneration-exact"
+                ),
+                waiver_key=finding_key("baseline", "stale", key),
+            ))
+
+    def to_dict(self) -> dict:
+        sev = {"error": 0, "warning": 0}
+        for f in self.unwaived:
+            sev[f.severity] += 1
+        return {
+            "schema": SCHEMA,
+            "n_devices": self.n_devices,
+            "passes_run": sorted(self.passes_run),
+            "n_findings": len(self.findings),
+            "n_unwaived": len(self.unwaived),
+            "n_waived": len(self.waived),
+            "unwaived_by_severity": sev,
+            "findings": [f.to_dict() for f in sorted(
+                self.findings,
+                key=lambda f: (f.waived, f.severity != "error",
+                               f.pass_id, f.location),
+            )],
+            "cases": self.cases,
+            "ast": self.ast,
+        }
+
+
+# ------------------------------------------------------------- baseline
+
+def load_baseline(path: str) -> dict:
+    """Load + validate ANALYSIS_BASELINE.json. Every waiver must carry
+    key + justification + date — an unjustified waiver is refused here,
+    not discovered in review."""
+    if not os.path.exists(path):
+        return {"schema": SCHEMA, "waivers": []}
+    with open(path) as f:
+        doc = json.load(f)
+    seen = set()
+    for i, w in enumerate(doc.get("waivers", [])):
+        for req in ("key", "justification", "date"):
+            if not str(w.get(req, "")).strip():
+                raise ValueError(
+                    f"{path}: waiver #{i} missing {req!r} — every waiver "
+                    "names its key, WHY the finding is load-bearing, and "
+                    "the date it was taken"
+                )
+        if w["key"] in seen:
+            raise ValueError(f"{path}: duplicate waiver key {w['key']!r}")
+        seen.add(w["key"])
+    return doc
+
+
+def write_report(report: Report, path: str) -> None:
+    with open(path, "w") as f:
+        json.dump(report.to_dict(), f, indent=1, sort_keys=True)
+        f.write("\n")

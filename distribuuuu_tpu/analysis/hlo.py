@@ -1,0 +1,278 @@
+"""Text-level parsers over lowered StableHLO and compiled (post-GSPMD)
+HLO — the program passes' shared toolbox.
+
+Everything here is pure string → dict: shapes and byte counts, the
+``input_output_alias`` map, the collective census with replica-group →
+mesh-axis attribution, and bf16→f32 upcast extraction with scope
+attribution from the MLIR location table. No jax arrays are touched;
+the analyzer hands in the texts it got from the one lowered/compiled
+bundle per stanza (analysis/program.py).
+"""
+
+from __future__ import annotations
+
+import re
+
+import numpy as np
+
+# HLO primitive byte widths (the types step programs actually contain)
+DTYPE_BYTES = {
+    "pred": 1, "s8": 1, "u8": 1, "f8e4m3fn": 1, "f8e5m2": 1,
+    "s16": 2, "u16": 2, "f16": 2, "bf16": 2,
+    "s32": 4, "u32": 4, "f32": 4,
+    "s64": 8, "u64": 8, "f64": 8, "c64": 8,
+    "c128": 16,
+}
+
+COLLECTIVE_OPS = (
+    "all-reduce", "all-gather", "reduce-scatter", "all-to-all",
+    "collective-permute",
+)
+
+_SHAPE_RE = re.compile(r"([a-z0-9]+)\[([\d,]*)\]")
+
+
+def shape_bytes(shape_text: str) -> int:
+    """Bytes of one HLO shape literal (``f32[2,8,64]{...}``); tuple
+    shapes sum their elements."""
+    total = 0
+    for m in _SHAPE_RE.finditer(shape_text):
+        dtype, dims = m.group(1), m.group(2)
+        if dtype not in DTYPE_BYTES:
+            continue
+        n = 1
+        for d in dims.split(","):
+            if d:
+                n *= int(d)
+        total += n * DTYPE_BYTES[dtype]
+    return total
+
+
+# ------------------------------------------------------- replica groups
+
+def decode_replica_groups(text: str) -> list[list[int]] | None:
+    """Replica groups of one collective op line, both HLO spellings:
+
+    * explicit: ``replica_groups={{0,2},{1,3}}``
+    * iota v2:  ``replica_groups=[2,4]<=[4,2]T(1,0)`` — arange over the
+      tile dims, transposed by the permutation, reshaped to the group
+      dims (this is XLA's compact form for the mesh-regular groups GSPMD
+      emits).
+    """
+    m = re.search(r"replica_groups=\{\{([\d,{} ]*)\}\}", text)
+    if m:
+        return [
+            [int(x) for x in grp.split(",") if x.strip()]
+            for grp in m.group(1).split("},{")
+        ]
+    m = re.search(
+        r"replica_groups=\[([\d,]+)\]<=\[([\d,]+)\](?:T\(([\d,]+)\))?",
+        text,
+    )
+    if m:
+        group_dims = [int(x) for x in m.group(1).split(",")]
+        tile_dims = [int(x) for x in m.group(2).split(",")]
+        ids = np.arange(int(np.prod(tile_dims))).reshape(tile_dims)
+        if m.group(3):
+            perm = [int(x) for x in m.group(3).split(",")]
+            ids = ids.transpose(perm)
+        return [list(row) for row in ids.reshape(group_dims)]
+    return None
+
+
+def decode_source_target_pairs(text: str) -> list[tuple[int, int]] | None:
+    m = re.search(r"source_target_pairs=\{([\d,{} ]*)\}", text)
+    if not m:
+        return None
+    return [
+        tuple(int(x) for x in pair.split(","))
+        for pair in m.group(1).strip("{}").split("},{")
+        if pair
+    ]
+
+
+def mesh_axis_groups(mesh) -> dict[tuple[str, ...], frozenset]:
+    """Canonical device-id groups for every populated mesh-axis combo:
+    ``{("data",): {{ids varying only along data}, …}, ("data","model"):
+    …}`` — the lookup table replica groups are attributed against."""
+    import itertools
+
+    ids = np.vectorize(lambda d: d.id)(mesh.devices)
+    axis_names = list(mesh.axis_names)
+    populated = [
+        (i, name) for i, name in enumerate(axis_names)
+        if ids.shape[i] > 1
+    ]
+    table: dict[tuple[str, ...], frozenset] = {}
+    for r in range(1, len(populated) + 1):
+        for combo in itertools.combinations(populated, r):
+            axes = tuple(name for _, name in combo)
+            dims = [i for i, _ in combo]
+            other = [i for i in range(ids.ndim) if i not in dims]
+            moved = np.transpose(ids, other + dims)
+            flat = moved.reshape(-1, int(np.prod(
+                [ids.shape[i] for i in dims], dtype=int)))
+            table[axes] = frozenset(
+                frozenset(int(x) for x in row) for row in flat
+            )
+    return table
+
+
+def attribute_groups(groups, table) -> tuple[str, ...] | None:
+    """The mesh-axis combo whose canonical groups exactly match
+    ``groups`` (None = unattributable — an irregular grouping)."""
+    got = frozenset(frozenset(g) for g in groups)
+    for axes, canonical in table.items():
+        if canonical == got:
+            return axes
+    return None
+
+
+def attribute_pairs(pairs, table) -> tuple[str, ...] | None:
+    """Smallest axis combo whose groups contain every (src, tgt) pair —
+    collective-permute has no groups, only a neighbor relation."""
+    best = None
+    for axes, canonical in sorted(
+        table.items(), key=lambda kv: sum(len(g) for g in kv[1])
+    ):
+        ok = all(
+            any(s in g and t in g for g in canonical) for s, t in pairs
+        )
+        if ok:
+            best = axes
+            break
+    return best
+
+
+# ---------------------------------------------------- collective census
+
+def collective_census(compiled_text: str, mesh) -> list[dict]:
+    """Every collective op in the compiled HLO: kind, output bytes,
+    attributed mesh axes, and the op_name scope (for the metric-op
+    exemption). One dict per op instance."""
+    table = mesh_axis_groups(mesh)
+    out = []
+    for line in compiled_text.splitlines():
+        m = re.search(
+            r"=\s+(\([^)]*\)|\S+)\s+(" + "|".join(COLLECTIVE_OPS)
+            + r")(?:-start|-done)?\(",
+            line,
+        )
+        if not m:
+            continue
+        kind = m.group(2)
+        if "-done(" in line:
+            continue  # the -start counted the op; -done is its completion
+        shape = m.group(1)
+        scope_m = re.search(r'op_name="([^"]*)"', line)
+        src_m = re.search(r'source_file="([^"]*)"', line)
+        axes = None
+        pairs = decode_source_target_pairs(line)
+        if pairs is not None:
+            axes = attribute_pairs(pairs, table)
+        else:
+            groups = decode_replica_groups(line)
+            if groups is not None:
+                axes = attribute_groups(groups, table)
+        out.append({
+            "kind": kind,
+            "bytes": shape_bytes(shape),
+            "axes": axes,
+            "scope": scope_m.group(1) if scope_m else "",
+            "source_file": src_m.group(1) if src_m else "",
+        })
+    return out
+
+
+# --------------------------------------------------------- alias parsing
+
+def alias_map(compiled_text: str) -> dict[int, int] | None:
+    """``{flat_output_index: flat_parameter_index}`` from the ENTRY
+    computation's ``input_output_alias`` annotation; None when the
+    program declares no aliasing at all."""
+    m = re.search(r"input_output_alias=\{([^\n]*)\}", compiled_text)
+    if not m:
+        return None
+    out = {}
+    for pm in re.finditer(r"\{(\d*)\}:\s*\((\d+),", m.group(1)):
+        out[int(pm.group(1) or 0)] = int(pm.group(2))
+    return out
+
+
+def entry_parameter_count(compiled_text: str) -> int | None:
+    """Number of parameters of the ENTRY computation (the guard that the
+    flat-arg → HLO-parameter mapping is positional and unpruned)."""
+    pos = compiled_text.find("\nENTRY ")
+    if pos < 0:
+        return None
+    body = compiled_text[pos:]
+    end = body.find("\n}")
+    body = body[: end if end > 0 else len(body)]
+    return len(re.findall(r"=\s+\S+\s+parameter\(\d+\)", body))
+
+
+# ------------------------------------------------------ upcast extraction
+
+def stablehlo_with_locs(lowered) -> str:
+    """The lowered StableHLO text WITH the MLIR debug-location table
+    (``Lowered.as_text()`` strips it on this jax line)."""
+    from jax.interpreters import mlir
+
+    return mlir.module_to_string(
+        lowered.compiler_ir("stablehlo"), enable_debug_info=True
+    )
+
+
+def _loc_table(text: str) -> dict[str, str]:
+    return {
+        m.group(1): m.group(2)
+        for m in re.finditer(r"^#loc(\d+) = loc\((.*)\)\s*$", text, re.M)
+    }
+
+
+def resolve_loc(ref: str, table: dict[str, str], depth: int = 12) -> str:
+    """Follow one ``#locN`` reference to a readable ``scope @ file:line``
+    string (loc defs nest: ``"scope"(#locM)`` chains down to a callsite
+    file location)."""
+    scope = ""
+    filename = ""
+    seen = 0
+    while ref in table and seen < depth:
+        d = table[ref]
+        seen += 1
+        sm = re.match(r'"([^"]+)"', d)
+        if sm and not scope and not sm.group(1).endswith(".py"):
+            scope = sm.group(1)
+        fm = re.search(r'"([^"]+\.py)":(\d+)', d)
+        if fm and not filename:
+            filename = f"{fm.group(1)}:{fm.group(2)}"
+        nm = re.search(r"#loc(\d+)", d)
+        if not nm:
+            break
+        ref = nm.group(1)
+    return " @ ".join(x for x in (scope, filename) if x)
+
+
+def upcast_census(stablehlo_text: str) -> list[dict]:
+    """Every ``stablehlo.convert`` producing f32 from a bf16 operand in
+    the lowered program — the trace-time promotions the dtype lint
+    audits (compile-time converts XLA inserts for collectives are not
+    the program author's doing and are excluded by construction)."""
+    table = _loc_table(stablehlo_text)
+    out = []
+    for m in re.finditer(
+        r"stablehlo\.convert\s+%\S+\s*:\s*\(tensor<([^>]*)xbf16>\)\s*->"
+        r"\s*tensor<[^>]*xf32>(?:\s+loc\(#loc(\d+)\))?",
+        stablehlo_text,
+    ):
+        dims = [int(d) for d in m.group(1).split("x") if d.isdigit()]
+        n = 1
+        for d in dims:
+            n *= d
+        loc = resolve_loc(m.group(2), table) if m.group(2) else ""
+        out.append({
+            "shape": "x".join(str(d) for d in dims) or "scalar",
+            "elements": n,
+            "scope": loc,
+        })
+    return out

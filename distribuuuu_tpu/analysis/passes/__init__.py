@@ -1,0 +1,27 @@
+"""Analysis passes. Program passes take a ``ProgramBundle`` and return
+findings for one stanza; AST passes take the repo root and return
+findings for the source tree. ``PROGRAM_PASSES`` / ``AST_PASSES`` are
+the registries the runner and the CLI iterate."""
+
+from distribuuuu_tpu.analysis.passes import (
+    collectives,
+    dispatch,
+    donation,
+    dtype,
+    knobs,
+    replication,
+    telemetry,
+)
+
+PROGRAM_PASSES = {
+    "replication": replication.run,
+    "donation": donation.run,
+    "collectives": collectives.run,
+    "dtype": dtype.run,
+}
+
+AST_PASSES = {
+    "knobs": knobs.run,
+    "dispatch": dispatch.run,
+    "telemetry": telemetry.run,
+}

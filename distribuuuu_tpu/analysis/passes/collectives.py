@@ -1,0 +1,126 @@
+"""Collective lint: the compiled program's per-mesh-axis collective
+census vs what the spec algebra predicts for the declared layout.
+
+The census (``hlo.collective_census``) attributes every all-gather /
+all-reduce / reduce-scatter / all-to-all / collective-permute to the
+mesh axes its replica groups span; the prediction
+(``specs.collective_expectations``) knows which op kinds each layout
+legitimately produces and bounds the dangerous one — all-gathers over
+the ``data`` axis:
+
+* a gather over ``data`` in a program with NO ZeRO stage means
+  something rests sharded that the declaration says is replicated (a
+  silent re-gather — the layout and the program disagree);
+* more data-gathers than the rest-layout re-gather bound is a gather
+  storm (per-use gathering instead of gather-once);
+* reduce-scatter / all-to-all / collective-permute over axes no feature
+  predicts are redundant collectives.
+
+Metric-scope ops are exempt (the ``top_k`` logits gather in
+utils/metrics.py is a handful of KB and semantically a metric, not a
+layout leak) — exempt from *findings*, still counted in the ledger.
+The fused-update replicated-pin (PR 13) is recognized through the
+expectations table (its whole-leaf gathers raise the bound), not
+re-flagged. The full per-axis count/bytes ledger lands in the report's
+case record either way: ROADMAP #1's overlap work reads it as its
+before/after referee.
+"""
+
+from __future__ import annotations
+
+from distribuuuu_tpu.analysis import hlo
+from distribuuuu_tpu.analysis.findings import Finding, finding_key
+
+PASS_ID = "collectives"
+
+# op scopes that are metrics/loss bookkeeping, not layout traffic
+METRIC_SCOPE = ("top_k", "metrics.py", "accuracy", "cross_entropy")
+
+
+def _is_metric(op: dict) -> bool:
+    hay = op["scope"] + " " + op["source_file"]
+    return any(tok in hay for tok in METRIC_SCOPE)
+
+
+def ledger_from_census(census) -> dict:
+    """{axes-key: {kind: {count, bytes}}} — the report artifact."""
+    out: dict = {}
+    for op in census:
+        axes = "+".join(op["axes"]) if op["axes"] else "unattributed"
+        slot = out.setdefault(axes, {}).setdefault(
+            op["kind"], {"count": 0, "bytes": 0, "metric_ops": 0}
+        )
+        slot["count"] += 1
+        slot["bytes"] += op["bytes"]
+        if _is_metric(op):
+            slot["metric_ops"] += 1
+    return out
+
+
+def run(bundle) -> list:
+    findings = []
+    census = hlo.collective_census(bundle.compiled_text, bundle.mesh)
+    bundle.extras["collective_ledger"] = ledger_from_census(census)
+    exp = bundle.expectations
+    allowed = exp["allowed"]
+
+    # --- unexpected op kinds over axes the spec algebra predicts none of
+    flagged: dict = {}
+    for op in census:
+        if op["axes"] is None or _is_metric(op):
+            continue
+        kinds_allowed = allowed.get(op["kind"])
+        if kinds_allowed is None:
+            continue  # unconstrained kind (all-reduce)
+        if set(op["axes"]) <= kinds_allowed:
+            continue
+        key = (op["kind"], op["axes"])
+        slot = flagged.setdefault(key, {"count": 0, "bytes": 0,
+                                        "scope": op["scope"]})
+        slot["count"] += 1
+        slot["bytes"] += op["bytes"]
+    for (kind, axes), slot in sorted(flagged.items()):
+        axes_s = "+".join(axes)
+        findings.append(Finding(
+            pass_id=PASS_ID, severity="error",
+            location=f"{bundle.name}::{kind}@{axes_s}",
+            message=(
+                f"{slot['count']} {kind} op(s) over mesh axes {axes_s} "
+                f"({slot['bytes']} B) that the declared layout predicts "
+                f"ZERO of (zero={bundle.topology.zero}, features="
+                f"{sorted(bundle.topology.features())}): something rests "
+                "sharded that the declaration says is replicated, or a "
+                "redundant collective. First scope: "
+                f"{slot['scope'][:120] or '<none>'}"
+            ),
+            waiver_key=finding_key(PASS_ID, bundle.name, kind, axes_s),
+        ))
+
+    # --- gather-storm bound over the data axis
+    bound = exp["gather_bound"]
+    if bound is not None:
+        data_gathers = [
+            op for op in census
+            if op["kind"] == "all-gather" and op["axes"] == ("data",)
+            and not _is_metric(op)
+        ]
+        if len(data_gathers) > bound:
+            gbytes = sum(op["bytes"] for op in data_gathers)
+            findings.append(Finding(
+                pass_id=PASS_ID, severity="warning",
+                location=f"{bundle.name}::all-gather@data",
+                message=(
+                    f"gather storm: {len(data_gathers)} non-metric "
+                    f"all-gathers over data ({gbytes} B) vs the "
+                    f"rest-layout re-gather bound {bound} "
+                    f"(= f(zero={bundle.topology.zero}, "
+                    f"{exp['zero_sharded']} sharded leaves"
+                    + (", fused-update pin" if bundle.fused_update_pinned
+                       else "")
+                    + ")): the program gathers per use instead of once"
+                ),
+                waiver_key=finding_key(
+                    PASS_ID, bundle.name, "gather-storm", "data"
+                ),
+            ))
+    return findings

@@ -1,0 +1,178 @@
+"""Dispatch-discipline lint: device dispatch on threads outside the
+sequencer token ring — the PR 11 deadlock class as a static check.
+
+The pinned failure: two host threads dispatching SPMD programs onto one
+multi-device mesh can enqueue in different per-device orders; the
+collectives cross-wait at the XLA rendezvous and the backend wedges.
+The fix (asyncplane/sequencer.py) is that every dispatch from a worker
+thread goes through ``sequencer.dispatch`` — one token ring, one global
+program order. This pass keeps that invariant: in the async plane and
+the trainer, any *thread-entry* function (a function handed to
+``threading.Thread(target=…)``, plus same-module functions it calls)
+that directly calls ``jax.device_put`` / ``jax.block_until_ready`` /
+``jax.jit`` dispatch is a finding, unless the call is lexically inside
+a ``sequencer.dispatch(...)`` argument or lives in sequencer.py itself
+(whose fences ARE the ring).
+
+Main-thread dispatch sites are deliberately NOT flagged — the ring only
+exists to order concurrent streams; the epoch loop's own dispatches
+chain by construction. The lint is narrow and precise over the modules
+where worker threads live rather than heuristic over the world.
+"""
+
+from __future__ import annotations
+
+import ast
+import glob
+import os
+
+from distribuuuu_tpu.analysis.findings import Finding, finding_key
+
+PASS_ID = "dispatch"
+
+# where worker threads that touch devices live
+SCAN_GLOBS = (
+    "distribuuuu_tpu/asyncplane/*.py",
+    "distribuuuu_tpu/trainer.py",
+)
+EXEMPT_BASENAMES = ("sequencer.py",)  # the ring itself
+
+# the dispatch surfaces (attribute names on the jax module)
+DISPATCH_ATTRS = {"device_put", "block_until_ready"}
+
+
+def _is_dispatch_call(call: ast.Call) -> str | None:
+    f = call.func
+    if isinstance(f, ast.Attribute) and f.attr in DISPATCH_ATTRS:
+        root = f.value
+        if isinstance(root, ast.Name) and root.id == "jax":
+            return f"jax.{f.attr}"
+    return None
+
+
+def _is_sequencer_dispatch(call: ast.Call) -> bool:
+    f = call.func
+    return (
+        isinstance(f, ast.Attribute) and f.attr == "dispatch"
+        and isinstance(f.value, ast.Name) and f.value.id == "sequencer"
+    )
+
+
+class _ModuleIndex(ast.NodeVisitor):
+    """Function defs, thread targets, and call edges of one module."""
+
+    def __init__(self):
+        self.defs: dict[str, ast.AST] = {}
+        self.thread_targets: set[str] = set()
+        self._stack: list[str] = []
+        self.calls: dict[str, set[str]] = {}
+
+    def visit_FunctionDef(self, node):
+        self.defs[node.name] = node
+        self._stack.append(node.name)
+        self.calls.setdefault(node.name, set())
+        self.generic_visit(node)
+        self._stack.pop()
+
+    visit_AsyncFunctionDef = visit_FunctionDef
+
+    def visit_Call(self, node):
+        # threading.Thread(target=X) / Thread(target=self.X)
+        f = node.func
+        name = f.attr if isinstance(f, ast.Attribute) else (
+            f.id if isinstance(f, ast.Name) else None
+        )
+        if name == "Thread":
+            for kw in node.keywords:
+                if kw.arg != "target":
+                    continue
+                t = kw.value
+                if isinstance(t, ast.Name):
+                    self.thread_targets.add(t.id)
+                elif isinstance(t, ast.Attribute):
+                    self.thread_targets.add(t.attr)
+        if self._stack:
+            callee = None
+            if isinstance(f, ast.Name):
+                callee = f.id
+            elif isinstance(f, ast.Attribute) and isinstance(
+                f.value, ast.Name
+            ) and f.value.id == "self":
+                callee = f.attr
+            if callee:
+                self.calls[self._stack[-1]].add(callee)
+        self.generic_visit(node)
+
+
+def _thread_reachable(index: _ModuleIndex) -> set[str]:
+    """Thread targets plus same-module functions they call (fixpoint)."""
+    reach = set(t for t in index.thread_targets if t in index.defs)
+    frontier = list(reach)
+    while frontier:
+        fn = frontier.pop()
+        for callee in index.calls.get(fn, ()):
+            if callee in index.defs and callee not in reach:
+                reach.add(callee)
+                frontier.append(callee)
+    return reach
+
+
+def _violations_in(fn_node, rel: str, fn_name: str) -> list:
+    """Dispatch calls inside one thread-reachable function that are not
+    wrapped in sequencer.dispatch(...)."""
+    # parent map for the lexical sequencer.dispatch ancestry check
+    parents: dict[ast.AST, ast.AST] = {}
+    for node in ast.walk(fn_node):
+        for child in ast.iter_child_nodes(node):
+            parents[child] = node
+    out = []
+    for node in ast.walk(fn_node):
+        if not isinstance(node, ast.Call):
+            continue
+        surface = _is_dispatch_call(node)
+        if surface is None:
+            continue
+        cur = node
+        wrapped = False
+        while cur in parents:
+            cur = parents[cur]
+            if isinstance(cur, ast.Call) and _is_sequencer_dispatch(cur):
+                wrapped = True
+                break
+        if wrapped:
+            continue
+        out.append(Finding(
+            pass_id=PASS_ID, severity="error",
+            location=f"{rel}:{node.lineno}",
+            message=(
+                f"{surface} on the worker-thread path "
+                f"({fn_name}(), a threading.Thread target or called "
+                "from one) outside the sequencer token ring — two "
+                "free-running dispatch streams can invert per-device "
+                "program order and deadlock the backend at the XLA "
+                "rendezvous (the pinned PR 11 failure); route it "
+                "through sequencer.dispatch(...)"
+            ),
+            waiver_key=finding_key(PASS_ID, rel, fn_name, surface),
+        ))
+    return out
+
+
+def run(repo: str) -> list:
+    findings = []
+    for pattern in SCAN_GLOBS:
+        for path in sorted(glob.glob(os.path.join(repo, pattern))):
+            base = os.path.basename(path)
+            if base in EXEMPT_BASENAMES or "__pycache__" in path:
+                continue
+            rel = os.path.relpath(path, repo)
+            with open(path) as f:
+                try:
+                    tree = ast.parse(f.read(), filename=rel)
+                except SyntaxError:
+                    continue
+            index = _ModuleIndex()
+            index.visit(tree)
+            for fn in sorted(_thread_reachable(index)):
+                findings.extend(_violations_in(index.defs[fn], rel, fn))
+    return findings

@@ -1,0 +1,378 @@
+"""Config-knob drift lint, both directions.
+
+Forward: every ``cfg.X.Y`` *read* anywhere in the package / tools /
+entry scripts must be DECLARED in ``config.py`` — an undeclared read is
+a typo that AttributeErrors at runtime (or worse, a knob someone forgot
+to add defaults for). Backward: every declared leaf knob must be read
+somewhere (a dead knob is config surface that silently does nothing —
+users set it and nothing changes) and must be mentioned in the
+README/RUNBOOK corpus (an undocumented knob is invisible; the RUNBOOK
+knob index exists so this direction stays cheap to satisfy). Doc
+mentions are checked in reverse too: a dotted ``SECTION.KNOB`` token in
+the docs whose section exists but whose leaf does not is a stale doc.
+
+Resolution is deliberately conservative where static analysis cannot
+see: a read of a bare section object (``cfg.MODEL.MOE`` passed as an
+argument) marks the whole section *escaped* — its children are
+reachable through the alias, so they are never reported dead. Dynamic
+subscripts (``cfg.MESH[key]``) mark the section dynamically-read with
+the same effect. Sound over noisy: this pass must never cry wolf on a
+knob that IS read.
+"""
+
+from __future__ import annotations
+
+import ast
+import glob
+import os
+import re
+
+from distribuuuu_tpu.analysis.findings import Finding, finding_key
+
+PASS_ID = "knobs"
+
+# files whose cfg reads count as "the program" (tests deliberately
+# excluded: a knob only a test reads is still dead in production)
+READ_GLOBS = (
+    "distribuuuu_tpu/**/*.py",
+    "tools/*.py",
+    "train_net.py",
+    "test_net.py",
+    "serve_net.py",
+    "bench.py",
+    "__graft_entry__.py",
+)
+
+DOC_FILES = ("README.md", "docs/RUNBOOK.md", "docs/DESIGN.md",
+             "docs/PARALLELISM.md")
+
+
+# ------------------------------------------------------------ declared
+
+def declared_knobs(config_path: str) -> tuple[set, set]:
+    """(leaves, sections) of the config tree, from config.py's
+    ``_C.<chain> = value`` assignments (a CfgNode() value declares a
+    section; anything else a leaf knob)."""
+    with open(config_path) as f:
+        tree = ast.parse(f.read(), filename=config_path)
+    leaves, sections = set(), set()
+
+    def chain_of(node):
+        parts = []
+        while isinstance(node, ast.Attribute):
+            parts.append(node.attr)
+            node = node.value
+        if isinstance(node, ast.Name) and node.id == "_C":
+            return ".".join(reversed(parts))
+        return None
+
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Assign) or len(node.targets) != 1:
+            continue
+        dotted = None
+        t = node.targets[0]
+        if isinstance(t, ast.Attribute):
+            dotted = chain_of(t)
+        if not dotted:
+            continue
+        is_section = (
+            isinstance(node.value, ast.Call)
+            and isinstance(node.value.func, ast.Name)
+            and node.value.func.id == "CfgNode"
+        )
+        (sections if is_section else leaves).add(dotted)
+    return leaves, sections
+
+
+# --------------------------------------------------------------- reads
+
+class _ReadCollector(ast.NodeVisitor):
+    """cfg.<chain> reads: dotted paths, section escapes, dynamic reads."""
+
+    def __init__(self):
+        self.reads: set[str] = set()
+        self.dynamic: set[str] = set()   # sections subscripted dynamically
+
+    def _root_chain(self, node):
+        """Walk down Attribute/Subscript/.get() spine to the root Name;
+        returns the dotted chain above ``cfg`` or None."""
+        parts = []
+        while True:
+            if isinstance(node, ast.Attribute):
+                parts.append(("attr", node.attr))
+                node = node.value
+            elif isinstance(node, ast.Subscript):
+                sl = node.slice
+                if isinstance(sl, ast.Constant) and isinstance(sl.value, str):
+                    parts.append(("attr", sl.value))
+                else:
+                    parts.append(("dyn", None))
+                node = node.value
+            else:
+                break
+        if isinstance(node, ast.Name) and node.id in ("cfg", "_C"):
+            return list(reversed(parts))
+        return None
+
+    def visit_Call(self, call):
+        # cfg.SECTION.get("KNOB", default) reads SECTION.KNOB
+        f = call.func
+        if (
+            isinstance(f, ast.Attribute) and f.attr == "get"
+            and call.args and isinstance(call.args[0], ast.Constant)
+            and isinstance(call.args[0].value, str)
+        ):
+            chain = self._root_chain(f.value)
+            if chain is not None:
+                self._record(chain + [("attr", call.args[0].value)])
+                for a in call.args[1:]:
+                    self.visit(a)
+                return
+        self.generic_visit(call)
+
+    def visit_Attribute(self, node):
+        self._maybe(node)
+
+    def visit_Subscript(self, node):
+        self._maybe(node)
+        # still visit the slice (it may contain cfg reads)
+        self.visit(node.slice)
+
+    def _maybe(self, node):
+        if not isinstance(getattr(node, "ctx", ast.Load()), ast.Load):
+            # a WRITE target: setting a knob is not a read, and its
+            # prefix chain is not a section-object read either
+            return
+        chain = self._root_chain(node)
+        if chain is None:
+            self.generic_visit(node)
+            return
+        self._record(chain)
+
+    def _record(self, chain):
+        path = []
+        for kind, name in chain:
+            if kind == "dyn":
+                self.dynamic.add(".".join(path))
+                return
+            path.append(name)
+        if path:
+            self.reads.add(".".join(path))
+
+
+def collect_reads(repo: str) -> _ReadCollector:
+    col = _ReadCollector()
+    for pattern in READ_GLOBS:
+        for path in sorted(glob.glob(os.path.join(repo, pattern),
+                                     recursive=True)):
+            if "__pycache__" in path:
+                continue
+            # config.py itself participates: its declarations are Store
+            # context (never counted), but dump_cfg & co genuinely READ
+            # knobs like CFG_DEST/OUT_DIR
+            try:
+                with open(path) as f:
+                    tree = ast.parse(f.read(), filename=path)
+            except SyntaxError:
+                continue
+            col.visit(tree)
+    return col
+
+
+# ---------------------------------------------------------------- docs
+
+def doc_corpus(repo: str) -> str:
+    texts = []
+    for rel in DOC_FILES:
+        path = os.path.join(repo, rel)
+        if os.path.exists(path):
+            with open(path) as f:
+                texts.append(f.read())
+    return "\n".join(texts)
+
+
+def doc_mentions(corpus: str) -> set[str]:
+    """Every dotted UPPER.CASE token in the docs (knob-shaped)."""
+    return set(re.findall(
+        r"\b[A-Z][A-Z0-9_]*(?:\.[A-Z][A-Z0-9_]*)+\b", corpus
+    ))
+
+
+# ----------------------------------------------------------- knob index
+
+def knob_index_markdown(config_path: str) -> str:
+    """Generate the RUNBOOK 'Config knob index' table from config.py:
+    every leaf knob with its default and the first sentence of the
+    comment block above its declaration. ``python tools/staticcheck.py
+    --knob-index`` prints it; the docs-mention direction of this pass
+    keeps it complete (a new knob missing from the index is a finding).
+    """
+    with open(config_path) as f:
+        src = f.read()
+    tree = ast.parse(src, filename=config_path)
+    lines = src.splitlines()
+    rows = []
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Assign) or len(node.targets) != 1:
+            continue
+        t = node.targets[0]
+        parts = []
+        while isinstance(t, ast.Attribute):
+            parts.append(t.attr)
+            t = t.value
+        if not (isinstance(t, ast.Name) and t.id == "_C"):
+            continue
+        dotted = ".".join(reversed(parts))
+        if (
+            isinstance(node.value, ast.Call)
+            and isinstance(node.value.func, ast.Name)
+            and node.value.func.id == "CfgNode"
+        ):
+            continue  # sections head their own group implicitly
+        try:
+            default = repr(ast.literal_eval(node.value))
+        except (ValueError, SyntaxError):
+            default = "<computed>"
+        # the comment block immediately above the assignment
+        comment: list[str] = []
+        i = node.lineno - 2
+        while i >= 0 and lines[i].lstrip().startswith("#"):
+            comment.append(lines[i].lstrip().lstrip("#").strip())
+            i -= 1
+        text = " ".join(reversed(comment))
+        first = re.split(r"(?<=[.;])\s", text, maxsplit=1)[0] if text else ""
+        if len(first) > 110:
+            first = first[:107] + "…"
+        rows.append((dotted, default, first))
+    rows.sort()
+    out = ["| Knob | Default | What it does |", "| --- | --- | --- |"]
+    for dotted, default, first in rows:
+        if len(default) > 24:
+            default = default[:21] + "…"
+        out.append(f"| `{dotted}` | `{default}` | {first} |")
+    return "\n".join(out)
+
+
+# ----------------------------------------------------------------- run
+
+# CfgNode's own API surface — attribute reads on a section that are
+# method calls, not knob reads
+DICT_METHODS = {
+    "get", "keys", "values", "items", "clone", "dump", "freeze",
+    "defrost", "is_frozen", "merge_from_file", "merge_from_list",
+    "merge_from_other_cfg", "to_dict", "update", "pop", "setdefault",
+}
+
+
+def run(repo: str) -> list:
+    findings = []
+    config_path = os.path.join(repo, "distribuuuu_tpu", "config.py")
+    leaves, sections = declared_knobs(config_path)
+    col = collect_reads(repo)
+
+    # resolve raw chains: method/attr access on a declared leaf counts
+    # as reading the leaf; anything below a declared SECTION that is not
+    # declared (and not a dict method) is an undeclared read
+    resolved: set[str] = set()
+    undeclared: set[str] = set()
+    for read in col.reads:
+        if read in leaves or read in sections:
+            resolved.add(read)
+            continue
+        parts = read.split(".")
+        for i in range(len(parts) - 1, 0, -1):
+            prefix = ".".join(parts[:i])
+            if prefix in leaves:
+                resolved.add(prefix)
+                break
+            if prefix in sections:
+                if parts[i] in DICT_METHODS:
+                    resolved.add(prefix)
+                else:
+                    undeclared.add(".".join(parts[: i + 1]))
+                break
+        # chains rooted at no declared name (cfg.items() etc.) are
+        # CfgNode API reads, not knob reads — ignored
+
+    # sections read as bare objects (aliased away) or dynamically
+    escaped = {r for r in resolved if r in sections} | col.dynamic
+
+    # (1) undeclared reads
+    for read in sorted(undeclared):
+        if any(read == e or read.startswith(e + ".") for e in col.dynamic):
+            continue
+        findings.append(Finding(
+            pass_id=PASS_ID, severity="error",
+            location=f"cfg.{read}",
+            message=(
+                f"cfg.{read} is read but never declared in config.py — "
+                "an AttributeError waiting for that code path (declare "
+                "the knob with a default and a comment, or fix the typo)"
+            ),
+            waiver_key=finding_key(PASS_ID, "undeclared", read),
+        ))
+
+    # (2) dead declared knobs
+    for leaf in sorted(leaves):
+        if leaf in resolved:
+            continue
+        if any(leaf == e or leaf.startswith(e + ".") for e in escaped):
+            continue
+        findings.append(Finding(
+            pass_id=PASS_ID, severity="warning",
+            location=f"config.py::{leaf}",
+            message=(
+                f"declared knob {leaf} is never read by the package, "
+                "tools, or entry scripts — dead config surface: users "
+                "can set it and nothing changes (remove it, or waive "
+                "with the reason it must stay, e.g. reference-YAML "
+                "schema compatibility)"
+            ),
+            waiver_key=finding_key(PASS_ID, "dead", leaf),
+        ))
+
+    # (3) docs: every leaf knob mentioned; stale doc mentions
+    corpus = doc_corpus(repo)
+    mentions = doc_mentions(corpus)
+    top_sections = {s.split(".")[0] for s in sections} | {"OUT_DIR"}
+    for leaf in sorted(leaves):
+        dotted_forms = {leaf}
+        if leaf.count(".") >= 2:
+            # nested sections also accept the short form (FLEET.REPLICAS)
+            dotted_forms.add(".".join(leaf.split(".")[-2:]))
+        if "." not in leaf:
+            continue  # top-level scalars (OUT_DIR etc.) documented freely
+        if dotted_forms & mentions:
+            continue
+        findings.append(Finding(
+            pass_id=PASS_ID, severity="warning",
+            location=f"docs::{leaf}",
+            message=(
+                f"declared knob {leaf} appears nowhere in "
+                f"{'/'.join(DOC_FILES)} — add it to the RUNBOOK knob "
+                "index (docs/RUNBOOK.md 'Config knob index') so "
+                "operators can find it"
+            ),
+            waiver_key=finding_key(PASS_ID, "undocumented", leaf),
+        ))
+    for token in sorted(mentions):
+        root = token.split(".")[0]
+        if root not in top_sections:
+            continue
+        if token.endswith("_"):
+            continue  # docs wildcard convention (FAULTS.STALL_*)
+        if token in leaves or token in sections:
+            continue
+        # accept short nested forms (FLEET.REPLICAS for SERVE.FLEET.…)
+        if any(l.endswith("." + token) for l in leaves | sections):
+            continue
+        findings.append(Finding(
+            pass_id=PASS_ID, severity="warning",
+            location=f"docs::{token}",
+            message=(
+                f"docs mention {token} but config.py declares no such "
+                "knob — stale documentation (renamed or removed knob)"
+            ),
+            waiver_key=finding_key(PASS_ID, "stale-doc", token),
+        ))
+    return findings

@@ -1,0 +1,102 @@
+"""Silent-replication lint: a leaf whose DECLARED spec is sharded but
+whose COMPILED sharding rests replicated.
+
+This generalizes the PR 12 drift gate (tests/test_mesh_stanzas.py, which
+compares a handful of stanzas at runtime) into a static pass over the
+whole config registry: the declared layout comes from the SpecTable /
+annotations (``specs.state_layout``), the compiled verdict from the
+train step's output shardings — the state the program actually leaves
+at rest every step. The message carries the uneven-dim arithmetic that
+explains the one way this legitimately happens (GSPMD demotes a spec it
+cannot satisfy; a prime vocab dim on a model axis was PR 12's instance:
+``257 % 2 = 1``).
+
+Declared-replicated leaves that COMPILE sharded are flagged too (the
+reverse drift): the declaration is the contract in both directions.
+"""
+
+from __future__ import annotations
+
+import jax
+
+from distribuuuu_tpu.analysis.findings import Finding, finding_key
+from distribuuuu_tpu.parallel.partition import specs
+
+PASS_ID = "replication"
+
+
+def _axis_sizes(mesh) -> dict:
+    return {k: int(v) for k, v in dict(mesh.shape).items()}
+
+
+def _arith(shape, declared_spec, axis_sizes) -> str:
+    """The per-dim divisibility arithmetic for the message."""
+    bits = []
+    entries = tuple(declared_spec) if declared_spec is not None else ()
+    for dim, entry in enumerate(entries):
+        names = (entry,) if isinstance(entry, str) else tuple(entry or ())
+        for ax in names:
+            size = axis_sizes.get(ax, 1)
+            if size > 1 and dim < len(shape):
+                rem = shape[dim] % size
+                bits.append(
+                    f"dim{dim}={shape[dim]} over {ax}({size}): "
+                    f"{shape[dim]} % {size} = {rem}"
+                    + ("" if rem == 0 else " — UNEVEN, GSPMD demotes")
+                )
+    return "; ".join(bits) or "no populated axis named"
+
+
+def run(bundle) -> list:
+    findings = []
+    axis_sizes = _axis_sizes(bundle.mesh)
+    declared_flat = jax.tree_util.tree_flatten_with_path(
+        bundle.layout["params"]
+    )[0]
+    compiled_flat = jax.tree_util.tree_flatten_with_path(
+        bundle.state_out_shardings.params
+    )[0]
+    shape_flat = jax.tree_util.tree_flatten_with_path(
+        bundle.state_in.params
+    )[0]
+    if not (len(declared_flat) == len(compiled_flat) == len(shape_flat)):
+        findings.append(Finding(
+            pass_id=PASS_ID, severity="error", location=bundle.name,
+            message=(
+                f"declared/compiled/abstract param trees disagree on leaf "
+                f"count ({len(declared_flat)}/{len(compiled_flat)}/"
+                f"{len(shape_flat)}) — the pass cannot compare them"
+            ),
+            waiver_key=finding_key(PASS_ID, bundle.name, "tree-mismatch"),
+        ))
+        return findings
+
+    for (path, decl), (_, comp), (_, leaf) in zip(
+        declared_flat, compiled_flat, shape_flat
+    ):
+        leaf_path = specs.leaf_path(path)
+        d = specs.canonicalize(decl.spec, axis_sizes)
+        c = specs.canonicalize(comp.spec, axis_sizes)
+        if d == c:
+            continue
+        shape = tuple(leaf.shape)
+        if len(tuple(d)) and not len(tuple(c)):
+            msg = (
+                f"declared {decl.spec} but the compiled program rests this "
+                f"leaf REPLICATED — every data rank holds all "
+                f"{shape} elements. Arithmetic: "
+                f"{_arith(shape, decl.spec, axis_sizes)}"
+            )
+        else:
+            msg = (
+                f"declared {decl.spec} but compiled {comp.spec} — the "
+                f"declaration and GSPMD disagree "
+                f"({_arith(shape, decl.spec, axis_sizes)})"
+            )
+        findings.append(Finding(
+            pass_id=PASS_ID, severity="error",
+            location=f"{bundle.name}::params/{leaf_path}",
+            message=msg,
+            waiver_key=finding_key(PASS_ID, bundle.name, leaf_path),
+        ))
+    return findings

@@ -1,0 +1,138 @@
+"""One analyzed program per config stanza — lowered once, compiled once.
+
+``build_bundle`` drives the EXISTING partition layer exactly the way
+``train_net.py`` would — merge the stanza, validate through the topology
+registry, ``lowering.lower()`` — then lowers/compiles the train step
+against abstract declared-sharding arguments (``Lowered.abstract_args``)
+and extracts every artifact the program passes need:
+
+* the lowered StableHLO text with debug locations (dtype pass),
+* the compiled post-GSPMD HLO text (collectives, donation),
+* the compiled output shardings of the state tree (replication pass),
+* ``memory_analysis()`` byte counts (donation footprint arithmetic),
+* the spec-algebra collective expectations
+  (``specs.collective_expectations``).
+
+Each pass reads this one :class:`ProgramBundle`; nothing compiles twice.
+
+Analysis geometry: the stanza's MESH axes, arch, class count, dtype and
+ZeRO stage — everything placement-relevant — are analyzed VERBATIM.
+Batch geometry (batch size, image size, LM sequence length) is shrunk to
+keep CPU compile cost bounded: batch leaves ride the declared
+``BATCH_TABLE`` specs whatever their size, so placement decisions do not
+depend on it (the same downscaling the mesh-sweep dryrun uses). The
+shrunken geometry is recorded per case in the report.
+"""
+
+from __future__ import annotations
+
+import time
+from dataclasses import dataclass, field
+from typing import Any
+
+# bounded analysis geometry (placement-neutral, see module docstring)
+ANALYSIS_IM_SIZE = 32
+ANALYSIS_SEQ_LEN = 32
+
+
+@dataclass
+class ProgramBundle:
+    """Everything the program passes read for one stanza."""
+
+    name: str
+    arch: str
+    topology: Any
+    mesh: Any
+    layout: dict
+    lowered_text: str
+    compiled_text: str
+    state_in: Any            # abstract state args (SDS with shardings)
+    state_out_shardings: Any  # compiled shardings of the output state
+    n_flat_inputs: int
+    memory: dict | None
+    expectations: dict
+    fused_update_pinned: bool
+    geometry: dict
+    seconds: float = 0.0
+    extras: dict = field(default_factory=dict)
+
+
+def _fused_update_pinned() -> bool:
+    """Is the PR 13 fused-update replicated-pin active in this program?
+    (KERNELS.OPT_UPDATE resolved to a pallas kernel while a ZeRO layout
+    is on — lowering.py pins the kernel operands whole-leaf, and the
+    collective lint must recognize those gathers, not re-flag them.)"""
+    from distribuuuu_tpu.config import cfg
+    from distribuuuu_tpu.ops.pallas import opt_update as fused_opt
+
+    if not cfg.MESH.ZERO:
+        return False
+    return fused_opt.fused_update_for() is not None
+
+
+def build_bundle(name: str, *, n_devices: int = 8,
+                 batch_size: int | None = None) -> ProgramBundle:
+    """Build the analyzed program for the LIVE cfg (caller merged the
+    stanza). One lower, one compile; every extraction after that is
+    text/metadata reads."""
+    import jax
+
+    from distribuuuu_tpu.analysis import hlo
+    from distribuuuu_tpu.config import cfg
+    from distribuuuu_tpu import trainer
+    from distribuuuu_tpu.parallel import mesh as mesh_lib
+    from distribuuuu_tpu.parallel.partition import lowering, specs
+    from distribuuuu_tpu.telemetry import costmodel
+    from distribuuuu_tpu.utils.optim import construct_optimizer
+
+    t0 = time.perf_counter()
+    # bounded geometry (placement-neutral — module docstring)
+    cfg.TRAIN.IM_SIZE = min(int(cfg.TRAIN.IM_SIZE), ANALYSIS_IM_SIZE)
+    cfg.LM.SEQ_LEN = min(int(cfg.LM.SEQ_LEN), ANALYSIS_SEQ_LEN)
+
+    topo = trainer.check_trainer_mesh()
+    mesh = mesh_lib.mesh_from_cfg(cfg)
+    model = trainer.build_model_from_cfg(topo)
+    low = lowering.lower(
+        model, construct_optimizer(), trainer.effective_topk(),
+        mesh=mesh, topology=topo, im_size=cfg.TRAIN.IM_SIZE,
+    )
+    state_sds, batch_sds = low.abstract_args(batch_size)
+    lowered = low.train_step.lower(state_sds, batch_sds)
+    lowered_text = hlo.stablehlo_with_locs(lowered)
+    compiled = lowered.compile()
+    compiled_text = compiled.as_text()
+    try:
+        memory = costmodel.normalize_memory(compiled.memory_analysis())
+    except Exception:
+        memory = None
+    pinned = _fused_update_pinned()
+    state_out = compiled.output_shardings[0]
+    flat_in = jax.tree.leaves((state_sds, batch_sds))
+    return ProgramBundle(
+        name=name,
+        arch=str(cfg.MODEL.ARCH),
+        topology=topo,
+        mesh=mesh,
+        layout=low.layout,
+        lowered_text=lowered_text,
+        compiled_text=compiled_text,
+        state_in=state_sds,
+        state_out_shardings=state_out,
+        n_flat_inputs=len(flat_in),
+        memory=memory,
+        expectations=specs.collective_expectations(
+            low.layout, topo, fused_update_pinned=pinned
+        ),
+        fused_update_pinned=pinned,
+        geometry={
+            "im_size": int(cfg.TRAIN.IM_SIZE),
+            "seq_len": int(cfg.LM.SEQ_LEN),
+            "batch": int(
+                jax.tree.leaves(batch_sds)[0].shape[0]
+            ),
+            "compute_dtype": str(cfg.DEVICE.COMPUTE_DTYPE),
+            "n_devices": int(n_devices),
+        },
+        seconds=round(time.perf_counter() - t0, 1),
+    )

@@ -1,0 +1,209 @@
+"""The analyzer's case generator + orchestration.
+
+Program cases are (a) every shipped model YAML in ``config/`` — the
+stanza merged through the REAL config path, exactly as ``train_net.py``
+would — and (b) the mesh-sweep CORE cases the topology registry
+generates (``tools/mesh_sweep.generate_cases``), i.e. the same matrix
+the MULTICHIP dryrun executes, analyzed statically instead. Each case
+builds ONE ``ProgramBundle`` (one lower, one compile) and every program
+pass reads it.
+
+AST passes run once over the repo tree.
+
+``run_all`` returns a :class:`findings.Report` with the baseline
+applied. The CLI (tools/staticcheck.py) and the tier-1 gate
+(tests/test_staticcheck.py) both drive this entry.
+"""
+
+from __future__ import annotations
+
+import glob
+import os
+import sys
+import traceback
+
+from distribuuuu_tpu.analysis import program
+from distribuuuu_tpu.analysis.findings import (
+    Finding,
+    Report,
+    finding_key,
+    load_baseline,
+)
+from distribuuuu_tpu.analysis.passes import AST_PASSES, PROGRAM_PASSES
+
+BASELINE_FILE = "ANALYSIS_BASELINE.json"
+
+
+def repo_root() -> str:
+    """The repo checkout this package lives in (config/ + tools/)."""
+    here = os.path.dirname(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+    return here
+
+
+def model_yaml_cases(repo: str) -> list[dict]:
+    """One case per shipped model YAML (non-model YAMLs like
+    monitor_rules are skipped the same way the stanza gate skips them)."""
+    import yaml
+
+    cases = []
+    for path in sorted(glob.glob(os.path.join(repo, "config", "*.yaml"))):
+        with open(path) as f:
+            doc = yaml.safe_load(f) or {}
+        if "MODEL" not in doc:
+            continue
+        cases.append({
+            "name": f"config/{os.path.basename(path)}",
+            "kind": "yaml",
+            "path": path,
+        })
+    return cases
+
+
+def sweep_core_cases(repo: str, n_devices: int) -> list[dict]:
+    """The generated mesh-sweep core matrix as analysis cases."""
+    tools = os.path.join(repo, "tools")
+    sys.path.insert(0, tools)
+    try:
+        import mesh_sweep
+    finally:
+        sys.path.remove(tools)
+    out = []
+    for case in mesh_sweep.generate_cases(n_devices):
+        if case["tier"] != "core" or case["degenerate_zero"]:
+            continue
+        out.append({
+            "name": f"sweep/{case['name']}",
+            "kind": "sweep",
+            "arch": case["arch"],
+            "stanza": case["stanza"],
+        })
+    return out
+
+
+def _merge_case(case: dict) -> None:
+    """Reset + merge the live cfg for one case (the same path the
+    trainer takes; sweep cases mirror mesh_sweep's generated YAML)."""
+    import distribuuuu_tpu.config as config
+    from distribuuuu_tpu.config import cfg
+
+    config.reset_cfg()
+    if case["kind"] == "yaml":
+        cfg.merge_from_file(case["path"])
+    else:
+        cfg.MODEL.ARCH = case["arch"]
+        cfg.MODEL.NUM_CLASSES = 16
+        cfg.DEVICE.COMPUTE_DTYPE = "float32"
+        for key, val in case["stanza"].items():
+            cfg.MESH[key] = val
+
+
+def run_program_case(case: dict, n_devices: int = 8,
+                     passes=None) -> tuple[list, dict]:
+    """(findings, case_record) for one stanza. A case that fails to
+    build is itself a finding (error) — the analyzer never silently
+    skips coverage."""
+    passes = passes or PROGRAM_PASSES
+    findings: list = []
+    record = {"name": case["name"], "kind": case["kind"], "ok": False}
+    try:
+        _merge_case(case)
+        bundle = program.build_bundle(case["name"], n_devices=n_devices)
+    except Exception as e:  # noqa: BLE001 — coverage loss is a finding
+        findings.append(Finding(
+            pass_id="build", severity="error", location=case["name"],
+            message=(
+                f"analysis bundle failed to build: "
+                f"{type(e).__name__}: {e} — this stanza is NOT being "
+                "analyzed; fix the build or the stanza"
+            ),
+            waiver_key=finding_key("build", case["name"]),
+        ))
+        record["error"] = f"{type(e).__name__}: {e}"
+        record["traceback"] = traceback.format_exc(limit=4)
+        return findings, record
+    for pass_id, pass_fn in passes.items():
+        try:
+            findings.extend(pass_fn(bundle))
+        except Exception as e:  # noqa: BLE001
+            findings.append(Finding(
+                pass_id=pass_id, severity="error", location=case["name"],
+                message=(
+                    f"pass crashed: {type(e).__name__}: {e} — the "
+                    "program was not checked by this pass"
+                ),
+                waiver_key=finding_key(pass_id, case["name"], "crash"),
+            ))
+    record.update({
+        "ok": True,
+        "arch": bundle.arch,
+        "class": bundle.topology.class_name(),
+        "zero": bundle.topology.zero,
+        "geometry": bundle.geometry,
+        "expectations": {
+            k: (sorted(v) if isinstance(v, (set, frozenset)) else v)
+            for k, v in bundle.expectations.items()
+            if k != "allowed"
+        },
+        "collective_ledger": bundle.extras.get("collective_ledger", {}),
+        "upcasts": bundle.extras.get("upcasts", {}),
+        "fused_update_pinned": bundle.fused_update_pinned,
+        "seconds": bundle.seconds,
+    })
+    return findings, record
+
+
+def run_ast(repo: str, passes=None) -> tuple[list, dict]:
+    passes = passes or AST_PASSES
+    findings: list = []
+    for pass_id, pass_fn in passes.items():
+        try:
+            findings.extend(pass_fn(repo))
+        except Exception as e:  # noqa: BLE001
+            findings.append(Finding(
+                pass_id=pass_id, severity="error", location=repo,
+                message=f"AST pass crashed: {type(e).__name__}: {e}",
+                waiver_key=finding_key(pass_id, "crash"),
+            ))
+    return findings, {"root": repo, "passes": sorted(passes)}
+
+
+def run_all(repo: str | None = None, *, n_devices: int = 8,
+            ast_only: bool = False, program_only: bool = False,
+            configs: str | None = None, sweep: bool = True,
+            baseline_path: str | None = None,
+            progress=None) -> Report:
+    """The full analyzer. ``configs`` filters program cases by substring
+    (CLI --configs); ``progress`` is an optional per-case callback."""
+    import distribuuuu_tpu.config as config
+
+    repo = repo or repo_root()
+    report = Report(n_devices=n_devices)
+    if not program_only:
+        findings, ast_cov = run_ast(repo)
+        report.extend(findings)
+        report.ast = ast_cov
+        report.passes_run += sorted(AST_PASSES)
+    if not ast_only:
+        cases = model_yaml_cases(repo)
+        if sweep:
+            cases += sweep_core_cases(repo, n_devices)
+        if configs:
+            cases = [c for c in cases if configs in c["name"]]
+        try:
+            for case in cases:
+                findings, record = run_program_case(case, n_devices)
+                report.extend(findings)
+                report.cases.append(record)
+                if progress:
+                    progress(record, findings)
+        finally:
+            config.reset_cfg()
+        report.passes_run += sorted(PROGRAM_PASSES)
+    baseline = load_baseline(
+        baseline_path or os.path.join(repo, BASELINE_FILE)
+    )
+    # a partial scope cannot judge staleness of waivers it never ran
+    full = not ast_only and not program_only and not configs and sweep
+    report.apply_baseline(baseline, check_stale=full)
+    return report

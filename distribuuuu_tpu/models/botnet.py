@@ -16,6 +16,7 @@ from __future__ import annotations
 from typing import Any
 
 import flax.linen as nn
+import jax
 import jax.numpy as jnp
 
 from distribuuuu_tpu.models.layers import (
@@ -79,14 +80,21 @@ class MHSA2D(nn.Module):
         if self.rel_pos_emb:
             rel_h = self.param("rel_height", init, (2 * h - 1, dqk), jnp.float32)
             rel_w = self.param("rel_width", init, (2 * w - 1, dqk), jnp.float32)
-            # reference applies pos logits to the scaled q (botnet.py:206-209)
-            pos = att_ops.rel_pos_logits(
-                (q * scale).astype(jnp.float32), rel_h, rel_w, h, w
-            )
+            # reference applies pos logits to the scaled q (botnet.py:206-209).
+            # Computed in f32 against the f32 position tables, feeding
+            # straight into the fp32 softmax — the *_fp32 scope declares
+            # the promotion to the dtype lint.
+            with jax.named_scope("pos_logits_fp32"):
+                pos = att_ops.rel_pos_logits(
+                    (q * scale).astype(jnp.float32), rel_h, rel_w, h, w
+                )
         else:
             emb_h = self.param("emb_height", init, (h, dqk), jnp.float32)
             emb_w = self.param("emb_width", init, (w, dqk), jnp.float32)
-            pos = att_ops.abs_pos_logits((q * scale).astype(jnp.float32), emb_h, emb_w)
+            with jax.named_scope("pos_logits_fp32"):
+                pos = att_ops.abs_pos_logits(
+                    (q * scale).astype(jnp.float32), emb_h, emb_w
+                )
 
         if self.attn_impl not in ("auto", "xla"):
             raise ValueError(

@@ -533,7 +533,11 @@ class SqueezeExcite(nn.Module):
 
     @nn.compact
     def __call__(self, x):
-        s = jnp.mean(x, axis=(1, 2), keepdims=True)
+        # jnp.mean of a bf16 tensor accumulates in f32 and casts back
+        # (jax's half-type reduction upcast) — deliberate numerics, so
+        # the scope declares it to the dtype lint (*_fp32 convention)
+        with jax.named_scope("se_squeeze_fp32"):
+            s = jnp.mean(x, axis=(1, 2), keepdims=True)
         s = nn.Conv(self.se_width, (1, 1), dtype=self.dtype,
                     param_dtype=jnp.float32)(s)
         s = self.act(s)

@@ -345,19 +345,31 @@ class Attention(nn.Module):
             # O(L·chunk) memory — high-resolution single-chip training
             out = ra.blockwise_attention(q, k, v, causal=self.causal)
         else:
-            scale = D ** -0.5
-            s = jnp.einsum(
-                "bhqd,bhkd->bhqk",
-                q.astype(jnp.float32), k.astype(jnp.float32),
-            ) * scale
-            if self.causal:
-                s = jnp.where(
-                    jnp.tril(jnp.ones((S, S), bool))[None, None],
-                    s, jnp.float32(-1e30),
+            # the dense path deliberately runs the whole score→softmax→
+            # weighted-sum region in f32 (bf16 logits overflow the -1e30
+            # mask and lose softmax mass at long S); the named scope
+            # declares the promotion to the static analyzer's dtype lint
+            # (analysis/passes/dtype.py SAFE_SCOPES convention: a
+            # *_fp32 scope is a documented numerical choice)
+            with jax.named_scope("attn_softmax_fp32"):
+                scale = D ** -0.5
+                s = jnp.einsum(
+                    "bhqd,bhkd->bhqk",
+                    q.astype(jnp.float32), k.astype(jnp.float32),
+                ) * scale
+                if self.causal:
+                    s = jnp.where(
+                        jnp.tril(jnp.ones((S, S), bool))[None, None],
+                        s, jnp.float32(-1e30),
+                    )
+                w = jax.nn.softmax(s, axis=-1)
+                w = nn.Dropout(self.dropout, deterministic=not train)(w)
+                out = jnp.einsum(
+                    "bhqk,bhkd->bhqd", w, v.astype(jnp.float32)
                 )
-            w = jax.nn.softmax(s, axis=-1)
-            w = nn.Dropout(self.dropout, deterministic=not train)(w)
-            out = jnp.einsum("bhqk,bhkd->bhqd", w, v.astype(jnp.float32))
+                # leave the region in compute dtype HERE so the exit
+                # cast (and its autodiff transpose) carries the scope
+                out = out.astype(self.dtype)
 
         out = out.astype(self.dtype).transpose(0, 2, 1, 3).reshape(B, S, self.dim)
         out = Dense(self.dim, dtype=self.dtype)(out)

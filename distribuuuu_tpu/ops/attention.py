@@ -82,6 +82,13 @@ def mhsa_2d(q, k, v, pos_logits, scale: float):
     import jax.nn
 
     logits = jnp.einsum("bnxd,bnyd->bnxy", q * scale, k)
-    logits = logits.astype(jnp.float32) + pos_logits.astype(jnp.float32)
-    weights = jax.nn.softmax(logits, axis=-1)
-    return jnp.einsum("bnxy,bnyd->bnxd", weights.astype(v.dtype), v)
+    # fp32 softmax is the documented numerical choice here (weights cast
+    # straight back to v.dtype); the *_fp32 scope declares it to the
+    # static analyzer's dtype lint
+    with jax.named_scope("attn_softmax_fp32"):
+        logits = logits.astype(jnp.float32) + pos_logits.astype(jnp.float32)
+        weights = jax.nn.softmax(logits, axis=-1)
+        # exit the region in v.dtype HERE so the cast (and its autodiff
+        # transpose) carries the scope
+        weights = weights.astype(v.dtype)
+    return jnp.einsum("bnxy,bnyd->bnxd", weights, v)

@@ -85,7 +85,7 @@ def _collective_scopes(layout) -> tuple[str, str]:
 
 
 def train_step_body(model, optimizer, topk: int, accum_steps: int = 1,
-                    layout=None):
+                    layout=None, rest_layout=None):
     """The pure step function shared by the per-step and folded paths.
 
     ``layout`` (a ``specs.state_layout`` dict) is required when
@@ -111,6 +111,21 @@ def train_step_body(model, optimizer, topk: int, accum_steps: int = 1,
     Gradients are exact (the mean-CE micro-grads average to the full-batch
     grad); BN stats are per-micro-batch — torch-DDP-with-accumulation
     semantics. HBM holds one micro-batch of activations at a time.
+
+    ``rest_layout`` (the full ``state_layout`` dict, passed by
+    :func:`lower` at EVERY stage) pins the output state back to the
+    DECLARED rest layout when no ZeRO stage does it already. Without the
+    pin, GSPMD is free to rest stage-0 outputs wherever propagation
+    lands them — on TP/EP meshes it model-shards LayerNorm/bias leaves
+    the declaration says are replicated — so the steady-state layout
+    silently drifts from the declaration after the first step AND buffer
+    donation quietly drops for every drifted leaf (an output cannot
+    alias an input resting in a different sharding): state held twice.
+    Found by the static analyzer's replication+donation passes
+    (ISSUE 14); on all-replicated dp-only meshes the pin collapses to a
+    no-op, so legacy stage-0 programs are untouched. ``None`` (legacy
+    direct callers of the re-exported step builders) preserves the old
+    unpinned behavior.
     """
     if layout is None and cfg.MESH.ZERO:
         raise ValueError(
@@ -183,6 +198,16 @@ def train_step_body(model, optimizer, topk: int, accum_steps: int = 1,
             )
             new_opt_state = tp.constrain_like(
                 new_opt_state, grads, layout["opt"]
+            )
+        elif rest_layout is not None:
+            # stage 0: same pin, declared base layout (docstring above —
+            # no-op on all-replicated meshes, drift+donation fix on
+            # TP/EP meshes)
+            new_params = zero.constrain(
+                new_params, rest_layout["params"], scope="rest_layout"
+            )
+            new_opt_state = tp.constrain_like(
+                new_opt_state, grads, rest_layout["opt"]
             )
         new_state = TrainState(
             params=new_params,
@@ -293,17 +318,19 @@ def train_step_body(model, optimizer, topk: int, accum_steps: int = 1,
 
 
 def make_train_step(model, optimizer, topk: int, accum_steps: int = 1,
-                    layout=None):
+                    layout=None, rest_layout=None):
     """Compile-once train step: fwd + CE loss + bwd + SGD + metrics
     (≙ the hot loop body, ref: trainer.py:37-58)."""
     return jax.jit(
-        train_step_body(model, optimizer, topk, accum_steps, layout=layout),
+        train_step_body(model, optimizer, topk, accum_steps, layout=layout,
+                        rest_layout=rest_layout),
         donate_argnums=0,
     )
 
 
 def make_scan_train_step(model, optimizer, topk: int, fold: int,
-                         accum_steps: int = 1, layout=None):
+                         accum_steps: int = 1, layout=None,
+                         rest_layout=None):
     """``fold`` optimizer steps in ONE compiled call via ``lax.scan``.
 
     Same math as ``fold`` sequential ``make_train_step`` calls (same body,
@@ -314,7 +341,8 @@ def make_scan_train_step(model, optimizer, topk: int, fold: int,
     Takes a stacked batch pytree with leading dim ``fold`` (leaf shape
     ``(fold, batch, ...)``) and returns stacked per-step metrics ``(fold,)``.
     """
-    body = train_step_body(model, optimizer, topk, accum_steps, layout=layout)
+    body = train_step_body(model, optimizer, topk, accum_steps, layout=layout,
+                           rest_layout=rest_layout)
 
     def scan_steps(state: TrainState, stacked_batch):
         return jax.lax.scan(body, state, stacked_batch, length=fold)
@@ -381,6 +409,8 @@ class Lowered:
     accum: int = 1
     fold: int = 1
     model: Any = None
+    optimizer: Any = None  # kept so abstract_args can shape the opt state
+    im_size: int = 32
 
     def init_state(self, key, im_size: int):
         """Fresh TrainState resting in this topology's layout."""
@@ -406,6 +436,80 @@ class Lowered:
             )
         return sharding_lib.shard_stacked_batch(self.mesh, host_stacked)
 
+    def abstract_args(self, batch_size: int | None = None, *,
+                      with_mask: bool = False):
+        """``(state_sds, batch_sds)`` — ShapeDtypeStructs carrying the
+        DECLARED shardings for this topology's step arguments.
+
+        The static analyzer (distribuuuu_tpu/analysis/) lowers and
+        compiles the step against these to read GSPMD's verdict (compiled
+        shardings, donation aliasing, the collective schedule) without
+        ever materializing state or data — and without a second compile:
+        every program pass shares the one lowered/compiled bundle. The
+        placement mirrors ``trainer.create_train_state`` exactly: params
+        per the declared layout, batch_stats replicated, optimizer state
+        per the opt layout on param-structured subtrees (the abstract
+        twin of ``tp.constrain_like``) and replicated elsewhere, batch
+        leaves per ``specs.BATCH_TABLE``. ``batch_size`` defaults to two
+        samples per data rank (shape-only — placement does not depend on
+        batch geometry).
+        """
+        import flax
+        import numpy as np
+
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        repl = NamedSharding(self.mesh, P())
+
+        def sds(leaf, sh):
+            return jax.ShapeDtypeStruct(leaf.shape, leaf.dtype, sharding=sh)
+
+        abstract = specs_lib.abstract_state(self.model, self.im_size)
+        unboxed = flax.linen.meta.unbox(abstract)
+        params = jax.tree.map(sds, unboxed["params"], self.layout["params"])
+        stats = jax.tree.map(
+            lambda l: sds(l, repl), unboxed.get("batch_stats", {})
+        )
+        opt_abs = jax.eval_shape(self.optimizer.init, unboxed["params"])
+        tdef = jax.tree.structure(unboxed["params"])
+
+        def is_param_shaped(node):
+            try:
+                return jax.tree.structure(node) == tdef
+            except (TypeError, ValueError):
+                return False
+
+        def place_opt(node):
+            if is_param_shaped(node):
+                return jax.tree.map(sds, node, self.layout["opt"])
+            return jax.tree.map(lambda l: sds(l, repl), node)
+
+        opt = jax.tree.map(place_opt, opt_abs, is_leaf=is_param_shaped)
+        state = TrainState(
+            params=params, batch_stats=stats, opt_state=opt,
+            step=sds(jax.ShapeDtypeStruct((), np.int32), repl),
+            key=sds(jax.eval_shape(lambda: jax.random.key(0)), repl),
+        )
+
+        data = int(dict(self.mesh.shape).get("data", 1))
+        B = int(batch_size) if batch_size else max(8, 2 * data)
+        dummy = specs_lib.model_dummy_input(self.model, self.im_size)
+        image = jax.ShapeDtypeStruct((B,) + dummy.shape[1:], dummy.dtype)
+        # token models label per token ([B, S]); image models per sample
+        label_shape = (B,) + (dummy.shape[1:] if image.ndim == 2 else ())
+        batch = {
+            "image": image,
+            "label": jax.ShapeDtypeStruct(label_shape, np.int32),
+        }
+        if with_mask:
+            batch["mask"] = jax.ShapeDtypeStruct((B,), np.float32)
+        batch = {
+            k: sds(v, NamedSharding(
+                self.mesh, specs_lib.BATCH_TABLE.spec_for(k)))
+            for k, v in batch.items()
+        }
+        return state, batch
+
 
 def lower(model, optimizer, topk: int, *, mesh, topology, im_size: int,
           fold: int = 1, accum: int = 1) -> Lowered:
@@ -421,17 +525,18 @@ def lower(model, optimizer, topk: int, *, mesh, topology, im_size: int,
     layout = specs_lib.state_layout(model, mesh, im_size, topology.zero)
     step_layout = layout if topology.zero else None
     train_step = make_train_step(
-        model, optimizer, topk, accum_steps=accum, layout=step_layout
+        model, optimizer, topk, accum_steps=accum, layout=step_layout,
+        rest_layout=layout,
     )
     scan_step = None
     if fold > 1:
         scan_step = make_scan_train_step(
             model, optimizer, topk, fold, accum_steps=accum,
-            layout=step_layout,
+            layout=step_layout, rest_layout=layout,
         )
     return Lowered(
         mesh=mesh, topology=topology, layout=layout, step_layout=step_layout,
         train_step=train_step, eval_step=make_eval_step(model, topk),
         scan_step=scan_step, accum=max(1, accum), fold=max(1, fold),
-        model=model,
+        model=model, optimizer=optimizer, im_size=im_size,
     )

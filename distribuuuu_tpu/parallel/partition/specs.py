@@ -396,6 +396,95 @@ def state_layout(model, mesh: Mesh, im_size: int, zero_stage: int) -> dict:
     return layout
 
 
+def collective_expectations(layout: dict, topology,
+                            fused_update_pinned: bool = False) -> dict:
+    """What the spec algebra predicts about the collective schedule of a
+    step program lowered from ``layout`` under ``topology`` — the
+    referee table the static analyzer's collective lint compares the
+    compiled program's per-axis collective census against
+    (analysis/passes/collectives.py), and the before/after ledger the
+    ZeRO-overlap work (ROADMAP #1) scores itself with.
+
+    Returns ``{"leaves", "zero_sharded", "tp_sharded", "ep_sharded",
+    "allowed", "gather_bound"}``:
+
+      * ``allowed`` maps each collective kind to the mesh-axis sets it
+        may legitimately run over. Reductions (``all-reduce``) are
+        unconstrained over populated axes — grad means, BN/loss
+        reductions. Gather-class ops are the dangerous ones: an
+        ``all-gather`` over ``data`` is only predicted when a ZeRO stage
+        re-gathers rest layouts (or the fused-update kernel pins its
+        whole-leaf operands — the PR 13 replicated-pin, recognized here
+        so the lint does not re-flag it); in a plain-DDP program it
+        means something rests sharded that the declaration says is
+        replicated, i.e. a silent re-gather.
+      * ``gather_bound`` bounds the non-metric all-gather count over the
+        ``data`` axis: ~1 gather per rest-resharded leaf for stage 1,
+        ~4× for stage 3 (forward + backward + update re-gathers before
+        XLA merges them), plus the pinned fused-update gathers (params +
+        grads + each moment copy) when active. Exceeding it is a gather
+        storm even when gathers are expected at all.
+    """
+    leaves = jax.tree.leaves(layout["params"])
+    grads = jax.tree.leaves(layout["grads"])
+    zero_sharded = sum(
+        1 for g in grads if "data" in spec_axes(g.spec)
+    )
+    tp_sharded = sum(1 for p in leaves if "model" in spec_axes(p.spec))
+    ep_sharded = sum(1 for p in leaves if "expert" in spec_axes(p.spec))
+    zero = int(getattr(topology, "zero", 0))
+    feats = topology.features() if hasattr(topology, "features") else set()
+
+    gather_axes = set()
+    if tp_sharded or "tp" in feats:
+        gather_axes.add("model")
+    if ep_sharded or "ep" in feats:
+        gather_axes.add("expert")
+    if "pp" in feats:
+        gather_axes.add("pipe")
+    if "sp" in feats:
+        gather_axes.add("seq")
+    if zero or fused_update_pinned:
+        gather_axes.add("data")
+
+    gather_bound = None
+    if zero == 1:
+        gather_bound = 2 * zero_sharded
+    elif zero == 3:
+        gather_bound = 4 * zero_sharded
+    if fused_update_pinned:
+        # params + grads + up to two moment copies gathered whole-leaf
+        gather_bound = (gather_bound or 0) + 4 * len(leaves)
+
+    a2a_axes = set()
+    if ep_sharded or "ep" in feats or "tp" in feats:
+        a2a_axes |= {"expert", "model"}
+    if zero:
+        # resharding between two data-sharded layouts that shard
+        # DIFFERENT dims (grads vs rest after a reshape) lowers to an
+        # all-to-all over data — legitimate whenever a stage is on
+        a2a_axes.add("data")
+    allowed = {
+        "all-reduce": None,  # reductions are always legitimate
+        "all-gather": gather_axes,
+        "reduce-scatter": (
+            {"data"} if zero else set()) | (gather_axes - {"data"}),
+        "all-to-all": a2a_axes,
+        # point-to-point moves are the lowering's workhorse (GPipe hops,
+        # ring decompositions of reduce/gather, MoE rotations, halo
+        # exchanges) — censused in the ledger, never bounded here
+        "collective-permute": None,
+    }
+    return {
+        "leaves": len(leaves),
+        "zero_sharded": zero_sharded,
+        "tp_sharded": tp_sharded,
+        "ep_sharded": ep_sharded,
+        "allowed": allowed,
+        "gather_bound": gather_bound,
+    }
+
+
 def added_axes(layout: dict) -> tuple[str, ...]:
     """Mesh axes the ZeRO transform ADDED to the grads layout relative to
     the params-base declaration — the axes the spec-induced

@@ -1,22 +1,12 @@
 """Static ``kind=`` schema check over every telemetry/metrics emit call
 site in ``distribuuuu_tpu/`` (tier-1 via tests/test_telemetry.py).
 
-Walks the package AST for calls to the emit surfaces —
-``metrics_log(kind, ...)``, ``emit_event(kind, ...)``,
-``timeline_log(...)`` (implicit kind "timeline"), ``emit_span(...)``
-(implicit kind "span"), ``mirror_event(kind, fields)`` — and fails on:
-
-* an **undeclared kind**: a string-literal kind not registered in
-  ``distribuuuu_tpu/telemetry/schema.py`` (new record kinds must be
-  declared with their required fields before anything emits them);
-* a **drifted kind**: a literal-kind call whose static keyword arguments
-  no longer cover the kind's required fields (calls that splat
-  ``**fields`` are only kind-checked — their fields are validated
-  dynamically by tests over real emitted files);
-* a **dynamic kind outside the infrastructure**: a non-literal kind
-  expression anywhere except the two forwarding modules
-  (``utils/jsonlog.py``, ``telemetry/spans.py``) that pass a caller's
-  kind through by design.
+Since ISSUE 14 this is a thin wrapper over the static analysis plane's
+telemetry pass (``distribuuuu_tpu/analysis/passes/telemetry.py`` — the
+same check also runs inside ``tools/staticcheck.py`` with the rest of
+the lint suite). The historical CLI and the ``check_file`` /
+``check_tree`` ``(violations, seen)`` string API are preserved so
+existing invocations and tests keep working:
 
     python tools/check_telemetry_schema.py [--root distribuuuu_tpu]
 
@@ -26,101 +16,36 @@ Exit 0 clean, 1 with one line per violation.
 from __future__ import annotations
 
 import argparse
-import ast
 import os
 import sys
 
 import _path  # noqa: F401  (repo root onto sys.path)
 
-from distribuuuu_tpu.telemetry import schema
+from distribuuuu_tpu.analysis.passes import telemetry as _pass
 
-# emit surface -> implicit kind (None = first positional arg is the kind)
-EMIT_FUNCS = {
-    "metrics_log": None,
-    "emit_event": None,
-    "mirror_event": None,
-    "timeline_log": "timeline",
-    "emit_span": "span",
-}
-
-# modules allowed to forward a caller's kind variable (the sinks themselves)
-DYNAMIC_KIND_OK = ("utils/jsonlog.py", "telemetry/spans.py")
+# re-exported for callers that introspect the check's surface
+EMIT_FUNCS = _pass.EMIT_FUNCS
+DYNAMIC_KIND_OK = _pass.DYNAMIC_KIND_OK
 
 
-def _func_name(call: ast.Call) -> str | None:
-    f = call.func
-    if isinstance(f, ast.Name):
-        return f.id
-    if isinstance(f, ast.Attribute):
-        return f.attr
-    return None
+def _strings(findings) -> list[str]:
+    return [f"{f.location}: {f.message}" for f in findings]
 
 
 def check_file(path: str, rel: str) -> tuple[list[str], set[str]]:
     """(violations, kinds_seen) for one source file."""
-    with open(path) as f:
-        tree = ast.parse(f.read(), filename=rel)
-    violations, seen = [], set()
-    for node in ast.walk(tree):
-        if not isinstance(node, ast.Call):
-            continue
-        name = _func_name(node)
-        if name not in EMIT_FUNCS:
-            continue
-        where = f"{rel}:{node.lineno}"
-        kind = EMIT_FUNCS[name]
-        if kind is None:
-            if not node.args:
-                continue  # not an emit form we recognize
-            first = node.args[0]
-            if isinstance(first, ast.Constant) and isinstance(first.value, str):
-                kind = first.value
-            else:
-                if not rel.replace(os.sep, "/").endswith(DYNAMIC_KIND_OK):
-                    violations.append(
-                        f"{where}: {name}() with a non-literal kind — only "
-                        f"the sink modules {DYNAMIC_KIND_OK} may forward a "
-                        "dynamic kind"
-                    )
-                continue
-        seen.add(kind)
-        if kind not in schema.KINDS:
-            violations.append(
-                f"{where}: undeclared kind {kind!r} — declare it (with "
-                "required fields) in distribuuuu_tpu/telemetry/schema.py"
-            )
-            continue
-        if name in ("timeline_log", "emit_span"):
-            continue  # those wrappers provide the required fields themselves
-        has_splat = any(kw.arg is None for kw in node.keywords)
-        static = {kw.arg for kw in node.keywords if kw.arg is not None}
-        missing = schema.KINDS[kind] - static
-        if missing and not has_splat:
-            violations.append(
-                f"{where}: kind {kind!r} drifted — call no longer provides "
-                f"required fields {sorted(missing)} "
-                "(telemetry/schema.py declares them)"
-            )
-    return violations, seen
+    findings, seen = _pass.check_file(path, rel)
+    return _strings(findings), seen
 
 
 def check_tree(root: str) -> tuple[list[str], set[str]]:
-    violations, seen = [], set()
-    for dirpath, _dirnames, filenames in os.walk(root):
-        if "__pycache__" in dirpath:
-            continue
-        for fn in sorted(filenames):
-            if not fn.endswith(".py"):
-                continue
-            path = os.path.join(dirpath, fn)
-            rel = os.path.relpath(path, os.path.dirname(root))
-            v, s = check_file(path, rel)
-            violations += v
-            seen |= s
-    return violations, seen
+    findings, seen = _pass.check_tree(root)
+    return _strings(findings), seen
 
 
 def main(argv=None) -> int:
+    from distribuuuu_tpu.telemetry import schema
+
     ap = argparse.ArgumentParser(description=__doc__)
     ap.add_argument(
         "--root",

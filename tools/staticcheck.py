@@ -1,0 +1,23 @@
+"""In-repo CLI for the static analysis plane (ISSUE 14).
+
+    JAX_PLATFORMS=cpu python tools/staticcheck.py \
+        [--ast-only] [--configs SUBSTR] [--no-sweep] \
+        [--json-out ANALYSIS_r01.json]
+
+Runs program lints (silent replication, donation, collectives, dtype
+promotion) over the lowered/compiled step of every shipped config
+stanza + the generated mesh-sweep core cases, and AST lints (config
+knobs, dispatch discipline, telemetry kinds) over the package. Exit 0
+only when every finding is waived in ANALYSIS_BASELINE.json with a
+justification. The engine lives in ``distribuuuu_tpu/analysis/``; the
+installed console-script twin is ``distribuuuu-staticcheck``.
+"""
+
+import sys
+
+import _path  # noqa: F401  (repo root onto sys.path)
+
+from distribuuuu_tpu.analysis.cli import main
+
+if __name__ == "__main__":
+    sys.exit(main())
