@@ -19,11 +19,11 @@ the ``data`` axis:
 Metric-scope ops are exempt (the ``top_k`` logits gather in
 utils/metrics.py is a handful of KB and semantically a metric, not a
 layout leak) — exempt from *findings*, still counted in the ledger.
-The fused-update replicated-pin (PR 13) is recognized through the
-expectations table (its whole-leaf gathers raise the bound), not
-re-flagged. The full per-axis count/bytes ledger lands in the report's
-case record either way: ROADMAP #1's overlap work reads it as its
-before/after referee.
+The full per-axis count/bytes ledger lands in the report's case record
+either way: it was the before/after referee the gather-once schedule
+(ISSUE 15) was scored by — 195 → ~21 data-gathers on dp8·zero3 — and
+the ``gather_bound`` now encodes the gather-once model, so a schedule
+regression is a finding, not a waiver.
 """
 
 from __future__ import annotations
@@ -114,10 +114,11 @@ def run(bundle) -> list:
                     f"all-gathers over data ({gbytes} B) vs the "
                     f"rest-layout re-gather bound {bound} "
                     f"(= f(zero={bundle.topology.zero}, "
-                    f"{exp['zero_sharded']} sharded leaves"
-                    + (", fused-update pin" if bundle.fused_update_pinned
-                       else "")
-                    + ")): the program gathers per use instead of once"
+                    f"{exp['zero_sharded']} sharded leaves)): the "
+                    "program gathers more than the declared schedule — "
+                    "gather-once hoists every FSDP leaf to ONE entry "
+                    "gather (specs.gather_schedule); per-use gathering "
+                    "is the ZERO.GATHER_AHEAD >= 0 escape hatch"
                 ),
                 waiver_key=finding_key(
                     PASS_ID, bundle.name, "gather-storm", "data"

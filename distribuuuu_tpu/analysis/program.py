@@ -51,23 +51,9 @@ class ProgramBundle:
     n_flat_inputs: int
     memory: dict | None
     expectations: dict
-    fused_update_pinned: bool
     geometry: dict
     seconds: float = 0.0
     extras: dict = field(default_factory=dict)
-
-
-def _fused_update_pinned() -> bool:
-    """Is the PR 13 fused-update replicated-pin active in this program?
-    (KERNELS.OPT_UPDATE resolved to a pallas kernel while a ZeRO layout
-    is on — lowering.py pins the kernel operands whole-leaf, and the
-    collective lint must recognize those gathers, not re-flag them.)"""
-    from distribuuuu_tpu.config import cfg
-    from distribuuuu_tpu.ops.pallas import opt_update as fused_opt
-
-    if not cfg.MESH.ZERO:
-        return False
-    return fused_opt.fused_update_for() is not None
 
 
 def build_bundle(name: str, *, n_devices: int = 8,
@@ -106,7 +92,6 @@ def build_bundle(name: str, *, n_devices: int = 8,
         memory = costmodel.normalize_memory(compiled.memory_analysis())
     except Exception:
         memory = None
-    pinned = _fused_update_pinned()
     state_out = compiled.output_shardings[0]
     flat_in = jax.tree.leaves((state_sds, batch_sds))
     return ProgramBundle(
@@ -121,10 +106,7 @@ def build_bundle(name: str, *, n_devices: int = 8,
         state_out_shardings=state_out,
         n_flat_inputs=len(flat_in),
         memory=memory,
-        expectations=specs.collective_expectations(
-            low.layout, topo, fused_update_pinned=pinned
-        ),
-        fused_update_pinned=pinned,
+        expectations=specs.collective_expectations(low.layout, topo),
         geometry={
             "im_size": int(cfg.TRAIN.IM_SIZE),
             "seq_len": int(cfg.LM.SEQ_LEN),

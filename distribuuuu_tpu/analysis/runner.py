@@ -147,7 +147,6 @@ def run_program_case(case: dict, n_devices: int = 8,
         },
         "collective_ledger": bundle.extras.get("collective_ledger", {}),
         "upcasts": bundle.extras.get("upcasts", {}),
-        "fused_update_pinned": bundle.fused_update_pinned,
         "seconds": bundle.seconds,
     })
     return findings, record

@@ -469,6 +469,34 @@ _C.MESH.MICROBATCH = 0
 # constraint already materializes them sharded.
 _C.MESH.ZERO = 0
 
+# ------------------------------- ZeRO collective scheduling -----------------
+# Latency-hiding controls for the ZeRO/FSDP collective schedule the
+# partition layer derives (parallel/partition/specs.gather_schedule +
+# lowering.train_step_body). The MESH.ZERO stage declares WHERE state
+# rests; this node declares WHEN the spec-induced collectives run.
+_C.ZERO = CfgNode()
+# Collective/compute overlap. True (default): the step's ZeRO collectives
+# (gather-once entry all-gathers, backward reduce-scatters, rest-layout
+# re-gathers) are emitted as independent per-leaf ops with no serializing
+# joins, so XLA's latency-hiding scheduler can run them concurrently with
+# compute (proof artifact: trace_report's overlap-fraction rollup over
+# the zero_*@data named scopes). False: an optimization_barrier joins
+# each collective class before the consuming compute — the synchronous
+# control arm of the A/B (tools/collective_bench.py --zero-ab); values
+# are bit-identical either way (pinned: tests/test_zero_overlap.py).
+_C.ZERO.OVERLAP = True
+# ZeRO-3 gather-once prefetch depth, in parameter block-groups (the
+# path-pattern groups specs.gather_groups derives — one group per
+# numbered model block). -1 (default): the WHOLE FSDP param tree is
+# all-gathered once at step entry (~1 gather/leaf instead of the per-use
+# gather storm the PR 14 census priced at ~9.3/leaf; full-model gathered
+# footprint lives through the step). N >= 1: only the first N groups are
+# hoisted to step entry, later groups keep per-use gathering (bounds the
+# gathered-live footprint on memory-tight configs at the cost of extra
+# collectives). 0: no hoisting at all — the legacy per-use schedule, the
+# escape hatch the census A/B compares against.
+_C.ZERO.GATHER_AHEAD = -1
+
 # ------------------------------- data pipeline -------------------------------
 _C.DATA = CfgNode()
 # Dataset storage format. "imagefolder" reads root/split/class/*.jpg one

@@ -22,10 +22,12 @@ the same test's documented tolerance).
 
 Sharding: the update is elementwise per leaf, so it commutes with any
 shard slicing — updating a ZeRO shard equals slicing the unsharded
-update (pinned by test). Under jit+GSPMD the custom call itself runs
-replicated (the partition layer's rest-layout constraints re-pin the
-outputs); the per-shard shard_map lowering that keeps it local per rank
-is ROADMAP #1's overlap work.
+update (pinned by test). Under a ZeRO layout the kernel lowers
+PER-SHARD via :func:`per_shard_update` (shard_map over the rest
+layout): each rank runs the one-pass kernel on its own 1/N slice, no
+gather and no re-scatter — the fusion point of the gather-once schedule
+(ISSUE 15, delivered ROADMAP #1). Plain-replicated layouts run the
+whole-leaf call unchanged.
 """
 
 from __future__ import annotations
@@ -302,6 +304,59 @@ def fused_update_for(optimizer_kind: str | None = None):
         return fused_optimizer_update(params, grads, opt_state, **kwargs)
 
     return update
+
+
+def per_shard_update(update, layout):
+    """Lower a fused update PER-SHARD through shard_map over the ZeRO
+    layout (ISSUE 15 — the per-shard fused weight update of
+    arXiv:2004.13336, replacing the r14 whole-leaf replicated-pin that
+    gathered params+grads+moments before every update).
+
+    ``update`` is the whole-leaf callable from :func:`fused_update_for`;
+    ``layout`` the ``specs.state_layout`` dict whose ``grads`` tree
+    carries the per-leaf shard specs (``data`` added where divisible).
+    The returned callable runs the kernel on each rank's LOCAL 1/N slice
+    of params/grads/moments — no gather, no re-scatter; the update IS
+    shard-local because it is elementwise per leaf (the shard-commute
+    contract pinned in tests/test_pallas_kernels.py). Inputs resting in
+    a different layout (stage-1 params rest replicated) are sliced by
+    the shard_map in_specs — a local view, not a collective; the outer
+    rest-layout constraints re-gather stage-1 params once after the
+    update, exactly the declared schedule. Scalar state (counters, the
+    injected learning rate) rides in replicated and is recomputed
+    identically per rank."""
+    mesh = jax.tree.leaves(layout["grads"])[0].mesh
+    shard_specs = jax.tree.map(lambda sh: sh.spec, layout["grads"])
+
+    def call(params, grads, opt_state):
+        from distribuuuu_tpu.parallel.compat import shard_map
+        from jax.sharding import PartitionSpec as P
+
+        tdef = jax.tree.structure(params)
+
+        def is_param_shaped(node):
+            try:
+                return jax.tree.structure(node) == tdef
+            except (TypeError, ValueError):
+                return False
+
+        def place(node):
+            if is_param_shaped(node):
+                return shard_specs
+            return jax.tree.map(lambda _: P(), node)
+
+        # the abstract twin of lowering.abstract_args' place_opt: moment
+        # trees (param-structured) ride the shard specs, everything else
+        # (counters, hyperparams) is replicated
+        ospecs = jax.tree.map(place, opt_state, is_leaf=is_param_shaped)
+        fn = shard_map(
+            update, mesh=mesh,
+            in_specs=(shard_specs, shard_specs, ospecs),
+            out_specs=(shard_specs, ospecs),
+        )
+        return fn(params, grads, opt_state)
+
+    return call
 
 
 def leaf_pass_bytes(tree, kind: str = "sgd") -> int:

@@ -14,6 +14,14 @@ body applies, never which code runs:
     the optimizer update (GSPMD satisfies it with a reduce-scatter fused
     with the cross-replica mean) and outputs are pinned back to the rest
     layout so buffer donation stays stable;
+  * the ZeRO-3 gather SCHEDULE is gather-once (ISSUE 15): FSDP leaves
+    are constrained to their gathered compute layout ONCE at step entry
+    (``make_gather_entry`` from ``specs.gather_schedule`` — ~1
+    all-gather/leaf/step instead of per-use), each gather/reduce-scatter
+    an independent per-leaf op the latency-hiding scheduler can overlap
+    with compute (``ZERO.OVERLAP``; False = barrier-joined sync control
+    arm, bit-identical), and the fused optimizer update runs per-shard
+    (``opt_update.per_shard_update``);
   * every spec-induced collective carries a ``jax.named_scope`` naming
     the mesh axes it runs over (``zero_reduce_scatter@data``, …) so
     trace_report / Perfetto / cost.* records attribute comm per axis on
@@ -74,14 +82,70 @@ def make_image_prep():
     return prep
 
 
-def _collective_scopes(layout) -> tuple[str, str]:
-    """Attribution scope names for the two spec-induced state collectives
-    — reduce-scatter into the grads layout, all-gather back to the rest
-    layout — suffixed with the mesh axes they run over (``@data``), so
-    trace_report rollups and Perfetto split comm per axis. ``None``
-    layout never reaches these."""
+def _collective_scopes(layout) -> tuple[str, str, str]:
+    """Attribution scope names for the three spec-induced state
+    collectives — the gather-once entry all-gather of FSDP leaves, the
+    reduce-scatter into the grads layout, and the all-gather back to the
+    rest layout — suffixed with the mesh axes they run over (``@data``),
+    so trace_report rollups and Perfetto split comm per axis (the
+    overlap-fraction rollup measures compute concurrency against exactly
+    these names). ``None`` layout never reaches these."""
     axes = ",".join(specs_lib.added_axes(layout)) or "data"
-    return f"zero_reduce_scatter@{axes}", f"zero_rest_layout@{axes}"
+    return (
+        f"zero_gather_once@{axes}",
+        f"zero_reduce_scatter@{axes}",
+        f"zero_rest_layout@{axes}",
+    )
+
+
+def _barrier(tree):
+    """optimization_barrier over a pytree: joins every leaf before any
+    consumer — the ZERO.OVERLAP=False control arm (collectives complete
+    before the consuming compute starts; identity on values, so the
+    ON ≡ OFF bit-identity pin holds by construction)."""
+    leaves, treedef = jax.tree.flatten(tree)
+    return jax.tree.unflatten(treedef, jax.lax.optimization_barrier(leaves))
+
+
+def make_gather_entry(layout):
+    """The gather-once transform (ROADMAP #1, arXiv:2004.13336): a
+    function constraining the scheduled FSDP leaves of a param tree to
+    their gathered compute layout ONCE at step entry, derived entirely
+    from the spec algebra (specs.gather_schedule — no per-model code).
+
+    Returns ``(gather_fn, n_hoisted)``; ``gather_fn`` is identity when
+    nothing is scheduled (stage 0/1, or ``ZERO.GATHER_AHEAD=0``). The
+    constraint is applied OUTSIDE the differentiated function, so the
+    backward reduce-scatters grads exactly as the stage-1 schedule does
+    (the explicit grads constraint in ``apply_grads``); the gathered
+    value is one program value consumed by forward AND backward — one
+    all-gather per leaf per step instead of one per use site (the PR 14
+    census: 195 → ~21 on dp8·zero3[resnet18]). Each leaf's gather is an
+    independent op with no serializing join under ``ZERO.OVERLAP``, so
+    the latency-hiding scheduler can run layer k+1's gather under layer
+    k's compute; ``ZERO.OVERLAP=False`` joins them all first (the
+    synchronous A/B control arm)."""
+    hoist = specs_lib.gather_schedule(layout, int(cfg.ZERO.GATHER_AHEAD))
+    n_hoisted = sum(jax.tree.leaves(hoist))
+    if not n_hoisted:
+        return (lambda params: params), 0
+    gather_to = specs_lib.compute_layout(layout)
+    go_scope = _collective_scopes(layout)[0]
+    overlap = bool(cfg.ZERO.OVERLAP)
+
+    def gather_fn(params):
+        with jax.named_scope(go_scope):
+            gathered = jax.tree.map(
+                lambda x, sh, h: (
+                    jax.lax.with_sharding_constraint(x, sh) if h else x
+                ),
+                params, gather_to, hoist,
+            )
+        if not overlap:
+            gathered = _barrier(gathered)
+        return gathered
+
+    return gather_fn, int(n_hoisted)
 
 
 def train_step_body(model, optimizer, topk: int, accum_steps: int = 1,
@@ -142,48 +206,47 @@ def train_step_body(model, optimizer, topk: int, accum_steps: int = 1,
     nonfinite_policy = supervisor.validate_policy(str(cfg.TRAIN.NONFINITE))
 
     if layout is not None:
-        rs_scope, ag_scope = _collective_scopes(layout)
+        _, rs_scope, ag_scope = _collective_scopes(layout)
+        # gather-once (ROADMAP #1): the scheduled FSDP leaves are
+        # all-gathered ONCE at step entry — see make_gather_entry
+        gather_entry, _ = make_gather_entry(layout)
+        overlap = bool(cfg.ZERO.OVERLAP)
+    else:
+        gather_entry, overlap = (lambda p: p), True
 
     # Kernel tier (ops/pallas/, KERNELS.OPT_UPDATE): the fused one-pass
     # optimizer update, resolved ONCE at step-build time. None ⇒ the
     # optax reference chain (the xla escape hatch / unsupported
     # optimizer); non-None is bit-exact vs it (pinned:
-    # tests/test_pallas_kernels.py) and elementwise per leaf, so the
-    # ZeRO layout constraints around it are unchanged.
+    # tests/test_pallas_kernels.py) and elementwise per leaf. Under a
+    # ZeRO layout the kernel lowers PER-SHARD through shard_map over the
+    # rest layout (opt_update.per_shard_update): each rank updates only
+    # the 1/N slice it owns — the fused per-shard weight update of
+    # arXiv:2004.13336, and the fusion point the gather-once schedule
+    # feeds. (The r14 whole-leaf replicated-pin — gather everything,
+    # update, re-scatter — is gone; its recognition in the collectives
+    # lint went with it.)
     from distribuuuu_tpu.ops.pallas import opt_update as fused_opt
 
     fused_update = fused_opt.fused_update_for()
     if fused_update is not None and layout is not None:
-        # Under a ZeRO layout the kernel's operands must be whole
-        # leaves: GSPMD partitions the custom-call region against the
-        # sharded operands INCORRECTLY (measured wrong values, not just
-        # extra traffic — the grid program's indexing does not survive
-        # operand sharding), so the fused region pins its inputs
-        # replicated and the rest-layout constraints below re-shard the
-        # results. The per-shard fused update (shard_map over the data
-        # axis, no gather at all) is exactly ROADMAP #1's overlap work.
-        rep = jax.sharding.NamedSharding(
-            jax.tree.leaves(layout["params"])[0].mesh,
-            jax.sharding.PartitionSpec(),
-        )
-
-        def _whole(tree):
-            return jax.tree.map(
-                lambda x: jax.lax.with_sharding_constraint(x, rep), tree
-            )
-    else:
-        def _whole(tree):
-            return tree
+        fused_update = fused_opt.per_shard_update(fused_update, layout)
 
     def apply_grads(state, grads, new_stats, metrics):
         if layout is not None:
+            if not overlap:
+                # sync control arm: the backward completes before the
+                # first reduce-scatter is issued
+                grads = _barrier(grads)
             # ZeRO: reduce-scatter the grad into the sharded update
             grads = zero.constrain(grads, layout["grads"], scope=rs_scope)
+            if not overlap:
+                # ... and every reduce-scatter lands before the update
+                grads = _barrier(grads)
         with jax.named_scope("optimizer_update"):
             if fused_update is not None:
                 new_params, new_opt_state = fused_update(
-                    _whole(state.params), _whole(grads),
-                    _whole(state.opt_state)
+                    state.params, grads, state.opt_state
                 )
             else:
                 updates, new_opt_state = optimizer.update(
@@ -270,8 +333,13 @@ def train_step_body(model, optimizer, topk: int, accum_steps: int = 1,
 
     def train_step(state: TrainState, batch):
         step_key = jax.random.fold_in(state.key, state.step)
+        # gather-once: FSDP leaves are constrained to their gathered
+        # compute layout HERE, outside grad_fn — forward and backward
+        # consume the one gathered value, and the explicit grads
+        # constraint in apply_grads stays the lone reduce-scatter
+        params = gather_entry(state.params)
         (loss, (logits, new_stats, dropped)), grads = grad_fn(
-            state.params, state.batch_stats, batch["image"], batch["label"],
+            params, state.batch_stats, batch["image"], batch["label"],
             step_key, state.step,
         )
         return apply_grads(
@@ -281,6 +349,11 @@ def train_step_body(model, optimizer, topk: int, accum_steps: int = 1,
 
     def accum_train_step(state: TrainState, micro):
         step_key = jax.random.fold_in(state.key, state.step)
+        # gather-once, OUTSIDE the microbatch scan: every micro-step
+        # closes over the same gathered params (one gather per optimizer
+        # step, not per microbatch); each micro-backward reduce-scatters
+        # into the standing sharded grad-sum
+        gathered_params = gather_entry(state.params)
         if micro["image"].shape[0] != accum_steps:
             raise ValueError(
                 f"accum train step wants a pre-split (accum={accum_steps}, "
@@ -292,7 +365,7 @@ def train_step_body(model, optimizer, topk: int, accum_steps: int = 1,
             stats, gsum, i = carry
             mkey = jax.random.fold_in(step_key, i)
             (loss, (logits, new_stats, dropped)), grads = grad_fn(
-                state.params, stats, mb["image"], mb["label"], mkey,
+                gathered_params, stats, mb["image"], mb["label"], mkey,
                 state.step,
             )
             gsum = jax.tree.map(jnp.add, gsum, grads)
@@ -350,15 +423,25 @@ def make_scan_train_step(model, optimizer, topk: int, fold: int,
     return jax.jit(scan_steps, donate_argnums=0)
 
 
-def make_eval_step(model, topk: int):
+def make_eval_step(model, topk: int, layout=None):
     """Masked eval step: per-batch metric sums + valid count
-    (≙ validate body, ref: trainer.py:77-89)."""
+    (≙ validate body, ref: trainer.py:77-89).
+
+    ``layout`` (passed by :func:`lower` when a ZeRO stage is on) applies
+    the same gather-once schedule the train step uses: at stage 3 the
+    FSDP leaves are gathered once at eval entry instead of per use site.
+    ``None`` (legacy direct callers — serve, tools) keeps the old
+    per-use behavior."""
     prep_images = make_image_prep()
+    gather_entry = (
+        make_gather_entry(layout)[0] if layout is not None else (lambda p: p)
+    )
 
     def eval_step(state: TrainState, batch):
+        params = gather_entry(state.params)
         with jax.named_scope("eval_fwd"):
             logits = model.apply(
-                {"params": state.params, "batch_stats": state.batch_stats},
+                {"params": params, "batch_stats": state.batch_stats},
                 prep_images(batch["image"]),
                 train=False,
             )
@@ -524,6 +607,8 @@ def lower(model, optimizer, topk: int, *, mesh, topology, im_size: int,
     """
     layout = specs_lib.state_layout(model, mesh, im_size, topology.zero)
     step_layout = layout if topology.zero else None
+    if step_layout is not None:
+        _log_zero_schedule(step_layout, topology)
     train_step = make_train_step(
         model, optimizer, topk, accum_steps=accum, layout=step_layout,
         rest_layout=layout,
@@ -536,7 +621,38 @@ def lower(model, optimizer, topk: int, *, mesh, topology, im_size: int,
         )
     return Lowered(
         mesh=mesh, topology=topology, layout=layout, step_layout=step_layout,
-        train_step=train_step, eval_step=make_eval_step(model, topk),
+        train_step=train_step,
+        eval_step=make_eval_step(model, topk, layout=step_layout),
         scan_step=scan_step, accum=max(1, accum), fold=max(1, fold),
         model=model, optimizer=optimizer, im_size=im_size,
+    )
+
+
+_logged_schedules: set = set()
+
+
+def _log_zero_schedule(layout, topology) -> None:
+    """Record the derived ZeRO collective schedule ONCE per distinct
+    shape at lowering time (kind="zero.schedule", telemetry/schema.py):
+    how many leaves rest ZeRO-sharded, how many entry gathers the
+    gather-once transform hoisted, and the overlap knobs — so a run's
+    telemetry states the schedule it trained under (the same facts the
+    static analyzer's census referees post-hoc)."""
+    hoist = specs_lib.gather_schedule(layout, int(cfg.ZERO.GATHER_AHEAD))
+    sharded = sum(
+        1 for sh in jax.tree.leaves(layout["grads"])
+        if "data" in specs_lib.spec_axes(sh.spec)
+    )
+    key = (
+        int(topology.zero), sharded, sum(jax.tree.leaves(hoist)),
+        bool(cfg.ZERO.OVERLAP), int(cfg.ZERO.GATHER_AHEAD),
+    )
+    if key in _logged_schedules:
+        return
+    _logged_schedules.add(key)
+    from distribuuuu_tpu.utils.jsonlog import metrics_log
+
+    metrics_log(
+        "zero.schedule", stage=key[0], leaves=len(jax.tree.leaves(layout["params"])),
+        sharded=key[1], hoisted=key[2], overlap=key[3], gather_ahead=key[4],
     )

@@ -21,6 +21,13 @@ canonicalize) is what the stanza gate (tests/test_mesh_stanzas.py)
 compares declared layouts against compiled shardings with — a spec that
 names a size-1 axis collapses to replication, so dp-only meshes and
 dp×tp meshes flow through identical declarations.
+
+The collective SCHEDULE is derived here too (ISSUE 15): ``gather_schedule``
+decides per leaf — from the spec algebra alone — which ZeRO-3 all-gathers
+the lowering hoists to one step-entry gather (gather-once, ~1 gather/leaf
+vs the ~9.3/leaf per-use storm the analyzer priced), ``compute_layout`` is
+the gathered target, and ``collective_expectations`` is the referee table
+the static analyzer's collective lint scores compiled programs against.
 """
 
 from __future__ import annotations
@@ -396,8 +403,78 @@ def state_layout(model, mesh: Mesh, im_size: int, zero_stage: int) -> dict:
     return layout
 
 
+# ------------------------------------------------- gather scheduling
+
+
+def compute_layout(layout: dict) -> Any:
+    """The params layout DURING compute: the rest layout with the ZeRO
+    ``data`` axis stripped per leaf (zero.strip_data_axis — the exact
+    inverse of the transform that added it). At stage 0/1 this equals the
+    rest layout (identity); at stage 3 it is the gathered form the
+    gather-once schedule constrains FSDP leaves to at step entry."""
+    from distribuuuu_tpu.parallel import zero
+
+    return jax.tree.map(
+        lambda sh: NamedSharding(sh.mesh, zero.strip_data_axis(sh.spec)),
+        layout["params"],
+    )
+
+
+def gather_groups(layout: dict) -> Any:
+    """Per-leaf block-group index for gather scheduling, derived from the
+    SAME path naming the spec-table rules match against: the first
+    integer appearing in the leaf path (flax's numbered modules —
+    ``ResNetStage_2/...``, ``blocks_5/...``, ``Dense_1/...``) names the
+    leaf's group; un-numbered leaves (stem, embeddings, final norm) are
+    group 0. Purely a scheduling coordinate — no effect on values — used
+    by :func:`gather_schedule` to bound how many groups the gather-once
+    transform hoists to step entry (``ZERO.GATHER_AHEAD``)."""
+    flat, treedef = jax.tree_util.tree_flatten_with_path(layout["params"])
+    out = []
+    for path, _ in flat:
+        m = re.search(r"(\d+)", leaf_path(path))
+        out.append(int(m.group(1)) if m else 0)
+    return jax.tree.unflatten(treedef, out)
+
+
+def gather_schedule(layout: dict, ahead: int = -1) -> Any:
+    """Per-leaf bool tree: True = this leaf's ZeRO all-gather is hoisted
+    to step entry (gathered ONCE per step), False = the leaf keeps its
+    rest layout into the step and GSPMD gathers at use sites.
+
+    Derived from the spec algebra alone — a leaf qualifies iff the ZeRO
+    transform added ``data`` to its rest spec (stage 3 FSDP leaves; at
+    stage 0/1 params rest in the base layout and the schedule is empty).
+    ``ahead`` is ``ZERO.GATHER_AHEAD``: -1 hoists every qualifying leaf
+    (the default — ~1 gather/leaf/step, full gathered footprint), 0
+    hoists none (the legacy per-use schedule), N >= 1 hoists only the
+    leaves of the first N block-groups in :func:`gather_groups` order
+    (bounds the gathered-live footprint)."""
+    ahead = int(ahead)
+    if ahead < -1:
+        raise ValueError(
+            f"ZERO.GATHER_AHEAD={ahead}: must be -1 (hoist the whole "
+            "tree), 0 (legacy per-use gathers), or N >= 1 (hoist the "
+            "first N block-groups)"
+        )
+    needs = jax.tree.map(
+        lambda sh: "data" in spec_axes(sh.spec), layout["params"]
+    )
+    if ahead == -1:
+        return needs
+    if ahead == 0:
+        return jax.tree.map(lambda _: False, needs)
+    groups = gather_groups(layout)
+    ordered = sorted({
+        g for g, n in zip(jax.tree.leaves(groups), jax.tree.leaves(needs))
+        if n
+    })
+    hoisted = set(ordered[:ahead])
+    return jax.tree.map(lambda n, g: bool(n and g in hoisted), needs, groups)
+
+
 def collective_expectations(layout: dict, topology,
-                            fused_update_pinned: bool = False) -> dict:
+                            gather_ahead: int | None = None) -> dict:
     """What the spec algebra predicts about the collective schedule of a
     step program lowered from ``layout`` under ``topology`` — the
     referee table the static analyzer's collective lint compares the
@@ -413,17 +490,24 @@ def collective_expectations(layout: dict, topology,
         unconstrained over populated axes — grad means, BN/loss
         reductions. Gather-class ops are the dangerous ones: an
         ``all-gather`` over ``data`` is only predicted when a ZeRO stage
-        re-gathers rest layouts (or the fused-update kernel pins its
-        whole-leaf operands — the PR 13 replicated-pin, recognized here
-        so the lint does not re-flag it); in a plain-DDP program it
-        means something rests sharded that the declaration says is
-        replicated, i.e. a silent re-gather.
+        re-gathers rest layouts; in a plain-DDP program it means
+        something rests sharded that the declaration says is replicated,
+        i.e. a silent re-gather.
       * ``gather_bound`` bounds the non-metric all-gather count over the
-        ``data`` axis: ~1 gather per rest-resharded leaf for stage 1,
-        ~4× for stage 3 (forward + backward + update re-gathers before
-        XLA merges them), plus the pinned fused-update gathers (params +
-        grads + each moment copy) when active. Exceeding it is a gather
-        storm even when gathers are expected at all.
+        ``data`` axis. Stage 1: ~2 per rest-resharded leaf (the
+        post-update re-gather plus slack for XLA splitting one). Stage 3
+        under the gather-once schedule (``ZERO.GATHER_AHEAD`` -1, the
+        default): ~1 per leaf — every FSDP leaf is gathered once at step
+        entry and never again (the r16 model; the PR 14 census priced
+        the per-use schedule at ~9.3/leaf and this bound is what makes a
+        schedule regression a finding, not a waiver). With hoisting
+        disabled or partial (``GATHER_AHEAD`` >= 0) the per-use ceiling
+        (10×/leaf) applies — the escape hatch is priced, not flagged.
+        Exceeding the bound is a gather storm even when gathers are
+        expected at all.
+
+    ``gather_ahead`` defaults to the live ``cfg.ZERO.GATHER_AHEAD`` (the
+    knob the analyzed program was lowered under).
     """
     leaves = jax.tree.leaves(layout["params"])
     grads = jax.tree.leaves(layout["grads"])
@@ -434,6 +518,10 @@ def collective_expectations(layout: dict, topology,
     ep_sharded = sum(1 for p in leaves if "expert" in spec_axes(p.spec))
     zero = int(getattr(topology, "zero", 0))
     feats = topology.features() if hasattr(topology, "features") else set()
+    if gather_ahead is None:
+        from distribuuuu_tpu.config import cfg
+
+        gather_ahead = int(cfg.ZERO.GATHER_AHEAD)
 
     gather_axes = set()
     if tp_sharded or "tp" in feats:
@@ -444,17 +532,21 @@ def collective_expectations(layout: dict, topology,
         gather_axes.add("pipe")
     if "sp" in feats:
         gather_axes.add("seq")
-    if zero or fused_update_pinned:
+    if zero:
         gather_axes.add("data")
 
     gather_bound = None
     if zero == 1:
         gather_bound = 2 * zero_sharded
     elif zero == 3:
-        gather_bound = 4 * zero_sharded
-    if fused_update_pinned:
-        # params + grads + up to two moment copies gathered whole-leaf
-        gather_bound = (gather_bound or 0) + 4 * len(leaves)
+        # gather-once (the default schedule): one entry gather per FSDP
+        # leaf + slack for metric/loss-adjacent gathers. Per-use (the
+        # GATHER_AHEAD >= 0 escape hatch / partial hoisting): the
+        # measured ~9.3-gathers/leaf legacy ceiling, rounded to 10.
+        if gather_ahead == -1:
+            gather_bound = zero_sharded + 4
+        else:
+            gather_bound = 10 * zero_sharded
 
     a2a_axes = set()
     if ep_sharded or "ep" in feats or "tp" in feats:

@@ -57,6 +57,32 @@ def _padded(spec: P, rank: int):
     return entries + (None,) * (rank - len(entries))
 
 
+def _entry_names(entry) -> tuple[str, ...]:
+    """Axis names of one spec entry (None / str / tuple)."""
+    if entry is None:
+        return ()
+    return (entry,) if isinstance(entry, str) else tuple(entry)
+
+
+def strip_data_axis(spec: P | None) -> P:
+    """The exact inverse of :func:`add_data_axis`: ``spec`` with the
+    ``data`` axis removed from every entry — the layout a ZeRO-sharded
+    leaf occupies DURING compute once its shard has been all-gathered
+    (TP/PP annotations survive; a leaf ``data`` never touched is
+    returned unchanged). This is the gather target of the gather-once
+    schedule (partition/specs.gather_schedule)."""
+    entries = []
+    for entry in tuple(spec) if spec is not None else ():
+        names = tuple(n for n in _entry_names(entry) if n != DATA_AXIS)
+        if not names:
+            entries.append(None)
+        elif len(names) == 1:
+            entries.append(names[0])
+        else:
+            entries.append(names)
+    return P(*entries)
+
+
 def add_data_axis(
     spec: P | None,
     shape: tuple[int, ...],

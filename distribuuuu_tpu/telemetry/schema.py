@@ -28,6 +28,13 @@ KINDS: dict[str, frozenset] = {
     "timeline": frozenset({"v", "phase", "epoch", "batch", "n"}),
     # -- parallelism / serving -------------------------------------------
     "pp_bubble": frozenset({"stages", "microbatches", "ticks", "bubble"}),
+    # the derived ZeRO collective schedule, once per distinct shape at
+    # lowering time (parallel/partition/lowering._log_zero_schedule):
+    # leaves resting sharded, entry gathers hoisted by gather-once, and
+    # the ZERO.OVERLAP / ZERO.GATHER_AHEAD knobs the step compiled under
+    "zero.schedule": frozenset(
+        {"stage", "leaves", "sharded", "hoisted", "overlap", "gather_ahead"}
+    ),
     "serve": frozenset(
         {"requests", "rejected", "batches", "throughput_rps", "p50_ms",
          "p90_ms", "p99_ms", "batch_occupancy"}

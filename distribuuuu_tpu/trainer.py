@@ -1525,12 +1525,19 @@ def test_model():
     check_batch_geometry(mesh, eval_only=True)
     model = build_model_from_cfg(topo)
     key = jax.random.key(cfg.RNG_SEED or 0)
-    state = create_train_state(model, key, mesh, cfg.TRAIN.IM_SIZE)
+    layout = _state_layout(model, mesh, cfg.TRAIN.IM_SIZE)
+    state = create_train_state(
+        model, key, mesh, cfg.TRAIN.IM_SIZE, layout=layout
+    )
     if cfg.MODEL.WEIGHTS:
         state = _with_restored_weights(state, cfg.MODEL.WEIGHTS, model)
         logger.info("loaded weights from %s", cfg.MODEL.WEIGHTS)
     val_loader = construct_val_loader()
-    eval_step = make_eval_step(model, effective_topk())
+    # ZeRO rest layouts evaluate under the same gather-once schedule the
+    # train path uses (partition/lowering.make_gather_entry)
+    eval_step = make_eval_step(
+        model, effective_topk(), layout=layout if cfg.MESH.ZERO else None
+    )
     result = validate(val_loader, mesh, state, eval_step, 0, logger)
     if result is None:  # preempted mid-eval (TRAIN.PREEMPT_SAVE)
         if mesh_lib.is_primary():

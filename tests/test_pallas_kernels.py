@@ -206,7 +206,11 @@ def test_zero_sharded_update_equals_unsharded_then_shard():
 def test_fused_update_under_real_zero_lowering():
     """KERNELS.OPT_UPDATE=pallas composed with the partition layer's
     ZeRO-1 layout on the 8-device mesh: the trajectory must match the
-    XLA reference path's within the pinned tolerance."""
+    XLA reference path's within the pinned tolerance. Since r16 the
+    fused update lowers PER-SHARD through shard_map
+    (opt_update.per_shard_update) — both arms consume the same
+    reduce-scattered grads; tests/test_zero_overlap.py adds the ZeRO-3
+    twin and pins the census stays gather-once."""
     from distribuuuu_tpu import trainer
     from distribuuuu_tpu.parallel import mesh as mesh_lib, sharding
     from distribuuuu_tpu.parallel.partition import topology as topo_lib
@@ -237,10 +241,12 @@ def test_fused_update_under_real_zero_lowering():
     cfg.MODEL.ARCH = "resnet18"
     cfg.MODEL.NUM_CLASSES = 4
     cfg.MESH.ZERO = 1
-    # the ZeRO reference arm reduces grads in reduce-scatter order while
-    # the fused arm sees them gathered whole — ulp-level drift that a
-    # reference-recipe LR of 0.1 amplifies chaotically through BN+relu
-    # within two steps; the pin is layout composition, not chaos
+    # both arms consume the same reduce-scattered grads (per-shard
+    # lowering), but XLA fuses the in-step optax chain with different
+    # FMA contraction than the shard_map'd kernel region — ulp-level
+    # drift that a reference-recipe LR of 0.1 amplifies chaotically
+    # through BN+relu within two steps; the pin is layout composition,
+    # not chaos
     cfg.OPTIM.BASE_LR = 0.001
     ref_params, ref_metrics = run_two_steps()
     cfg.defrost()

@@ -74,7 +74,6 @@ def _toy_bundle(mesh, topo, layout, fn, state_in, batch_in,
         expectations=expectations or specs.collective_expectations(
             layout, topo
         ),
-        fused_update_pinned=False,
         geometry={"compute_dtype": compute_dtype},
     )
 

@@ -1,0 +1,399 @@
+"""Latency-hiding ZeRO (ISSUE 15): the gather-once schedule and the
+collective/compute overlap knobs as a partition-layer transform.
+
+Three contracts, each pinned toy-sized (tier-1 sits at ~800s of the
+870s budget — every compile here is a two-Dense MLP on the 8-device
+mesh):
+
+* SCHEDULE — ZeRO-3 FSDP leaves are all-gathered ONCE at step entry
+  (specs.gather_schedule over the spec algebra, no per-model code); the
+  compiled census shows ~1 gather/leaf and the committed analyzer
+  artifact pins the real dp8·zero3[resnet18] drop (195 → ≤25).
+* BIT-IDENTITY — ZERO.OVERLAP on ≡ off produces bit-identical params
+  (the off arm only inserts optimization_barrier joins; values cannot
+  differ by construction), at stage 1 AND stage 3, per-step and
+  grad-accum paths.
+* PER-SHARD FUSED UPDATE — KERNELS.OPT_UPDATE=pallas under a ZeRO
+  layout lowers through shard_map on each rank's 1/N slice
+  (opt_update.per_shard_update) and tracks the optax arm jit-vs-jit.
+"""
+
+import json
+import os
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+import flax.linen as nn
+import pytest
+from jax.sharding import PartitionSpec as P
+
+import distribuuuu_tpu.config as config
+from distribuuuu_tpu.config import cfg
+from distribuuuu_tpu.parallel import mesh as mesh_lib, sharding as sharding_lib, zero
+from distribuuuu_tpu.parallel.partition import (
+    lowering,
+    specs,
+    topology as topo_lib,
+)
+from distribuuuu_tpu.utils.optim import construct_optimizer
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+IM = 8  # toy image edge; MLP flattens it — smallest geometry that shards
+
+
+class ToyMLP(nn.Module):
+    """Two Dense layers over flattened pixels: the smallest model whose
+    kernels clear zero.MIN_SHARD_ELEMS, so the ZeRO transform genuinely
+    shards leaves (kernel0: 192×128 = 24576 elems ≥ 8192)."""
+
+    num_classes: int = 8
+
+    @nn.compact
+    def __call__(self, x, train=False):
+        x = x.reshape((x.shape[0], -1))
+        x = nn.Dense(128, name="Body_0")(x)
+        x = nn.relu(x)
+        return nn.Dense(self.num_classes, name="Head_1")(x)
+
+
+def _lower_toy(stage: int, overlap=True, ahead=-1, accum=1):
+    cfg.MODEL.NUM_CLASSES = 8
+    cfg.DEVICE.COMPUTE_DTYPE = "float32"
+    cfg.OPTIM.BASE_LR = 0.01
+    cfg.MESH.DATA = -1
+    cfg.MESH.ZERO = stage
+    cfg.ZERO.OVERLAP = overlap
+    cfg.ZERO.GATHER_AHEAD = ahead
+    topo = topo_lib.from_cfg(cfg)
+    mesh = mesh_lib.mesh_from_cfg(cfg)
+    model = ToyMLP()
+    return mesh, lowering.lower(
+        model, construct_optimizer(), 2, mesh=mesh, topology=topo,
+        im_size=IM, accum=accum,
+    )
+
+
+def _toy_batch(accum: int = 1):
+    rng = np.random.default_rng(0)
+    n = 16
+    return {
+        "image": rng.standard_normal((n, IM, IM, 3)).astype(np.float32),
+        "label": rng.integers(0, 8, (n,)).astype(np.int32),
+    }
+
+
+def _run_steps(stage, overlap, ahead=-1, n=3, accum=1):
+    mesh, low = _lower_toy(stage, overlap=overlap, ahead=ahead, accum=accum)
+    state = low.init_state(jax.random.key(0), IM)
+    batch = low.put_batch(_toy_batch())
+    for _ in range(n):
+        state, m = low.train_step(state, batch)
+    return jax.device_get(state.params), float(m["loss"]), low, mesh
+
+
+def _maxdiff(a, b):
+    return max(jax.tree.leaves(jax.tree.map(
+        lambda x, y: float(np.abs(np.asarray(x) - np.asarray(y)).max()),
+        a, b,
+    )))
+
+
+# ------------------------------------------------------- spec algebra
+
+
+def test_strip_data_axis_inverts_add_data_axis():
+    cases = [
+        (P(), (3, 3, 64, 128)),
+        (P(None, None, None, "model"), (3, 3, 64, 128)),
+        (P(None, None, None, "model"), (7, 7, 3, 64)),
+    ]
+    for base, shape in cases:
+        added = zero.add_data_axis(base, shape, 8, {"model": 1})
+        stripped = zero.strip_data_axis(added)
+        # canonical equality: trailing Nones are cosmetic
+        assert specs.canonicalize(stripped, {}) == specs.canonicalize(
+            base, {}
+        ), (base, added, stripped)
+    # a spec data never touched is returned unchanged
+    assert zero.strip_data_axis(P("model", None)) == P("model", None)
+
+
+def test_gather_schedule_derivation_and_refusal():
+    mesh, low = _lower_toy(3)
+    layout = low.layout
+    needs = [
+        "data" in specs.spec_axes(sh.spec)
+        for sh in jax.tree.leaves(layout["params"])
+    ]
+    assert sum(needs) >= 1  # the toy genuinely shards
+    # -1: every qualifying leaf hoisted
+    full = jax.tree.leaves(specs.gather_schedule(layout, -1))
+    assert full == needs
+    # 0: the legacy per-use schedule — nothing hoisted
+    assert not any(jax.tree.leaves(specs.gather_schedule(layout, 0)))
+    # 1: only group-0 leaves (Body_0) hoisted, Head_1 (group 1) not
+    one = specs.gather_schedule(layout, 1)
+    flat = jax.tree_util.tree_flatten_with_path(one)[0]
+    for path, hoisted in flat:
+        p = specs.leaf_path(path)
+        if "Head_1/kernel" in p:
+            assert not hoisted, p
+    assert sum(jax.tree.leaves(one)) >= 1
+    with pytest.raises(ValueError, match="GATHER_AHEAD"):
+        specs.gather_schedule(layout, -2)
+    # stage 1: params rest replicated — empty schedule at any depth
+    _, low1 = _lower_toy(1)
+    assert not any(jax.tree.leaves(specs.gather_schedule(low1.layout, -1)))
+
+
+def test_compute_layout_strips_only_data():
+    _, low = _lower_toy(3)
+    gathered = specs.compute_layout(low.layout)
+    for sh in jax.tree.leaves(gathered):
+        assert "data" not in specs.spec_axes(sh.spec)
+
+
+# ------------------------------------------------ schedule in the HLO
+
+
+def test_gather_once_census_on_toy_program():
+    """The compiled ZeRO-3 step all-gathers each FSDP leaf once (the
+    gather-once schedule), stays within the spec-algebra bound, and the
+    collectives lint raises no finding; the per-use escape hatch
+    (GATHER_AHEAD=0) still compiles and keeps the same loss math."""
+    from distribuuuu_tpu.analysis import hlo
+    from distribuuuu_tpu.analysis.passes import collectives
+
+    mesh, low = _lower_toy(3)
+    state_sds, batch_sds = low.abstract_args()
+    lowered = low.train_step.lower(state_sds, batch_sds)
+    compiled = lowered.compile()
+    census = hlo.collective_census(compiled.as_text(), mesh)
+    exp = specs.collective_expectations(low.layout, low.topology,
+                                        gather_ahead=-1)
+    data_gathers = [
+        op for op in census
+        if op["kind"] == "all-gather" and op["axes"] == ("data",)
+    ]
+    assert exp["zero_sharded"] >= 1
+    assert len(data_gathers) <= exp["gather_bound"], (
+        len(data_gathers), exp,
+    )
+    # the entry gather carries the attribution scope: axis-qualified in
+    # the LOWERED StableHLO locs (compiled HLO metadata strips the
+    # ``@axes`` suffix on this jax line — same caveat as the PP scopes,
+    # PR 8), and attributed by the census from the compiled metadata
+    assert "zero_gather_once@data" in hlo.stablehlo_with_locs(lowered)
+    assert any(
+        "zero_gather_once" in op["scope"] for op in data_gathers
+    ), [op["scope"] for op in data_gathers]
+
+
+def test_committed_census_artifact_pins_the_drop():
+    """ANALYSIS_r01.json (the regenerated referee): dp8·zero3[resnet18]
+    all-gather census ≤ 25 (~1/leaf; the PR 14 baseline priced the
+    per-use schedule at 195 ≈ 9.3/leaf) and the ZeRO-3 gather-storm
+    waivers are GONE from the baseline."""
+    with open(os.path.join(REPO, "ANALYSIS_r01.json")) as f:
+        doc = json.load(f)
+    case = next(
+        c for c in doc["cases"] if c["name"] == "sweep/dp8·zero3[resnet18]"
+    )
+    ag = case["collective_ledger"]["data"]["all-gather"]["count"]
+    assert ag <= 25, ag
+    pp = next(
+        c for c in doc["cases"]
+        if c["name"] == "sweep/dp2·pp4·zero3[vit_tiny]"
+    )
+    assert pp["collective_ledger"]["data"]["all-gather"]["count"] <= 20
+    with open(os.path.join(REPO, "ANALYSIS_BASELINE.json")) as f:
+        base = json.load(f)
+    keys = [w["key"] for w in base["waivers"]]
+    assert not any("gather-storm" in k for k in keys), keys
+
+
+# ------------------------------------------------------- bit-identity
+
+
+@pytest.mark.parametrize("stage", [1, 3])
+def test_overlap_on_off_bit_identical(stage):
+    """ZERO.OVERLAP off only inserts optimization_barrier joins — the
+    synchronous control arm of the A/B is bit-identical, both ZeRO
+    stages, 3 steps (the ISSUE 15 acceptance pin)."""
+    on, loss_on, _, _ = _run_steps(stage, overlap=True)
+    off, loss_off, _, _ = _run_steps(stage, overlap=False)
+    assert _maxdiff(on, off) == 0.0
+    assert loss_on == loss_off
+
+
+def test_overlap_bit_identical_on_accum_path():
+    """Same pin through the grad-accumulation scan (gather-once hoists
+    OUTSIDE the microbatch scan — one gather per optimizer step)."""
+    on, _, _, _ = _run_steps(3, overlap=True, n=2, accum=2)
+    off, _, _, _ = _run_steps(3, overlap=False, n=2, accum=2)
+    assert _maxdiff(on, off) == 0.0
+
+
+def test_partial_hoist_values_unchanged():
+    """GATHER_AHEAD is pure scheduling: hoisting only the first group
+    produces the same values as hoisting everything (constraints move,
+    math does not)."""
+    full, _, _, _ = _run_steps(3, overlap=True, ahead=-1, n=2)
+    part, _, _, _ = _run_steps(3, overlap=True, ahead=1, n=2)
+    assert _maxdiff(full, part) == 0.0
+
+
+def test_eval_step_gathers_once_at_zero3():
+    """lower() threads the schedule into the eval step: it runs on the
+    sharded rest state and its program carries the gather-once scope."""
+    mesh, low = _lower_toy(3)
+    state = low.init_state(jax.random.key(0), IM)
+    hb = _toy_batch()
+    hb["mask"] = np.ones((16,), np.float32)
+    batch = sharding_lib.shard_batch(mesh, hb)
+    m = low.eval_step(state, batch)
+    assert float(m["count"]) == 16.0
+    from distribuuuu_tpu.analysis import hlo
+
+    txt = hlo.stablehlo_with_locs(low.eval_step.lower(state, batch))
+    assert "zero_gather_once@data" in txt
+
+
+# ------------------------------------------- per-shard fused update
+
+
+@pytest.mark.parametrize("stage", [1, 3])
+def test_per_shard_fused_update_matches_optax(stage):
+    """KERNELS.OPT_UPDATE=pallas under a real ZeRO lowering: the
+    shard_map per-shard kernel (no whole-leaf gather — the r14
+    replicated-pin is deleted) tracks the optax arm jit-vs-jit within
+    the kernel tier's pinned tolerance."""
+    ref, _, _, _ = _run_steps(stage, overlap=True, n=2)
+    config.reset_cfg()
+    cfg.KERNELS.OPT_UPDATE = "pallas"
+    fused, _, low, mesh = _run_steps(stage, overlap=True, n=2)
+    assert _maxdiff(ref, fused) <= 5e-6
+    # and the fused program does NOT reintroduce the whole-leaf gathers:
+    # census stays within the same gather-once bound as the optax arm
+    from distribuuuu_tpu.analysis import hlo
+
+    state_sds, batch_sds = low.abstract_args()
+    compiled = low.train_step.lower(state_sds, batch_sds).compile()
+    census = hlo.collective_census(compiled.as_text(), mesh)
+    exp = specs.collective_expectations(low.layout, low.topology,
+                                        gather_ahead=-1)
+    ag = sum(
+        1 for op in census
+        if op["kind"] == "all-gather" and op["axes"] == ("data",)
+    )
+    assert ag <= exp["gather_bound"], (ag, exp)
+
+
+# ------------------------------------------------- telemetry + bench
+
+
+def test_zero_schedule_telemetry_declared_and_deduped(monkeypatch):
+    from distribuuuu_tpu.telemetry import schema
+
+    assert "zero.schedule" in schema.KINDS
+    _, low = _lower_toy(3)
+    records = []
+    monkeypatch.setattr(
+        "distribuuuu_tpu.utils.jsonlog.metrics_log",
+        lambda kind, **f: records.append((kind, f)),
+    )
+    lowering._logged_schedules.clear()
+    lowering._log_zero_schedule(low.layout, low.topology)
+    lowering._log_zero_schedule(low.layout, low.topology)  # deduped
+    assert len(records) == 1
+    kind, fields = records[0]
+    assert kind == "zero.schedule"
+    assert schema.KINDS["zero.schedule"] <= set(fields)
+    assert fields["stage"] == 3 and fields["hoisted"] >= 1
+
+
+def test_bench_index_zero_overlap_series(tmp_path):
+    """bench_history indexes the BENCH_r10 zero_overlap section as
+    zero_overlap_* series (outside the img/s gate patterns), and the
+    committed BENCH_INDEX.json carries them."""
+    import sys
+
+    sys.path.insert(0, os.path.join(REPO, "tools"))
+    try:
+        import bench_history
+    finally:
+        sys.path.remove(os.path.join(REPO, "tools"))
+    doc = {
+        "zero_overlap": {
+            "cases": {
+                "dp8_zero3": {"arms": {
+                    "overlap_on": {"data_all_gathers": 22, "step_ms": 10.0},
+                    "per_use": {"data_all_gathers": 196, "step_ms": 12.0},
+                }},
+            },
+        },
+    }
+    with open(tmp_path / "BENCH_r10.json", "w") as f:
+        json.dump(doc, f)
+    idx = bench_history.build_index(str(tmp_path))
+    s = idx["series"]
+    assert s["zero_overlap_dp8_zero3_overlap_on_data_gathers"][0]["value"] == 22
+    assert s["zero_overlap_dp8_zero3_per_use_data_gathers"][0]["value"] == 196
+    assert not any("images_per_sec" in k for k in s)
+    # committed artifacts: BENCH_r10.json indexed into BENCH_INDEX.json,
+    # and the gather-once arm beats per-use on the census
+    with open(os.path.join(REPO, "BENCH_INDEX.json")) as f:
+        committed = json.load(f)
+    on = committed["series"]["zero_overlap_dp8_zero3_overlap_on_data_gathers"]
+    per_use = committed["series"]["zero_overlap_dp8_zero3_per_use_data_gathers"]
+    assert on[-1]["value"] < per_use[-1]["value"]
+
+
+# ------------------------------------------------- trace overlap rollup
+
+
+def test_trace_overlap_fraction_rollup():
+    import sys
+
+    sys.path.insert(0, os.path.join(REPO, "tools"))
+    try:
+        import trace_report
+    finally:
+        sys.path.remove(os.path.join(REPO, "tools"))
+
+    def ev(line, op, start, dur, name="fusion.1"):
+        return {"line": line, "name": name, "op_name": op,
+                "bytes": 0, "start_ns": start, "dur_ns": dur}
+
+    gather = "jit(train_step)/zero_gather_once@data/all-gather"
+    compute = "jit(train_step)/jvp(fwd)/conv"
+    # collective on line A [0, 100); compute on line B [50, 150):
+    # 50 of 100 collective ns hidden -> fraction 0.5
+    events = [
+        ev("lineA", gather, 0.0, 100.0, name="all-gather.1"),
+        ev("lineB", compute, 50.0, 100.0),
+    ]
+    ov = trace_report.overlap_fraction(events)
+    assert ov["fraction"] == 0.5
+    assert ov["zero_collective_ms"] == pytest.approx(1e-4)
+    # fully serialized: fraction 0
+    serial = [
+        ev("lineA", gather, 0.0, 100.0, name="all-gather.1"),
+        ev("lineA", compute, 100.0, 100.0),
+    ]
+    assert trace_report.overlap_fraction(serial)["fraction"] == 0.0
+    # fully hidden: fraction 1
+    hidden = [
+        ev("lineA", gather, 10.0, 50.0, name="all-gather.1"),
+        ev("lineB", compute, 0.0, 100.0),
+    ]
+    assert trace_report.overlap_fraction(hidden)["fraction"] == 1.0
+    # no start stamps (older fixtures) -> no section, summary still works
+    legacy = [{"line": "lineA", "name": "fusion.1", "op_name": compute,
+               "bytes": 0, "dur_ns": 5.0}]
+    assert trace_report.overlap_fraction(legacy) is None
+    summary = trace_report.summarize_events(events, steps=1)
+    assert summary["overlap"]["fraction"] == 0.5
+    assert "busy_ms_per_step" in summary

@@ -135,11 +135,29 @@ def index_kernels(path: str, doc: dict, series: dict) -> None:
                src, row.get("intensity_with_kernel"), "flop/byte")
 
 
+def index_zero_overlap(path: str, doc: dict, series: dict) -> None:
+    """BENCH_r10+ ``zero_overlap`` section (tools/collective_bench.py
+    --zero-ab): per topology, the compiled all-gather census of each
+    scheduling arm (gather-once overlap on/off vs the legacy per-use
+    schedule) and the measured step wall. Every series name is
+    ``zero_overlap_*`` — deliberately outside the img/s gate patterns
+    (the PR 8 clobbering lesson): CPU-container census counts and
+    seconds must never become the throughput regression reference."""
+    zo = doc.get("zero_overlap") or {}
+    rnd, src = _round_of(path), os.path.basename(path)
+    for case, rec in (zo.get("cases") or {}).items():
+        for arm, row in (rec.get("arms") or {}).items():
+            _point(series, f"zero_overlap_{case}_{arm}_data_gathers", rnd,
+                   src, row.get("data_all_gathers"))
+            _point(series, f"zero_overlap_{case}_{arm}_step_ms", rnd, src,
+                   row.get("step_ms"), "ms")
+
+
 def index_train_bench(path: str, series: dict) -> None:
     """BENCH_r*.json: the ``parsed`` block is the metric (r06+ may
     instead carry an ``asyncplane`` section, r08+ an ``lm`` section,
-    r09+ a kernel-tier ``kernels``/``step_ab`` matrix — indexed
-    separately)."""
+    r09+ a kernel-tier ``kernels``/``step_ab`` matrix, r10+ a
+    ``zero_overlap`` schedule A/B — indexed separately)."""
     with open(path) as f:
         doc = json.load(f)
     if doc.get("asyncplane"):
@@ -148,6 +166,8 @@ def index_train_bench(path: str, series: dict) -> None:
         index_lm(path, doc, series)
     if doc.get("kernels") or doc.get("step_ab"):
         index_kernels(path, doc, series)
+    if doc.get("zero_overlap"):
+        index_zero_overlap(path, doc, series)
     parsed = doc.get("parsed") or {}
     if "metric" in parsed and "value" in parsed:
         _point(series, str(parsed["metric"]), _round_of(path),

@@ -8,11 +8,27 @@ reports per-size latency plus algorithm bandwidth the way NCCL's
 inside a train step, so the numbers reflect the real ICI/DCN path (or the
 host-interconnect on a forced CPU mesh).
 
+A second mode (``--zero-ab``, ISSUE 15) A/Bs the ZeRO collective
+SCHEDULE instead of raw collective latency: per ZeRO stage (1/3, plus
+the PP×ZeRO-3 composition) it lowers the REAL train step through the
+partition layer under each scheduling arm — gather-once + overlap
+(the default), gather-once with overlap barriers (``ZERO.OVERLAP``
+False — the synchronous control), and the legacy per-use schedule
+(``ZERO.GATHER_AHEAD=0``) — then records the compiled all-gather census
+(the schedule, from analysis.hlo — CPU-provable), measured step wall
+time, and max |param diff| vs the default arm after N steps (the
+bit-identity half of the A/B). Results land in a ``zero_overlap``
+section (``--json-out BENCH_r10.json``) indexed by bench_history as
+``zero_overlap_*`` series.
+
 Usage:
     python tools/collective_bench.py [--min-mb 0.001] [--max-mb 64] [--iters 20]
     # simulated topology:
     JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8 \
         python tools/collective_bench.py --max-mb 4
+    # ZeRO schedule A/B:
+    JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8 \
+        python tools/collective_bench.py --zero-ab --json-out BENCH_r10.json
 
 For the native (C-API-level) equivalent that talks to the TPU runtime
 directly, see native/collective_bench.cc.
@@ -86,13 +102,184 @@ def bench_one(fn, buf, iters: int) -> float:
     return (time.perf_counter() - t0) / iters
 
 
+# ---------------------------------------------------- ZeRO schedule A/B
+
+# (name, stanza overrides, arch) — every committed-waiver topology plus
+# the stage-1 reference
+ZERO_AB_CASES = (
+    ("dp8_zero1", {"DATA": -1, "ZERO": 1}, "resnet18"),
+    ("dp8_zero3", {"DATA": -1, "ZERO": 3}, "resnet18"),
+    ("dp2_pp4_zero3", {"DATA": 2, "PIPE": 4, "ZERO": 3}, "vit_tiny"),
+)
+
+# arm name -> (ZERO.OVERLAP, ZERO.GATHER_AHEAD)
+ZERO_AB_ARMS = {
+    "overlap_on": (True, -1),   # gather-once, collectives free to hide
+    "overlap_off": (False, -1),  # gather-once, barrier-serialized control
+    "per_use": (True, 0),        # the legacy schedule (the r15 baseline)
+}
+
+
+def _zero_ab_case(name: str, stanza: dict, arch: str, steps: int) -> dict:
+    """One topology through every scheduling arm: census + step wall +
+    params-vs-default-arm divergence."""
+    import numpy as np
+
+    import distribuuuu_tpu.config as config
+    from distribuuuu_tpu.analysis import hlo
+    from distribuuuu_tpu.config import cfg
+    from distribuuuu_tpu import trainer
+    from distribuuuu_tpu.parallel import (
+        mesh as mesh_lib, sharding as sharding_lib,
+    )
+    from distribuuuu_tpu.parallel.partition import lowering
+    from distribuuuu_tpu.utils.optim import construct_optimizer
+
+    rng = np.random.default_rng(0)
+    im = 16
+    # ONE host batch for the whole case — every arm trains the same data
+    # (a per-arm draw would turn the divergence column into noise)
+    host_batch = {
+        "image": rng.standard_normal((16, im, im, 3)).astype(np.float32),
+        "label": rng.integers(0, 8, (16,)).astype(np.int32),
+    }
+    out = {"arch": arch, "stanza": stanza, "arms": {}}
+    ref_params = None
+    for arm, (overlap, ahead) in ZERO_AB_ARMS.items():
+        config.reset_cfg()
+        cfg.MODEL.ARCH = arch
+        cfg.MODEL.NUM_CLASSES = 8
+        cfg.DEVICE.COMPUTE_DTYPE = "float32"
+        cfg.OPTIM.BASE_LR = 0.01
+        for k, v in stanza.items():
+            cfg.MESH[k] = v
+        cfg.ZERO.OVERLAP = overlap
+        cfg.ZERO.GATHER_AHEAD = ahead
+        if stanza.get("PIPE", 1) > 1:
+            cfg.MESH.MICROBATCH = 4
+        topo = trainer.check_trainer_mesh()
+        mesh = mesh_lib.mesh_from_cfg(cfg)
+        model = trainer.build_model_from_cfg(topo)
+        low = lowering.lower(
+            model, construct_optimizer(), 2,
+            mesh=mesh, topology=topo, im_size=im,
+        )
+        # the compiled schedule (the census referee, CPU-provable)
+        state_sds, batch_sds = low.abstract_args()
+        compiled = low.train_step.lower(state_sds, batch_sds).compile()
+        census = hlo.collective_census(compiled.as_text(), mesh)
+        gathers = sum(
+            1 for op in census
+            if op["kind"] == "all-gather" and op["axes"] == ("data",)
+        )
+        total = len(census)
+        # measured steps (CPU wall — the schedule is the provable part
+        # here, wall-clock overlap needs real async hardware)
+        batch = sharding_lib.shard_batch(mesh, host_batch)
+        state = low.init_state(jax.random.key(0), im)
+        state, _ = low.train_step(state, batch)  # compile+warm
+        jax.block_until_ready(state.params)
+        state = low.init_state(jax.random.key(0), im)
+        t0 = time.perf_counter()
+        for _ in range(steps):
+            state, m = low.train_step(state, batch)
+        jax.block_until_ready(state.params)
+        wall = (time.perf_counter() - t0) / steps
+        # divergence after ONE step from identical init: the same-math
+        # column. overlap_off vs on is pinned BIT-identical on the toy
+        # tier-1 configs; across full archs a barrier can shift XLA
+        # fusion boundaries (ulp-scale FMA-contraction drift — the same
+        # class the kernel tier pins at 5e-6); per_use changes the
+        # PROGRAM partitioning, so float reduction order legitimately
+        # differs. Multi-step trajectories amplify either through BN
+        # chaotically, which is why this measures one step.
+        state1 = low.init_state(jax.random.key(0), im)
+        state1, _ = low.train_step(state1, batch)
+        params1 = jax.device_get(state1.params)
+        if arm == "overlap_on":
+            ref_params = params1
+            diff = 0.0
+        else:
+            diff = max(
+                float(np.abs(np.asarray(a, np.float32)
+                             - np.asarray(b, np.float32)).max())
+                for a, b in zip(
+                    jax.tree_util.tree_leaves(ref_params),
+                    jax.tree_util.tree_leaves(params1),
+                )
+            )
+        out["arms"][arm] = {
+            "data_all_gathers": gathers,
+            "total_collectives": total,
+            "step_ms": round(wall * 1e3, 2),
+            "max_param_diff_vs_overlap_on_1step": diff,
+        }
+        print(
+            f"  {name:<16}{arm:<13} AG@data {gathers:>4}  "
+            f"collectives {total:>4}  step {wall * 1e3:8.1f} ms  "
+            f"|Δparam@1step| {diff:.2e}"
+        )
+    config.reset_cfg()
+    return out
+
+
+def zero_ab(steps: int, json_out: str | None) -> None:
+    import json
+
+    devices = jax.devices()
+    print(
+        f"# ZeRO schedule A/B on {len(devices)} × "
+        f"{devices[0].device_kind} (platform {devices[0].platform})"
+    )
+    if len(devices) < 8:
+        raise SystemExit(
+            f"--zero-ab wants the 8-device mesh the committed census uses "
+            f"(have {len(devices)}): run under JAX_PLATFORMS=cpu "
+            "XLA_FLAGS=--xla_force_host_platform_device_count=8"
+        )
+    cases = {}
+    for name, stanza, arch in ZERO_AB_CASES:
+        cases[name] = _zero_ab_case(name, stanza, arch, steps)
+    doc = {
+        "bench": "zero_overlap_ab",
+        "note": (
+            "CPU container: the all-gather census and the bit-identity "
+            "column are the provable halves of the A/B (the schedule); "
+            "step_ms on a time-shared 1-core host does not measure "
+            "latency hiding — wall-clock overlap needs TPU hardware "
+            "(PERF.md 'Hiding ZeRO collectives')."
+        ),
+        "zero_overlap": {
+            "devices": len(devices),
+            "platform": devices[0].platform,
+            "steps": steps,
+            "cases": cases,
+        },
+    }
+    if json_out:
+        with open(json_out, "w") as f:
+            json.dump(doc, f, indent=1)
+        print(f"# -> {json_out}")
+    print("# done")
+
+
 def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--min-mb", type=float, default=0.001)
     ap.add_argument("--max-mb", type=float, default=64.0)
     ap.add_argument("--iters", type=int, default=20)
     ap.add_argument("--ops", default="", help="comma-separated subset to run")
+    ap.add_argument("--zero-ab", action="store_true",
+                    help="A/B the ZeRO collective schedule instead "
+                         "(gather-once overlap on/off vs per-use)")
+    ap.add_argument("--steps", type=int, default=3,
+                    help="--zero-ab: measured steps per arm")
+    ap.add_argument("--json-out", default=None, metavar="OUT.json",
+                    help="--zero-ab: write the A/B matrix here")
     args = ap.parse_args()
+    if args.zero_ab:
+        zero_ab(args.steps, args.json_out)
+        return
 
     devices = jax.devices()
     n = len(devices)

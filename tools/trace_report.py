@@ -45,9 +45,9 @@ TRACE_DIR = "/tmp/r50_trace"
 # (trainer phases + parallel/{zero,tp,pp} collectives): an op whose
 # op_name path contains one is rolled up under it in the scopes table
 ATTRIBUTION_SCOPES = (
-    "zero_reduce_scatter", "zero_rest_layout", "tp_constrain",
-    "pp_stage", "pp_hop", "pp_gather_out", "optimizer_update",
-    "eval_fwd", "fwd",
+    "zero_gather_once", "zero_reduce_scatter", "zero_rest_layout",
+    "tp_constrain", "pp_stage", "pp_hop", "pp_gather_out",
+    "optimizer_update", "eval_fwd", "fwd",
 )
 
 
@@ -156,13 +156,86 @@ def scope_of(op_name: str) -> str | None:
     return None
 
 
+def _interval_union(intervals):
+    """Total measure + merged list of a set of (start, end) intervals."""
+    merged = []
+    for s, e in sorted(intervals):
+        if merged and s <= merged[-1][1]:
+            merged[-1][1] = max(merged[-1][1], e)
+        else:
+            merged.append([s, e])
+    return sum(e - s for s, e in merged), merged
+
+
+def overlap_fraction(events) -> dict | None:
+    """Compute↔collective overlap of the ZeRO schedule, from event
+    INTERVALS (``start_ns`` + ``dur_ns``; events without a start are
+    ignored — older fixtures keep summarizing without this section).
+
+    Collective time is the union of spans whose op_name carries a
+    ``zero_*`` attribution scope (the gather-once entry gathers, the
+    backward reduce-scatters, the rest-layout re-gathers — exactly the
+    spans the partition lowering names); compute time is the union of
+    every other busy span (async-DMA and step envelopes excluded, same
+    rule as the category table). ``fraction`` = overlapped measure /
+    collective measure: 0 means every ZeRO collective ran with all
+    compute lanes idle (fully exposed latency), 1 means every collective
+    nanosecond was hidden under concurrent compute — the ZERO.OVERLAP
+    acceptance artifact (ISSUE 15)."""
+    comm, comp = [], []
+    for ev in events:
+        start = ev.get("start_ns")
+        dur = float(ev.get("dur_ns", 0.0))
+        if start is None or dur <= 0:
+            continue
+        line = str(ev.get("line", ""))
+        if "step" in line.lower():
+            continue
+        name = str(ev.get("name", ""))
+        op_name = str(ev.get("op_name", ""))
+        kind = classify_event(line, name, op_name)[1]
+        if kind in ("async-dma", "step-envelope"):
+            continue
+        scope = scope_of(op_name) or ""
+        iv = (float(start), float(start) + dur)
+        if scope.startswith("zero_"):
+            comm.append(iv)
+        else:
+            comp.append(iv)
+    if not comm:
+        return None
+    comm_ns, comm_merged = _interval_union(comm)
+    comp_ns, comp_merged = _interval_union(comp)
+    # intersection of the two merged unions (two-pointer sweep)
+    overlapped = 0.0
+    i = j = 0
+    while i < len(comm_merged) and j < len(comp_merged):
+        s = max(comm_merged[i][0], comp_merged[j][0])
+        e = min(comm_merged[i][1], comp_merged[j][1])
+        if s < e:
+            overlapped += e - s
+        if comm_merged[i][1] <= comp_merged[j][1]:
+            i += 1
+        else:
+            j += 1
+    return {
+        "zero_collective_ms": round(comm_ns / 1e6, 4),
+        "compute_ms": round(comp_ns / 1e6, 4),
+        "overlapped_ms": round(overlapped / 1e6, 4),
+        "fraction": round(overlapped / comm_ns, 4) if comm_ns else 0.0,
+    }
+
+
 def summarize_events(events, steps: int, top: int = 25) -> dict:
     """Pure summary of one plane's events (each
-    ``{"line", "name", "op_name", "bytes", "dur_ns"}``): per-line
-    totals, per-(pass, kind) category times/bytes, per-scope rollup
-    (named_scope attribution), and the top compute ops — everything the
-    printed report and --json-out contain. ``steps`` normalizes to
-    per-step."""
+    ``{"line", "name", "op_name", "bytes", "dur_ns"}`` + optional
+    ``start_ns`` for the overlap rollup): per-line totals,
+    per-(pass, kind) category times/bytes, per-scope rollup
+    (named_scope attribution), the compute↔zero-collective overlap
+    fraction (:func:`overlap_fraction` — present only when events carry
+    start stamps and a ``zero_*`` scope appears), and the top compute
+    ops — everything the printed report and --json-out contain.
+    ``steps`` normalizes to per-step."""
     steps = max(1, int(steps))
     cat_ns: collections.Counter = collections.Counter()
     cat_bytes: collections.Counter = collections.Counter()
@@ -191,8 +264,10 @@ def summarize_events(events, steps: int, top: int = 25) -> dict:
             if scope is not None:
                 scope_ns[(key[0], scope)] += dur
     ms = 1e6 * steps  # ns totals -> ms/step
+    overlap = overlap_fraction(events)
     return {
         "steps": steps,
+        **({"overlap": overlap} if overlap is not None else {}),
         "busy_ms_per_step": round(total_ns / ms, 3),
         "lines": {
             ln: round(v / ms, 3)
@@ -261,6 +336,10 @@ def xplane_planes(path: str):
                     "name": md.name,
                     "op_name": op_name,
                     "bytes": bytes_acc,
+                    # line timestamp anchors events of different lines on
+                    # one timebase — the overlap rollup intersects
+                    # intervals ACROSS hardware queues
+                    "start_ns": line.timestamp_ns + ev.offset_ps / 1e3,
                     "dur_ns": ev.duration_ps / 1e3,
                 })
         yield plane.name, events
@@ -273,6 +352,13 @@ def print_summary(plane_name: str, summary: dict, top: int) -> None:
         print(f"  line {ln!r}: {v:.2f} ms/step")
     print(f"  busy (non-async, non-envelope): "
           f"{summary['busy_ms_per_step']:.2f} ms/step over {steps} steps")
+    if "overlap" in summary:
+        ov = summary["overlap"]
+        print(
+            f"  zero-collective overlap: {ov['overlapped_ms']:.3f} of "
+            f"{ov['zero_collective_ms']:.3f} ms under concurrent compute "
+            f"= fraction {ov['fraction']:.3f}"
+        )
     for row in summary["categories"]:
         print(
             f"  {row['pass']:>3s} {row['kind']:<13s} "
