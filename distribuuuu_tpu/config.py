@@ -744,6 +744,13 @@ _C.SERVE.DEVICE = 0
 _C.SERVE.HOST = "127.0.0.1"
 _C.SERVE.PORT = 8765
 
+# Weight-only serving quantization (serve/quantize.py): "" (full
+# precision), "bf16", or "int8". Repacks the weights before the AOT
+# bucket compiles — buckets, protocol, and batching are unchanged; int8
+# weights dequantize in-graph. Accuracy deltas are pinned by
+# `zoo_check.py --quantize` against per-mode tolerances.
+_C.SERVE.QUANTIZE = ""
+
 # Serving fleet (serve/fleet/, `serve_net.py --fleet N`): a shared-nothing
 # replica pool behind a router process. The router owns SERVE.HOST:PORT;
 # each replica is a full serve_net engine in its own process on an

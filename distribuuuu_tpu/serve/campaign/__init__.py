@@ -1,0 +1,20 @@
+"""Traffic-campaign plane (ISSUE 16): multi-model fleet multiplexing,
+quantized bucket variants, and trace-driven serving campaigns.
+
+``dsl``    — campaign YAML → seeded deterministic request schedule.
+``fleet``  — MultiModelFleet: one router, per-model replica pools.
+``runner`` — replay a schedule against a real fleet, alert-rule referee.
+
+``tools/serve_campaign.py`` composes all three into the committed
+SERVE_CAMPAIGN_r*.json artifact; docs/RUNBOOK.md "Running a traffic
+campaign" is the operator recipe.
+"""
+
+from distribuuuu_tpu.serve.campaign.dsl import (  # noqa: F401
+    CampaignSpec,
+    build_schedule,
+    load_campaign,
+    parse_campaign,
+    schedule_hash,
+)
+from distribuuuu_tpu.serve.campaign.runner import CampaignRunner  # noqa: F401

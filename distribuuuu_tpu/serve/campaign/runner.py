@@ -1,0 +1,298 @@
+"""Campaign runner: replay a seeded schedule against a real fleet and
+let the alert-rule engine referee every phase (ISSUE 16c).
+
+The runner is the serving-side analogue of soak.py's interval matrix:
+it OPEN-LOOP replays the schedule ``build_schedule`` produced (arrival
+times are absolute, not feedback-coupled — a saturated fleet faces the
+same offered load a healthy one does, which is what makes backpressure
+observable), samples router-derived snapshots every ``interval_s``, and
+feeds them to a FRESH ``RuleEngine`` per phase armed with the
+campaign's rules. A phase passes iff the raised alert-kind set equals
+its ``expect`` list EXACTLY — control phases must stay silent, so a
+rule that false-positives fails the campaign just as loudly as one
+that misses.
+
+The snapshots are serve-shaped (``totals.steps`` counts served
+requests; the training-plane fields are zeroed), so campaigns may arm
+only the serve-evaluable kinds in ``dsl.CAMPAIGN_RULE_KINDS``:
+p99-breach, backpressure, slo-breach, degrade-spill, recompile-storm.
+
+``rolling_update`` phases trigger ``MultiModelFleet.rolling_update``
+mid-phase (at ``at_frac`` of the phase) while the schedule keeps
+arriving; the phase record pins ``logits_changed`` (a fixed probe's
+logits differ across the swap) and ``failed_during`` (the drain
+ordering promises zero).
+"""
+
+from __future__ import annotations
+
+import bisect
+import json
+import threading
+import time
+from concurrent.futures import ThreadPoolExecutor
+
+from distribuuuu_tpu.serve import protocol
+from distribuuuu_tpu.serve.campaign import dsl
+from distribuuuu_tpu.telemetry.live import SNAPSHOT_SCHEMA, AlertRule, RuleEngine
+from distribuuuu_tpu.utils.logger import get_logger
+
+_BACKOFF = ("queue_full", "draining", "no_routable_replicas")
+
+
+class CampaignRunner:
+    """Replays one ``CampaignSpec`` against a router (in-process; the
+    router→replica hops are the real framed sockets).
+
+    ``payload_for(model)`` returns one raw request payload for that
+    model (the runner wraps it in the model envelope itself). ``fleet``
+    (a MultiModelFleet) is only needed for rolling_update phases.
+    """
+
+    def __init__(self, spec: dsl.CampaignSpec, router, *, payload_for,
+                 fleet=None, max_workers: int = 32):
+        self.spec = spec
+        self.router = router
+        self.fleet = fleet
+        self._payload_for = payload_for
+        self._pool = ThreadPoolExecutor(
+            max_workers=max_workers, thread_name_prefix="campaign"
+        )
+        self._stop = threading.Event()
+        self._lock = threading.Lock()
+        self._bounds = [
+            dsl.phase_window(spec, i) for i in range(len(spec.phases))
+        ]
+        self._starts = [b[0] for b in self._bounds]
+        self._counts = [
+            {"sent": 0, "ok": 0, "busy": 0, "failed": 0, "unknown_model": 0}
+            for _ in spec.phases
+        ]
+        self._t0 = 0.0
+        self.logger = get_logger()
+
+    # -- load generation ---------------------------------------------------
+    def _phase_index(self, t: float) -> int:
+        return max(0, bisect.bisect_right(self._starts, t) - 1)
+
+    def _job(self, t: float, model: str, size: int) -> None:
+        pi = self._phase_index(t)
+        payload = self._payload_for(model)
+        frame = protocol.model_envelope(model, payload)
+        for _ in range(size):
+            if self._stop.is_set():
+                return
+            cls = "failed"
+            try:
+                resp = self.router.dispatch(frame)
+                if not resp.startswith(b'{"error"'):
+                    cls = "ok"
+                else:
+                    err = json.loads(resp).get("error")
+                    if err in _BACKOFF:
+                        cls = "busy"
+                    elif err == "unknown_model":
+                        cls = "unknown_model"
+            except Exception:  # noqa: BLE001 — load-gen must not die
+                cls = "failed"
+            with self._lock:
+                self._counts[pi]["sent"] += 1
+                self._counts[pi][cls] += 1
+
+    def _replay(self, schedule: list) -> None:
+        for t, model, size in schedule:
+            delay = self._t0 + t - time.perf_counter()
+            if delay > 0 and self._stop.wait(delay):
+                return
+            if self._stop.is_set():
+                return
+            self._pool.submit(self._job, t, model, size)
+
+    # -- refereeing --------------------------------------------------------
+    def _snapshot(self) -> dict:
+        win = self.router.window_stats(max(2.0 * self.spec.interval_s, 1.0))
+        st = self.router.stats()
+        return {
+            "schema": SNAPSHOT_SCHEMA,
+            "steps": 0,
+            "totals": {"steps": int(st.get("requests", 0))},
+            "compiles": {"count": 0},
+            "events": {"stall": 0, "nonfinite": 0},
+            "serve": {
+                "p50_ms": float(win.get("p50_ms", 0.0)),
+                "p99_ms": float(win.get("p99_ms", 0.0)),
+                "window_samples": int(win.get("samples", 0)),
+                "queue_depth": int(win.get("queue_depth", 0)),
+                "rejected": int(st.get("rejected", 0)),
+                "degraded": int(st.get("degraded", 0)),
+                "models": win.get("models", {}),
+            },
+        }
+
+    def _probe_logits(self, model: str):
+        frame = protocol.model_envelope(model, self._payload_for(model))
+        resp = self.router.dispatch(frame)
+        if resp.startswith(b'{"error"'):
+            return None
+        return json.loads(resp).get("logits")
+
+    def _run_update(self, phase: dsl.PhaseSpec, rec: dict) -> None:
+        upd = dict(phase.update or {})
+        model = upd.get("model")
+        overrides = upd.get("overrides") or {}
+        before = self._probe_logits(model)
+        failed_before = self._counts_total("failed")
+        try:
+            self.fleet.rolling_update(model, overrides, wait=True)
+            rec["ok"] = self.router.n_routable() >= 1
+        except Exception as e:  # noqa: BLE001 — scored, not fatal
+            rec["ok"] = False
+            rec["error"] = f"{type(e).__name__}: {e}"
+        after = self._probe_logits(model)
+        rec.update(
+            model=model,
+            overrides=overrides,
+            logits_changed=(
+                before is not None and after is not None and before != after
+            ),
+            failed_during=self._counts_total("failed") - failed_before,
+        )
+
+    def _counts_total(self, key: str) -> int:
+        with self._lock:
+            return sum(c[key] for c in self._counts)
+
+    # -- the campaign ------------------------------------------------------
+    def run(self) -> dict:
+        """Replay every phase; returns the campaign verdict dict that
+        SERVE_CAMPAIGN_r*.json archives."""
+        from distribuuuu_tpu.telemetry import spans
+
+        spec = self.spec
+        schedule = dsl.build_schedule(spec)
+        sched_hash = dsl.schedule_hash(schedule)
+        self.logger.info(
+            "campaign %s: %d requests over %.0fs (seed %d, hash %s)",
+            spec.name, len(schedule), spec.duration_s, spec.seed,
+            sched_hash[:12],
+        )
+        self._t0 = time.perf_counter()
+        replayer = threading.Thread(
+            target=self._replay, args=(schedule,), daemon=True,
+            name="campaign-replay",
+        )
+        replayer.start()
+
+        phases = []
+        for pi, phase in enumerate(spec.phases):
+            engine = RuleEngine(
+                [AlertRule(dict(r)) for r in spec.rules], spec.interval_s
+            )
+            raised: set = set()
+            alerts: list = []
+            degraded_at_start = int(
+                self.router.stats().get("degraded", 0)
+            )
+            update_rec: dict | None = None
+            update_thread = None
+            if phase.kind == "rolling_update":
+                update_rec = {}
+                delay = phase.at_frac * phase.duration_s
+
+                def trigger(rec=update_rec, delay=delay, ph=phase):
+                    if not self._stop.wait(delay):
+                        self._run_update(ph, rec)
+
+                update_thread = threading.Thread(
+                    target=trigger, daemon=True, name="campaign-update"
+                )
+                update_thread.start()
+
+            t_end = self._t0 + self._bounds[pi][1]
+            while not self._stop.is_set():
+                remaining = t_end - time.perf_counter()
+                if remaining <= 0:
+                    # a rolling update may outlive its phase clock (warm-up
+                    # gated respawn); keep refereeing until it lands
+                    if update_thread is None or not update_thread.is_alive():
+                        break
+                self._stop.wait(min(spec.interval_s, max(remaining, 0.05)))
+                snap = self._snapshot()
+                for alert in engine.evaluate(snap):
+                    raised.add(alert["rule"])
+                    alerts.append(alert)
+            if update_thread is not None:
+                update_thread.join(timeout=120)
+
+            snap = self._snapshot()
+            with self._lock:
+                counts = dict(self._counts[pi])
+            ok = raised == set(phase.expect)
+            if update_rec is not None:
+                ok = ok and bool(update_rec.get("ok")) and bool(
+                    update_rec.get("logits_changed")
+                )
+            rec = {
+                "name": phase.name,
+                "kind": phase.kind,
+                "duration_s": phase.duration_s,
+                "expected": sorted(phase.expect),
+                "raised": sorted(raised),
+                "ok": ok,
+                "counts": counts,
+                "degraded_delta": int(
+                    snap["serve"]["degraded"] - degraded_at_start
+                ),
+                "p99_ms_end": snap["serve"]["p99_ms"],
+                "alerts": alerts,
+            }
+            if update_rec is not None:
+                rec["update"] = update_rec
+            phases.append(rec)
+            spans.emit_event(
+                "campaign.phase",
+                campaign=spec.name,
+                phase=phase.name,
+                expected_alerts=rec["expected"],
+                raised_alerts=rec["raised"],
+                ok=rec["ok"],
+            )
+            self.logger.info(
+                "campaign %s phase %s: expected=%s raised=%s ok=%s %s",
+                spec.name, phase.name, rec["expected"], rec["raised"],
+                rec["ok"], counts,
+            )
+
+        self._stop.set()
+        replayer.join(timeout=10)
+        self._pool.shutdown(wait=True)
+
+        alerts_exact = all(p["ok"] for p in phases)
+        control_clean = all(
+            not p["raised"] for p in phases if not p["expected"]
+        )
+        models = self.router.stats().get("models", {})
+        verdict = {
+            "campaign": spec.name,
+            "seed": spec.seed,
+            "interval_s": spec.interval_s,
+            "schedule_hash": sched_hash,
+            "requests_scheduled": len(schedule),
+            "phases": phases,
+            "models": models,
+            "alerts_exact": alerts_exact,
+            "control_clean": control_clean,
+            "ok": alerts_exact and control_clean,
+        }
+        spans.emit_event(
+            "campaign.verdict",
+            campaign=spec.name,
+            phases=len(phases),
+            alerts_exact=alerts_exact,
+            control_clean=control_clean,
+            ok=verdict["ok"],
+        )
+        return verdict
+
+    def stop(self) -> None:
+        self._stop.set()

@@ -55,6 +55,7 @@ from distribuuuu_tpu.config import cfg
 from distribuuuu_tpu.serve.admission import AdmissionController
 from distribuuuu_tpu.serve.metrics import ServeMetrics
 from distribuuuu_tpu.telemetry import registry as telemetry_registry
+from distribuuuu_tpu.telemetry import spans
 
 # Compilation-count hook: every AOT bucket compile appends its batch size.
 # Steady-state serving must not grow this list (tests/test_serve.py).
@@ -105,6 +106,7 @@ class Engine:
         input_dtype=np.uint8,
         metrics: ServeMetrics | None = None,
         emit_interval_s: float = 10.0,
+        quantize: str | None = None,
     ):
         self.model = model
         self._variables = variables
@@ -130,6 +132,29 @@ class Engine:
             max_queue if max_queue is not None else cfg.SERVE.MAX_QUEUE
         )
 
+        # -- weight-only quantized variant (serve/quantize.py) ------------
+        # "" = full precision; "bf16"/"int8" repack the weights BEFORE the
+        # AOT compiles below, so every bucket executable bakes in the
+        # variant — int8 weights dequantize in-graph per forward, trading
+        # a cheap elementwise op for halved/quartered HBM weight traffic.
+        mode = quantize if quantize is not None else str(cfg.SERVE.QUANTIZE)
+        self.quantize_mode = mode
+        self.quantize_meta = None
+        if mode:
+            from distribuuuu_tpu.serve import quantize as quantize_lib
+
+            self._variables, self.quantize_meta = (
+                quantize_lib.quantize_variables(variables, mode)
+            )
+            spans.emit_event(
+                "serve.quantized",
+                arch=cfg.MODEL.ARCH,
+                mode=mode,
+                bytes_before=self.quantize_meta["bytes_before"],
+                bytes_after=self.quantize_meta["bytes_after"],
+                leaves=self.quantize_meta["leaves"],
+            )
+
         # -- AOT compile every bucket shape, exactly once, at startup -----
         self.n_compiles = 0
         self._compiled = {}
@@ -138,7 +163,7 @@ class Engine:
             sds = jax.ShapeDtypeStruct(
                 (b, self.im_size, self.im_size, 3), self.input_dtype
             )
-            self._compiled[b] = jit_fwd.lower(variables, sds).compile()
+            self._compiled[b] = jit_fwd.lower(self._variables, sds).compile()
             self.n_compiles += 1
             COMPILE_EVENTS.append(b)
         # AOT startup compiles in the shared registry (telemetry/): a
@@ -156,8 +181,12 @@ class Engine:
             from distribuuuu_tpu.telemetry import costmodel
 
             for b in self.buckets:
+                label = (
+                    f"serve_bucket_{b}_{mode}" if mode
+                    else f"serve_bucket_{b}"
+                )
                 costmodel.capture_compiled(
-                    self._compiled[b], label=f"serve_bucket_{b}",
+                    self._compiled[b], label=label,
                     phase="serve", images=b, arch=cfg.MODEL.ARCH,
                 )
 
@@ -177,6 +206,13 @@ class Engine:
 
     # -- model forward (traced once per bucket at startup) -----------------
     def _forward(self, variables, images):
+        if self.quantize_mode == "int8":
+            # in-graph dequant: int8 weights + per-channel scales expand to
+            # f32 inside the traced forward — XLA fuses the expansion into
+            # the consuming matmul/conv, so HBM reads stay int8-sized
+            from distribuuuu_tpu.serve import quantize as quantize_lib
+
+            variables = quantize_lib.dequantize_in_graph(variables)
         if images.dtype == np.uint8:
             # the DATA.DEVICE_NORMALIZE eval pipeline: host ships raw uint8,
             # normalization runs in-graph (identical formula/order to the
@@ -249,6 +285,7 @@ class Engine:
             n_compiles=self.n_compiles,
             buckets=list(self.buckets),
             max_batch=self.max_batch,
+            quantize=self.quantize_mode,
         )
         return out
 
@@ -367,4 +404,5 @@ def engine_from_cfg() -> Engine:
         variables,
         cfg.TRAIN.IM_SIZE,
         input_dtype=np.uint8 if cfg.DATA.DEVICE_NORMALIZE else np.float32,
+        quantize=str(cfg.SERVE.QUANTIZE),
     )

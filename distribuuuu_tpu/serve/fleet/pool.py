@@ -145,9 +145,13 @@ class PoolManager:
         health_period_s: float = 1.0,
         health_fails: int = 3,
         probe_timeout_s: float = 5.0,
+        model: str = "",
     ):
         self.router = router
         self._spawn = spawn
+        # model id this pool's replicas serve ("" = single-model fleet);
+        # tags every add_replica so the router can model-filter _pick
+        self.model = str(model)
         if probe is probe_stats:
             # the default probe gets the pool's timeout (a loaded 1-core
             # replica can sit on the GIL past a short probe window —
@@ -174,7 +178,7 @@ class PoolManager:
         """Spawn one replica and (optionally) block until it is warm and
         routable. Returns the router's Replica record."""
         port = free_port(self.host)
-        rep = self.router.add_replica(self.host, port)
+        rep = self.router.add_replica(self.host, port, model=self.model)
         handle = self._spawn(rep.id, port)
         rep.proc = handle
         self.logger.info(
@@ -261,16 +265,27 @@ class PoolManager:
     def _wait_routable(self, n: int) -> bool:
         deadline = time.perf_counter() + self.warmup_timeout_s
         while time.perf_counter() < deadline and not self._stop.is_set():
-            if self.router.n_routable() >= n:
+            if self._n_routable() >= n:
                 return True
             time.sleep(0.1)
-        return self.router.n_routable() >= n
+        return self._n_routable() >= n
+
+    def _n_routable(self) -> int:
+        return sum(1 for r in self._own() if r.routable)
+
+    def _own(self) -> list:
+        """THIS pool's replicas. The router is shared across pools in a
+        multi-model fleet (fleet/campaign), so every lifecycle decision —
+        target counting, warm-up waits, health, shutdown — must filter
+        by the pool's model tag or pools start managing (and refusing to
+        spawn against) each other's replicas."""
+        return [r for r in self.router.replicas() if r.model == self.model]
 
     def _members(self) -> list:
         """Replicas that count toward the target: routable or warming —
         not the ones already draining out."""
         return [
-            r for r in self.router.replicas()
+            r for r in self._own()
             if not r.draining and r.id not in self._draining
         ]
 
@@ -354,7 +369,7 @@ class PoolManager:
         still WARMING are ``_wait_warm``'s to judge (it has the generous
         compile-time budget) — probing them here would kill every fresh
         replica before its first bucket compiles."""
-        for rep in self.router.replicas():
+        for rep in self._own():
             if rep.draining or rep.id in self._draining or not rep.warmed:
                 continue
             if rep.proc is not None and rep.proc.poll() is not None:
@@ -402,16 +417,18 @@ class PoolManager:
 
     # -- shutdown ----------------------------------------------------------
     def shutdown(self, timeout: float = 60.0) -> None:
-        """Drain every replica (SIGTERM chain) and stop supervision."""
+        """Drain every replica of THIS pool (SIGTERM chain) and stop
+        supervision; other pools' replicas on the shared router are
+        theirs to drain."""
         self._stop.set()
         if self._supervisor is not None:
             self._supervisor.join(timeout=self.health_period_s + 5)
-        for rep in self.router.replicas():
+        for rep in self._own():
             self.drain_stop(rep.id, wait=False, timeout=timeout)
         deadline = time.perf_counter() + timeout
-        while time.perf_counter() < deadline and self.router.replicas():
+        while time.perf_counter() < deadline and self._own():
             time.sleep(0.05)
-        for rep in self.router.replicas():  # anything that refused to die
+        for rep in self._own():  # anything that refused to die
             if rep.proc is not None:
                 try:
                     rep.proc.kill()

@@ -99,6 +99,7 @@ class Replica:
     host: str
     port: int
     proc: object = None            # pool-owned process handle (or None)
+    model: str = ""                # model id this replica serves ("": sole model)
     routable: bool = False
     warmed: bool = False           # warm-up completed at least once
     warm_jit_compiles: int = 0     # jit.compiles baseline at warm-up
@@ -188,18 +189,61 @@ class Router:
         self._recent: list[tuple[float, float]] = []
         self._recent_cap = recent_window
         self._t0 = time.perf_counter()
+        # multi-model multiplexing (serve/campaign): model id -> SLO class
+        # record, and per-model routing stats. Empty for single-model
+        # fleets — bare (non-enveloped) payloads never consult either.
+        self._models: dict[str, dict] = {}
+        self._mstats: dict[str, dict] = {}
+
+    # -- model registry (multi-model fleets) -------------------------------
+    @staticmethod
+    def _fresh_mstat() -> dict:
+        return {"requests": 0, "rejected": 0, "degraded_out": 0,
+                "degraded_in": 0, "recent": []}
+
+    def register_model(self, name: str, *, slo_class: str = "standard",
+                       p99_slo_ms: float | None = None,
+                       overflow_to: str | None = None) -> None:
+        """Declare a model id and its SLO class. ``overflow_to`` names the
+        cheaper model that absorbs this model's traffic when every one of
+        its replicas is saturated — the degrade-under-pressure path
+        (counted, never silent)."""
+        with self._lock:
+            self._models[name] = {
+                "slo_class": str(slo_class),
+                "p99_slo_ms": None if p99_slo_ms is None else float(p99_slo_ms),
+                "overflow_to": overflow_to,
+            }
+            self._mstats.setdefault(name, self._fresh_mstat())
+
+    def registered_models(self) -> list[str]:
+        """Every routable model id: registered ones plus any a replica was
+        tagged with (the wrong-model-id error lists these)."""
+        with self._lock:
+            names = set(self._models)
+            names.update(
+                r.model for r in self._replicas.values() if r.model
+            )
+            return sorted(names)
 
     # -- replica membership (pool-driven) ---------------------------------
     def add_replica(self, host: str, port: int, *, proc=None,
-                    replica_id: int | None = None) -> Replica:
+                    replica_id: int | None = None,
+                    model: str = "") -> Replica:
         """Register a replica in the NOT-routable (warming) state — the
         pool flips it routable only after the warm-up probe confirms every
-        bucket shape is compiled."""
+        bucket shape is compiled. ``model`` tags the replica for model-id
+        routing (multi-model fleets); untagged replicas serve bare
+        payloads exactly as before."""
         with self._lock:
             rid = self._next_id if replica_id is None else int(replica_id)
             self._next_id = max(self._next_id, rid + 1)
-            rep = Replica(id=rid, host=host, port=int(port), proc=proc)
+            rep = Replica(
+                id=rid, host=host, port=int(port), proc=proc, model=model
+            )
             self._replicas[rid] = rep
+            if model:
+                self._mstats.setdefault(model, self._fresh_mstat())
             return rep
 
     def mark_routable(self, rid: int) -> None:
@@ -237,11 +281,17 @@ class Router:
             )
 
     # -- dispatch ----------------------------------------------------------
-    def _pick(self, exclude: set[int]) -> Replica | None:
+    def _pick(self, exclude: set[int],
+              model: str | None = None) -> Replica | None:
+        """Least-loaded routable replica outside ``exclude``; with
+        ``model``, only replicas tagged with that model id count."""
         with self._lock:
             reps = list(self._replicas.values())
             snaps = [
-                (r.snapshot() if r.id not in exclude else None) for r in reps
+                (r.snapshot()
+                 if r.id not in exclude
+                 and (model is None or r.model == model) else None)
+                for r in reps
             ]
             self._rr += 1
             idx = pick_replica(snaps, rr=self._rr)
@@ -255,7 +305,8 @@ class Router:
         rep.close_conns()
         self.registry.counter("fleet.replica_failures").inc(1)
 
-    def _observe(self, rep: Replica, lat_s: float) -> None:
+    def _observe(self, rep: Replica, lat_s: float,
+                 model: str | None = None) -> None:
         now = time.perf_counter()
         with self._lock:
             rep.requests += 1
@@ -267,26 +318,32 @@ class Router:
             self._recent.append((now, lat_s))
             if len(self._recent) > self._recent_cap:
                 del self._recent[: self._recent_cap // 4]
+            if model:
+                ms = self._mstats.setdefault(model, self._fresh_mstat())
+                ms["requests"] += 1
+                ms["recent"].append((now, lat_s))
+                if len(ms["recent"]) > self._recent_cap:
+                    del ms["recent"][: self._recent_cap // 4]
         self._lat.observe(lat_s)
         self.registry.histogram(f"fleet.replica{rep.id}.latency_s").observe(
             lat_s
         )
         self.registry.counter("fleet.requests").inc(1)
 
-    def dispatch(self, payload: bytes) -> bytes:
-        """Route one request payload; returns the response payload.
-
-        Transport failures reroute (idempotent requests); fleet-wide
-        saturation returns the last replica's retry-after rejection
-        VERBATIM; a fleet with nothing routable returns a router-level
-        error record in the same JSON shape."""
-        t0 = time.perf_counter()
+    def _try_dispatch(
+        self, payload: bytes, model: str | None, t0: float
+    ) -> tuple[bytes | None, bytes | None]:
+        """The retry loop over one model's (or, with None, every)
+        replica set: ``(response, last_busy)``. ``response`` is None when
+        every candidate was busy, failed, or unroutable — the caller
+        decides between overflow, verbatim rejection, and the router
+        error."""
         tried: set[int] = set()
         last_busy: bytes | None = None
         while True:
-            rep = self._pick(tried)
+            rep = self._pick(tried, model=model)
             if rep is None:
-                break
+                return None, last_busy
             with self._lock:
                 rep.inflight += 1
             try:
@@ -310,22 +367,89 @@ class Router:
                     last_busy = resp
                     tried.add(rep.id)
                     continue
-            self._observe(rep, time.perf_counter() - t0)
+            self._observe(rep, time.perf_counter() - t0, model=model)
+            return resp, last_busy
+
+    def _count_rejected(self, model: str | None) -> None:
+        self.registry.counter("fleet.rejected").inc(1)
+        if model:
+            with self._lock:
+                self._mstats.setdefault(
+                    model, self._fresh_mstat()
+                )["rejected"] += 1
+
+    def dispatch(self, payload: bytes) -> bytes:
+        """Route one request payload; returns the response payload.
+
+        Model-enveloped payloads (protocol.model_envelope) route only to
+        replicas tagged with that model id — an unknown id is refused
+        with the registered-model list; when EVERY replica of a model
+        with a configured ``overflow_to`` is saturated, the stripped
+        payload spills to the cheap model instead of being rejected
+        (counted as degraded, per model). Bare payloads keep the
+        single-model semantics exactly.
+
+        Transport failures reroute (idempotent requests); fleet-wide
+        saturation returns the last replica's retry-after rejection
+        VERBATIM; a fleet with nothing routable returns a router-level
+        error record in the same JSON shape."""
+        t0 = time.perf_counter()
+        model, inner = protocol.split_model_envelope(payload)
+        if model is not None:
+            known = self.registered_models()
+            if model not in known:
+                self.registry.counter("fleet.unknown_model").inc(1)
+                return json.dumps({
+                    "error": "unknown_model",
+                    "model": model,
+                    "models": known,
+                }).encode()
+        resp, last_busy = self._try_dispatch(inner, model, t0)
+        if resp is not None:
             return resp
+        if model is not None:
+            with self._lock:
+                mrec = self._models.get(model)
+                spill = mrec.get("overflow_to") if mrec else None
+            if spill:
+                resp, spill_busy = self._try_dispatch(inner, spill, t0)
+                if resp is not None:
+                    # the cheap model absorbed the overflow: a degraded
+                    # answer beats a rejected one, and both sides count it
+                    self.registry.counter("fleet.degraded").inc(1)
+                    with self._lock:
+                        self._mstats.setdefault(
+                            model, self._fresh_mstat()
+                        )["degraded_out"] += 1
+                        self._mstats.setdefault(
+                            spill, self._fresh_mstat()
+                        )["degraded_in"] += 1
+                    return resp
+                last_busy = spill_busy or last_busy
         if last_busy is not None:
-            self.registry.counter("fleet.rejected").inc(1)
+            self._count_rejected(model)
             return last_busy
         self.registry.counter("fleet.unroutable").inc(1)
+        if model is not None:
+            with self._lock:
+                self._mstats.setdefault(
+                    model, self._fresh_mstat()
+                )["rejected"] += 1
         return json.dumps(
             {"error": "no_routable_replicas", "retry_after_ms": 1000.0}
         ).encode()
 
-    def dispatch_stream(self, payload: bytes, client: socket.socket) -> None:
+    def dispatch_stream(self, payload: bytes, client: socket.socket,
+                        model: str | None = None) -> None:
         """Route one STREAMING request (the LM ``op="generate"`` ctrl
         frame, lm/service.py): pick a replica exactly like ``dispatch``,
         then relay its whole frame sequence — token frames as they decode,
         the done frame last — straight to the client. Tokens stream
-        through the router; nothing buffers.
+        through the router; nothing buffers. A generate ctrl frame may
+        carry ``"model"``: the stream then routes only to that model's
+        replicas (unknown ids are refused with the registered list; no
+        overflow — a stream is not idempotently spillable once committed
+        to a model's weights).
 
         Retry semantics are necessarily narrower than ``dispatch``'s: a
         transport failure BEFORE the first frame reroutes (nothing
@@ -334,10 +458,18 @@ class Router:
         prefix would emit duplicate tokens). Busy rejections pass through
         verbatim when every replica rejects, the admission contract."""
         t0 = time.perf_counter()
+        if model is not None and model not in self.registered_models():
+            self.registry.counter("fleet.unknown_model").inc(1)
+            protocol.send_frame(client, json.dumps({
+                "error": "unknown_model",
+                "model": model,
+                "models": self.registered_models(),
+            }).encode())
+            return
         tried: set[int] = set()
         last_busy: bytes | None = None
         while True:
-            rep = self._pick(tried)
+            rep = self._pick(tried, model=model)
             if rep is None:
                 break
             with self._lock:
@@ -376,7 +508,9 @@ class Router:
                         # frame: the client unblocks the moment it reads
                         # "done", and an after-the-send increment races
                         # anything that checks the counters then
-                        self._observe(rep, time.perf_counter() - t0)
+                        self._observe(
+                            rep, time.perf_counter() - t0, model=model
+                        )
                         self.registry.counter("fleet.streams").inc(1)
                     protocol.send_frame(client, frame)
                     streamed += 1
@@ -408,7 +542,7 @@ class Router:
                 if conn is not None:
                     conn.close()
         if last_busy is not None:
-            self.registry.counter("fleet.rejected").inc(1)
+            self._count_rejected(model)
             protocol.send_frame(client, last_busy)
             return
         self.registry.counter("fleet.unroutable").inc(1)
@@ -428,13 +562,29 @@ class Router:
                 for r in self._replicas.values()
                 if r.routable and not r.draining
             )
-        return {
+            models = {}
+            for name, ms in self._mstats.items():
+                mlats = sorted(
+                    lat for (t, lat) in ms["recent"] if t >= cut
+                )
+                mrec = self._models.get(name) or {}
+                models[name] = {
+                    "samples": len(mlats),
+                    "p99_ms": round(percentile(mlats, 0.99) * 1e3, 3),
+                    "target_ms": mrec.get("p99_slo_ms"),
+                }
+        out = {
             "samples": len(lats),
             "p50_ms": round(percentile(lats, 0.50) * 1e3, 3),
             "p90_ms": round(percentile(lats, 0.90) * 1e3, 3),
             "p99_ms": round(percentile(lats, 0.99) * 1e3, 3),
             "queue_depth": queue_depth,
         }
+        if models:
+            # per-model windowed p99 against its SLO target — what the
+            # slo-breach rule reads (telemetry/live.py)
+            out["models"] = models
+        return out
 
     def _counter(self, name: str) -> int:
         return int(self.registry.counter(name).value)
@@ -459,17 +609,39 @@ class Router:
                 "jit_compiles": int(r.stats.get("jit_compiles", 0)),
                 "warm_jit_compiles": r.warm_jit_compiles,
                 "aot_compiles": int(r.stats.get("aot_compiles", 0)),
+                "model": r.model,
             }
             for r in reps
         ]
+        with self._lock:
+            names = set(self._models)
+            names.update(r.model for r in reps if r.model)
+            models = {}
+            for name in sorted(names):
+                mrec = self._models.get(name) or {}
+                ms = self._mstats.get(name) or self._fresh_mstat()
+                mlats = [lat for (_t, lat) in ms["recent"]]
+                models[name] = {
+                    "slo_class": mrec.get("slo_class", "standard"),
+                    "p99_slo_ms": mrec.get("p99_slo_ms"),
+                    "overflow_to": mrec.get("overflow_to"),
+                    "replicas": sum(1 for r in reps if r.model == name),
+                    "requests": ms["requests"],
+                    "rejected": ms["rejected"],
+                    "degraded_out": ms["degraded_out"],
+                    "degraded_in": ms["degraded_in"],
+                    "p99_ms": round(percentile(mlats, 0.99) * 1e3, 3),
+                }
         window = max(time.perf_counter() - self._t0, 1e-9)
-        return {
+        out = {
             "replicas": len(reps),
             "routable": sum(1 for p in per_replica if p["routable"]),
             "requests": self._counter("fleet.requests"),
             "rejected": self._counter("fleet.rejected"),
             "rerouted": self._counter("fleet.rerouted"),
             "unroutable": self._counter("fleet.unroutable"),
+            "degraded": self._counter("fleet.degraded"),
+            "unknown_model": self._counter("fleet.unknown_model"),
             "replica_failures": self._counter("fleet.replica_failures"),
             "throughput_rps": round(
                 self._counter("fleet.requests") / window, 2
@@ -479,17 +651,32 @@ class Router:
             "p99_ms": round(percentile(lat, 0.99) * 1e3, 3),
             "per_replica": per_replica,
         }
+        if models:
+            out["models"] = models
+        return out
 
     def emit_telemetry(self) -> None:
-        """One ``fleet.stats`` + one ``fleet.replica`` per replica into the
-        per-rank telemetry sink (no-op until setup_telemetry ran)."""
+        """One ``fleet.stats`` + one ``fleet.replica`` per replica (plus one
+        ``fleet.model_route`` per registered model on multi-model fleets)
+        into the per-rank telemetry sink (no-op until setup_telemetry ran)."""
         from distribuuuu_tpu.telemetry import spans
 
         snap = self.stats()
         per_replica = snap.pop("per_replica")
+        models = snap.pop("models", {})
         spans.emit_event("fleet.stats", **snap)
         for p in per_replica:
             spans.emit_event("fleet.replica", **p)
+        for name, m in models.items():
+            spans.emit_event(
+                "fleet.model_route",
+                model=name,
+                requests=m["requests"],
+                rejected=m["rejected"],
+                degraded_in=m["degraded_in"],
+                degraded_out=m["degraded_out"],
+                p99_ms=m["p99_ms"],
+            )
 
     # -- the client-facing accept loop ------------------------------------
     def _handle_conn(self, conn: socket.socket) -> None:
@@ -510,7 +697,9 @@ class Router:
                         # streaming passthrough: the replica's whole frame
                         # sequence relays on this client connection
                         try:
-                            self.dispatch_stream(payload, conn)
+                            self.dispatch_stream(
+                                payload, conn, model=ctrl.get("model")
+                            )
                         except OSError:
                             return
                         continue

@@ -52,6 +52,15 @@ MAX_FRAME = 64 << 20  # refuse absurd frames before allocating for them
 # occur in any image or .npy payload, so detection is unambiguous.
 CTRL_MAGIC = b"\x00DTPUCTL1"
 
+# Model-id envelope (serve/campaign, multi-model fleets): magic, a 1-byte
+# model-id length, the utf-8 model id, then the ORIGINAL request payload
+# unchanged. Shares the NUL lead byte with control frames (unambiguous vs
+# image payloads) but differs from CTRL_MAGIC at byte 5, so parse_ctrl
+# rejects it and bare payloads keep their existing single-model meaning.
+# The router strips the envelope before forwarding — replicas serve the
+# same bytes they always did.
+MODEL_MAGIC = b"\x00DTPUMDL1"
+
 
 def ctrl_request(op: str, **fields) -> bytes:
     """Encode a control request payload (send it with ``send_frame``)."""
@@ -63,6 +72,27 @@ def parse_ctrl(payload: bytes) -> dict | None:
     if not payload.startswith(CTRL_MAGIC):
         return None
     return json.loads(payload[len(CTRL_MAGIC):])
+
+
+def model_envelope(model: str, payload: bytes) -> bytes:
+    """Wrap a request payload with the model id it must route to."""
+    mid = model.encode("utf-8")
+    if not 0 < len(mid) < 256:
+        raise ValueError(f"model id must be 1..255 utf-8 bytes, got {model!r}")
+    return MODEL_MAGIC + bytes([len(mid)]) + mid + payload
+
+
+def split_model_envelope(payload: bytes) -> tuple[str | None, bytes]:
+    """(model_id, inner_payload) for an enveloped payload; (None, payload)
+    for a bare one — single-model clients never change."""
+    if not payload.startswith(MODEL_MAGIC):
+        return None, payload
+    n = payload[len(MODEL_MAGIC)]
+    start = len(MODEL_MAGIC) + 1
+    mid = payload[start:start + n]
+    if len(mid) != n:
+        raise ValueError("truncated model envelope")
+    return mid.decode("utf-8"), payload[start + n:]
 
 
 def replica_stats(engine) -> dict:
@@ -165,6 +195,20 @@ def _handle_conn(engine, conn: socket.socket, transform, topk: int) -> None:
                 return
             if payload is None:
                 return
+            if payload.startswith(MODEL_MAGIC):
+                # a fleet router already routed this here; a direct client
+                # may also send enveloped requests — either way the replica
+                # serves the inner payload (it IS the model)
+                try:
+                    _model, payload = split_model_envelope(payload)
+                except (ValueError, IndexError):
+                    try:
+                        send_frame(conn, json.dumps(
+                            {"error": "bad_model_envelope"}
+                        ).encode())
+                    except OSError:
+                        return
+                    continue
             ctrl = parse_ctrl(payload) if payload.startswith(CTRL_MAGIC[:1]) else None
             if ctrl is not None:
                 if ctrl.get("op") == "stats":

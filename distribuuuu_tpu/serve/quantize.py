@@ -1,0 +1,147 @@
+"""Weight-only quantized serving variants (ISSUE 16, tentpole part b).
+
+Serving on TPU/CPU is memory-bound at small batch (the decode-attention
+roofline argument of ISSUE 13 applies to the image engine too: bucket-1
+latency is dominated by streaming weights, not FLOPs), so the cheapest
+latency/capacity lever is shrinking the weights the executable streams:
+
+* ``bf16`` — every float weight leaf is cast to ``bfloat16`` at rest
+  (half the bytes). JAX's type promotion runs the matmuls against the
+  f32 activations in f32, so this is WEIGHT-ONLY quantization: the
+  compute dtype and the engine protocol are unchanged.
+* ``int8`` — 2D+ float leaves (conv kernels HWIO, dense ``(in, out)``)
+  are stored as symmetric per-output-channel int8 with an f32 scale
+  (4x smaller at rest) and dequantized IN-GRAPH
+  (``dequantize_in_graph``), inside the same AOT-compiled bucket
+  executable. Small leaves (biases, BN stats/params) stay f32 — they
+  are noise in the byte budget and poison accuracy cheaply.
+
+The accuracy referee is ``tools/zoo_check.py --quantize MODE``: served
+logits of the quantized variant must stay within ``TOLERANCE[mode]``
+relative error of the f32 forward (tests/test_campaign.py pins the same
+bound in the fast tier on toy shapes). The serving engine
+(serve/engine.py ``quantize=``) emits one ``kind="serve.quantized"``
+record with the measured byte shrink; the per-(model, dtype) latency
+frontier lands in SERVE_CAMPAIGN_r*.json and PERF.md.
+"""
+
+from __future__ import annotations
+
+from typing import Mapping
+
+import jax.numpy as jnp
+import numpy as np
+
+MODES = ("bf16", "int8")
+
+# relative logits tolerance per mode: max|logits_q - logits_f32| over
+# max|logits_f32| (the zoo_check --quantize gate and the test-tier pin).
+# bf16 keeps ~8 mantissa bits (~0.4% per op, accumulating over depth);
+# int8 per-channel weight-only lands low-single-digit percent on the zoo.
+TOLERANCE = {"bf16": 0.02, "int8": 0.08}
+
+# leaves smaller than this stay f32 under int8 (biases, BN) — they don't
+# pay for their scale metadata and BN stats are accuracy-critical
+MIN_INT8_SIZE = 256
+
+_Q = "q8"            # quantized payload key
+_SCALE = "q8_scale"  # per-output-channel scale key
+
+
+def _is_q8(node) -> bool:
+    return isinstance(node, Mapping) and set(node.keys()) == {_Q, _SCALE}
+
+
+def _quantize_leaf_int8(x: np.ndarray) -> dict:
+    """Symmetric per-output-channel (last axis) int8: conv kernels are
+    HWIO and dense kernels (in, out), so the last axis is the output
+    channel for every weight shape the zoo ships."""
+    absmax = np.max(np.abs(x), axis=tuple(range(x.ndim - 1)), keepdims=True)
+    scale = (absmax / 127.0).astype(np.float32)
+    scale = np.where(scale == 0.0, np.float32(1.0), scale)
+    q = np.clip(np.round(x / scale), -127, 127).astype(np.int8)
+    return {_Q: q, _SCALE: scale}
+
+
+def quantize_variables(variables, mode: str) -> tuple[dict, dict]:
+    """Return ``(packed, meta)``: the variables tree with weight leaves
+    replaced by their quantized form, plus the byte-accounting meta dict
+    ``{mode, bytes_before, bytes_after, leaves, quantized_leaves}``.
+
+    ``packed`` feeds ``model.apply`` only after
+    ``dequantize_in_graph`` (int8) — bf16 leaves apply directly (JAX
+    promotion computes in f32 against f32 activations).
+    """
+    if mode not in MODES:
+        raise ValueError(
+            f"SERVE.QUANTIZE must be one of {MODES} (or empty), got {mode!r}"
+        )
+    meta = {"mode": mode, "bytes_before": 0, "bytes_after": 0,
+            "leaves": 0, "quantized_leaves": 0}
+
+    def walk(node):
+        if isinstance(node, Mapping):
+            return {k: walk(v) for k, v in node.items()}
+        x = np.asarray(node)
+        meta["leaves"] += 1
+        meta["bytes_before"] += x.nbytes
+        if not np.issubdtype(x.dtype, np.floating):
+            meta["bytes_after"] += x.nbytes
+            return x
+        if mode == "bf16":
+            meta["quantized_leaves"] += 1
+            out = jnp.asarray(x).astype(jnp.bfloat16)
+            meta["bytes_after"] += x.nbytes // 2
+            return out
+        if x.ndim >= 2 and x.size >= MIN_INT8_SIZE:
+            packed = _quantize_leaf_int8(x.astype(np.float32))
+            meta["quantized_leaves"] += 1
+            meta["bytes_after"] += (
+                packed[_Q].nbytes + packed[_SCALE].nbytes
+            )
+            return packed
+        meta["bytes_after"] += x.nbytes
+        return x
+
+    return walk(variables), meta
+
+
+def dequantize_in_graph(packed):
+    """Rebuild an apply-able variables tree from ``quantize_variables``
+    output. Traceable — the serving engine calls this INSIDE its jitted
+    forward, so the AOT bucket executables take int8 weights as inputs
+    and pay the dequant once per batch on-device."""
+
+    def walk(node):
+        if _is_q8(node):
+            return node[_Q].astype(jnp.float32) * node[_SCALE]
+        if isinstance(node, Mapping):
+            return {k: walk(v) for k, v in node.items()}
+        return node
+
+    return walk(packed)
+
+
+def quantized_delta(model, variables, images, mode: str) -> dict:
+    """The accuracy referee's measurement (zoo_check --quantize and the
+    test-tier pins share it): forward ``images`` through the f32
+    variables and the ``mode`` variant, return the relative logits delta
+    and top-1 agreement against ``TOLERANCE[mode]``."""
+    ref = np.asarray(model.apply(variables, images, train=False))
+    packed, meta = quantize_variables(variables, mode)
+    got = np.asarray(
+        model.apply(dequantize_in_graph(packed), images, train=False)
+    )
+    denom = max(float(np.max(np.abs(ref))), 1e-9)
+    rel = float(np.max(np.abs(got - ref))) / denom
+    agree = float(np.mean(np.argmax(got, -1) == np.argmax(ref, -1)))
+    return {
+        "mode": mode,
+        "rel_logits_delta": round(rel, 6),
+        "tolerance": TOLERANCE[mode],
+        "top1_agree": round(agree, 4),
+        "ok": rel <= TOLERANCE[mode],
+        "bytes_before": meta["bytes_before"],
+        "bytes_after": meta["bytes_after"],
+        "quantized_leaves": meta["quantized_leaves"],
+    }

@@ -71,6 +71,9 @@ RULE_KINDS = (
     "mfu-regression",
     "hbm-headroom-low",
     "dispatch-wedge",
+    "backpressure",
+    "slo-breach",
+    "degrade-spill",
 )
 
 _RANK_RE = re.compile(r"rank(\d+)\.jsonl$")
@@ -533,8 +536,20 @@ def probe_serve(addr: tuple[str, int], window_s: float = 0.0,
         "occupancy": float(occupancy or 0.0),
         "requests": int(stats.get("requests", 0)),
         "rejected": int(stats.get("rejected", 0)),
+        "degraded": int(stats.get("degraded", 0)),
         "replicas": int(stats.get("replicas", 1)),
         "routable": int(stats.get("routable", stats.get("replicas", 1) or 1)),
+        "models": win.get("models") or {
+            # cumulative fallback when the peer has no windowed view:
+            # normalize the router's stats() model rows to the shape the
+            # slo-breach rule reads
+            name: {
+                "samples": int(m.get("requests", 0)),
+                "p99_ms": float(m.get("p99_ms", 0.0)),
+                "target_ms": m.get("p99_slo_ms"),
+            }
+            for name, m in (stats.get("models") or {}).items()
+        },
     }
 
 
@@ -722,6 +737,31 @@ class RuleEngine:
             return float(
                 sum(e["snap"].get("dispatch_wedges", 0) for e in window)
             )
+        if rule.kind in ("backpressure", "degrade-spill"):
+            # growth of a cumulative serve counter over the lookback
+            # window: rejected requests (backpressure) or degraded spills
+            # to a fallback model (degrade-spill). Needs two serve-bearing
+            # snapshots to form a delta — fewer is insufficient signal.
+            key = "rejected" if rule.kind == "backpressure" else "degraded"
+            vals = [
+                e["snap"]["serve"].get(key, 0)
+                for e in window if e["snap"].get("serve")
+            ]
+            if len(vals) < 2:
+                return None
+            return float(vals[-1] - vals[0])
+        if rule.kind == "slo-breach":
+            # worst per-model windowed p99 / SLO-target ratio (serve
+            # campaigns register targets per model — fleet/router.py).
+            # Models without a target or enough window samples don't vote.
+            serve = snap.get("serve") or {}
+            ratios = [
+                float(m["p99_ms"]) / float(m["target_ms"])
+                for m in (serve.get("models") or {}).values()
+                if m.get("target_ms")
+                and m.get("samples", 0) >= rule.min_steps
+            ]
+            return max(ratios) if ratios else None
         return None
 
     def _breached(self, rule: AlertRule, value: float) -> bool:
@@ -793,7 +833,9 @@ class RuleEngine:
         if rule.kind == "hbm-headroom-low":
             return (f"HBM headroom {value:.1f}% at or under the "
                     f"{limit:g}% floor (tightest executable)")
-        unit = {"p99-breach": " ms", "straggler-skew": "x"}.get(rule.kind, "")
+        unit = {
+            "p99-breach": " ms", "straggler-skew": "x", "slo-breach": "x",
+        }.get(rule.kind, "")
         return f"{rule.kind}: {value:g}{unit} >= {limit:g}{unit}"
 
     def active_rules(self) -> list[str]:

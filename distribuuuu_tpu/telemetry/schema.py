@@ -134,6 +134,24 @@ KINDS: dict[str, frozenset] = {
         {"ok", "intervals", "alerts_exact", "control_clean",
          "gates_evaluated"}
     ),
+    # -- traffic-campaign plane (serve/campaign/, ISSUE 16) --------------
+    # one per campaign phase: expected vs raised alerts + the phase gate
+    "campaign.phase": frozenset(
+        {"campaign", "phase", "expected_alerts", "raised_alerts", "ok"}
+    ),
+    # the final per-campaign verdict mirrored into SERVE_CAMPAIGN_*.json
+    "campaign.verdict": frozenset(
+        {"campaign", "phases", "alerts_exact", "control_clean", "ok"}
+    ),
+    # per-model routing stats on a multi-model fleet (router telemetry)
+    "fleet.model_route": frozenset(
+        {"model", "requests", "rejected", "degraded_in", "degraded_out",
+         "p99_ms"}
+    ),
+    # one per quantized engine start: the weight repack's footprint
+    "serve.quantized": frozenset(
+        {"arch", "mode", "bytes_before", "bytes_after", "leaves"}
+    ),
 }
 
 

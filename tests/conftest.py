@@ -26,6 +26,16 @@ jax.config.update("jax_platforms", "cpu")
 import pytest  # noqa: E402
 
 
+def pytest_configure(config):
+    # session start stamp for the tier-1 wall-clock guard
+    # (tests/test_zz_tier1_budget.py): the suite must fit its timeout
+    # with margin, or the guard fails BEFORE the driver's `timeout` kills
+    # the run with no diagnostics
+    import time
+
+    config._t1_start = time.monotonic()
+
+
 @pytest.fixture(autouse=True)
 def _reset_global_cfg():
     """Each test sees pristine config defaults."""

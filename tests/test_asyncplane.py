@@ -908,6 +908,7 @@ def _assert_pin_pair_identical(out_async, ev_async, out_sync, ev_sync):
             )
 
 
+@pytest.mark.slow  # two full subprocess trainings; tier-1 budget (ISSUE 16)
 def test_async_everything_trajectory_bit_identical(tmp_path):
     """ISSUE 10 hard contract, same style as the PR 7 monitor pin: a run
     with background checkpoint commit + concurrent eval + persistent
@@ -917,6 +918,7 @@ def test_async_everything_trajectory_bit_identical(tmp_path):
     _assert_pin_pair_identical(out_async, ev_async, out_sync, ev_sync)
 
 
+@pytest.mark.slow  # two 8-device subprocess trainings; tier-1 budget
 def test_async_everything_multidevice_bit_identical(tmp_path):
     """ISSUE 11 acceptance: the previously-DEADLOCKING configuration —
     concurrent eval + async save + compile cache on the 8-virtual-device
@@ -956,6 +958,7 @@ print(f"MH_PIN_DONE rank={jax.process_index()} best={best}", flush=True)
 """
 
 
+@pytest.mark.slow  # real 2-process distributed run; tier-1 budget
 def test_multihost_async_commit_two_processes(tmp_path):
     """ISSUE 11 acceptance, the multi-host half: a REAL 2-process run
     with CHECKPOINT.ASYNC commits its checkpoints through the

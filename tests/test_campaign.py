@@ -1,0 +1,586 @@
+"""Traffic-campaign plane (distribuuuu_tpu/serve/campaign/, ISSUE 16):
+campaign DSL strict validation, seeded-schedule determinism (same YAML +
+seed ⇒ identical schedule, pinned against the committed artifact),
+model-envelope framing, wrong-model-id refusal with the registered list,
+deterministic SLO overflow rerouting over fake socket replicas with
+degraded accounting, the three new serve alert-rule kinds, and the
+quantized logits-delta pins — all toy fixtures, no replica processes.
+"""
+
+from __future__ import annotations
+
+import glob
+import json
+import os
+import socket
+import threading
+
+import numpy as np
+import pytest
+import yaml
+
+from distribuuuu_tpu.serve import protocol
+from distribuuuu_tpu.serve import quantize as quantize_lib
+from distribuuuu_tpu.serve.campaign import (
+    CampaignRunner,
+    build_schedule,
+    load_campaign,
+    parse_campaign,
+    schedule_hash,
+)
+from distribuuuu_tpu.serve.campaign import dsl
+from distribuuuu_tpu.serve.fleet import Router
+from distribuuuu_tpu.telemetry import live, schema
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+CAMPAIGN_DIR = os.path.join(ROOT, "config", "campaigns")
+
+OK_RESP = json.dumps(
+    {"pred": 1, "topk": [1, 0], "logits": [0.0, 1.0]}
+).encode()
+BUSY_RESP = json.dumps(
+    {"error": "queue_full", "retry_after_ms": 5.0}
+).encode()
+
+
+def _doc(**over) -> dict:
+    doc = {
+        "campaign": 1,
+        "name": "toy",
+        "seed": 7,
+        "interval_s": 1.0,
+        "models": [{"name": "a", "p99_slo_ms": 100.0}],
+        "rules": [{"kind": "p99-breach", "threshold": 50.0}],
+        "phases": [
+            {"name": "control", "kind": "steady", "duration_s": 2,
+             "rate_rps": 3, "expect": []},
+        ],
+    }
+    doc.update(over)
+    return doc
+
+
+# -- DSL validation ----------------------------------------------------------
+
+def test_parse_campaign_happy_path_and_mix_normalization():
+    spec = parse_campaign(_doc(models=[
+        {"name": "a"}, {"name": "b"},
+    ], phases=[
+        {"name": "p", "kind": "steady", "duration_s": 2, "rate_rps": 3,
+         "expect": [], "mix": {"a": 3.0, "b": 1.0}},
+    ]))
+    assert spec.name == "toy" and spec.seed == 7
+    assert spec.phases[0].mix == (("a", 0.75), ("b", 0.25))
+    assert spec.duration_s == 2
+
+
+@pytest.mark.parametrize("mutation, match", [
+    ({"campaign": 2}, "campaign: 1"),
+    ({"typo_key": 1}, "unknown campaign keys"),
+    ({"models": []}, "at least one model"),
+    ({"models": [{"name": "a", "bogus": 1}]}, "unknown model keys"),
+    ({"models": [{"name": "a", "overflow_to": "ghost"}]}, "undeclared"),
+    ({"phases": []}, "at least one phase"),
+    ({"phases": [{"name": "p", "kind": "tsunami", "duration_s": 1,
+                  "rate_rps": 1, "expect": []}]}, "unknown phase kind"),
+    ({"phases": [{"name": "p", "kind": "steady", "duration_s": 1,
+                  "rate_rps": 1, "expect": ["stall"]}]}, "un-armable"),
+    ({"phases": [{"name": "p", "kind": "steady", "duration_s": 1,
+                  "rate_rps": 1, "expect": ["backpressure"]}]},
+     "arms only"),
+    ({"phases": [{"name": "p", "kind": "rolling_update", "duration_s": 1,
+                  "rate_rps": 1, "expect": []}]}, "update.model"),
+    ({"phases": [{"name": "p", "kind": "steady", "duration_s": 1,
+                  "rate_rps": 1, "expect": [], "mix": {"ghost": 1.0}}]},
+     "unknown models"),
+], ids=["version", "spec-key", "no-models", "model-key", "overflow-ghost",
+        "no-phases", "phase-kind", "unarmable-expect", "unarmed-expect",
+        "update-model", "mix-ghost"])
+def test_parse_campaign_rejects(mutation, match):
+    with pytest.raises(ValueError, match=match):
+        parse_campaign(_doc(**mutation))
+
+
+def test_campaign_rule_kinds_are_all_engine_evaluable():
+    assert set(dsl.CAMPAIGN_RULE_KINDS) <= set(live.RULE_KINDS)
+
+
+# -- schedule determinism ----------------------------------------------------
+
+def test_build_schedule_deterministic_and_seed_sensitive():
+    spec = parse_campaign(_doc(phases=[
+        {"name": "ramp", "kind": "diurnal", "duration_s": 5, "rate_rps": 2,
+         "peak_rps": 20, "expect": []},
+        {"name": "tail", "kind": "heavy_tail", "duration_s": 5,
+         "rate_rps": 4, "size_alpha": 1.1, "size_max": 6, "expect": []},
+    ]))
+    s1, s2 = build_schedule(spec), build_schedule(spec)
+    assert s1 == s2 and schedule_hash(s1) == schedule_hash(s2)
+    assert s1 == sorted(s1, key=lambda r: r[0])
+    assert all(1 <= size <= 6 for _t, _m, size in s1)
+    assert any(size > 1 for _t, _m, size in s1)  # the tail actually draws
+    other = parse_campaign(_doc(seed=8, phases=[
+        {"name": "ramp", "kind": "diurnal", "duration_s": 5, "rate_rps": 2,
+         "peak_rps": 20, "expect": []},
+        {"name": "tail", "kind": "heavy_tail", "duration_s": 5,
+         "rate_rps": 4, "size_alpha": 1.1, "size_max": 6, "expect": []},
+    ]))
+    assert schedule_hash(build_schedule(other)) != schedule_hash(s1)
+
+
+def test_flash_rate_curve_bursts_only_inside_window():
+    spec = parse_campaign(_doc(phases=[
+        {"name": "crowd", "kind": "flash", "duration_s": 10, "rate_rps": 2,
+         "burst_x": 50, "burst_window": [0.4, 0.6], "expect": []},
+    ]))
+    phase = spec.phases[0]
+    assert dsl._rate(phase, 0.1) == 2.0
+    assert dsl._rate(phase, 0.5) == 100.0
+    assert dsl._rate(phase, 0.7) == 2.0
+    sched = build_schedule(spec)
+    inside = sum(1 for t, _m, _s in sched if 4.0 <= t < 6.0)
+    outside = len(sched) - inside
+    assert inside > outside  # 20% of the time carries most of the load
+
+
+def test_shipped_campaign_yamls_parse_and_schedule():
+    paths = sorted(glob.glob(os.path.join(CAMPAIGN_DIR, "*.yaml")))
+    assert len(paths) >= 4  # the committed campaign matrix
+    names = set()
+    for path in paths:
+        spec = load_campaign(path)
+        names.add(spec.name)
+        sched = build_schedule(spec)
+        assert sched, f"{path} schedules zero requests"
+        assert schedule_hash(build_schedule(spec)) == schedule_hash(sched)
+    assert "degrade_under_pressure" in names  # ISSUE 16 acceptance scenario
+
+
+def test_committed_artifact_schedule_hashes_reproduce():
+    """The determinism pin against the REAL archived run: rebuilding each
+    campaign's schedule from its shipped YAML must give exactly the
+    schedule_hash the committed SERVE_CAMPAIGN artifact recorded."""
+    artifacts = sorted(glob.glob(os.path.join(ROOT, "SERVE_CAMPAIGN_r*.json")))
+    if not artifacts:
+        pytest.skip("no committed SERVE_CAMPAIGN artifact yet")
+    doc = json.load(open(artifacts[-1]))
+    by_name = {}
+    for path in glob.glob(os.path.join(CAMPAIGN_DIR, "*.yaml")):
+        spec = load_campaign(path)
+        by_name[spec.name] = spec
+    assert len(doc["campaigns"]) >= 4
+    for c in doc["campaigns"]:
+        spec = by_name[c["campaign"]]
+        assert schedule_hash(build_schedule(spec)) == c["schedule_hash"], (
+            f"campaign {c['campaign']}: shipped YAML no longer reproduces "
+            f"the archived schedule — rerun tools/serve_campaign.py"
+        )
+        assert c["ok"], f"committed campaign {c['campaign']} is red"
+
+
+# -- model envelope ----------------------------------------------------------
+
+def test_model_envelope_roundtrip_and_bare_passthrough():
+    payload = b"\x93NUMPYfake-image-bytes"
+    frame = protocol.model_envelope("resnet18", payload)
+    assert frame.startswith(protocol.MODEL_MAGIC)
+    assert protocol.split_model_envelope(frame) == ("resnet18", payload)
+    # bare payloads pass through untouched (single-model clients)
+    assert protocol.split_model_envelope(payload) == (None, payload)
+    # a ctrl frame is NOT a model envelope (magics differ at byte 5)
+    ctrl = protocol.ctrl_request("stats")
+    assert protocol.split_model_envelope(ctrl) == (None, ctrl)
+    with pytest.raises(ValueError, match="1..255"):
+        protocol.model_envelope("", payload)
+    with pytest.raises(ValueError, match="truncated"):
+        protocol.split_model_envelope(protocol.MODEL_MAGIC + bytes([9]) + b"ab")
+
+
+# -- fake socket replicas ----------------------------------------------------
+
+class FakeReplica:
+    """Real localhost socket speaking the serve framing with a scripted
+    responder — the no-process fleet fixture (tests/test_fleet.py idiom)."""
+
+    def __init__(self, responder):
+        self.responder = responder
+        self.listener = protocol.open_listener("127.0.0.1", 0)
+        self.port = self.listener.getsockname()[1]
+        self.requests = 0
+        self._stop = threading.Event()
+        threading.Thread(target=self._accept, daemon=True).start()
+
+    def _accept(self):
+        self.listener.settimeout(0.05)
+        while not self._stop.is_set():
+            try:
+                conn, _ = self.listener.accept()
+            except socket.timeout:
+                continue
+            except OSError:
+                return
+            threading.Thread(
+                target=self._handle, args=(conn,), daemon=True
+            ).start()
+
+    def _handle(self, conn):
+        with conn:
+            while True:
+                try:
+                    payload = protocol.recv_frame(conn)
+                except (OSError, ValueError):
+                    return
+                if payload is None:
+                    return
+                self.requests += 1
+                try:
+                    protocol.send_frame(conn, self.responder(payload))
+                except OSError:
+                    return
+
+    def close(self):
+        self._stop.set()
+        self.listener.close()
+
+
+def _multi_model_router(premium, economy) -> Router:
+    router = Router(request_timeout_s=5.0)
+    router.register_model(
+        "resnet50", slo_class="premium", p99_slo_ms=300.0,
+        overflow_to="resnet18",
+    )
+    router.register_model("resnet18", slo_class="economy", p99_slo_ms=600.0)
+    for srv, model in ((premium, "resnet50"), (economy, "resnet18")):
+        rep = router.add_replica("127.0.0.1", srv.port, model=model)
+        router.mark_routable(rep.id)
+    return router
+
+
+def test_unknown_model_refused_with_registered_list():
+    premium = FakeReplica(lambda p: OK_RESP)
+    economy = FakeReplica(lambda p: OK_RESP)
+    try:
+        router = _multi_model_router(premium, economy)
+        resp = json.loads(router.dispatch(
+            protocol.model_envelope("resnet152", b"img")
+        ))
+        assert resp["error"] == "unknown_model"
+        assert resp["model"] == "resnet152"
+        assert resp["models"] == ["resnet18", "resnet50"]
+        # refused before any replica saw a byte
+        assert premium.requests == 0 and economy.requests == 0
+        assert router.stats()["unknown_model"] == 1
+    finally:
+        premium.close()
+        economy.close()
+
+
+def test_model_routing_is_model_exclusive():
+    """Each model's traffic lands ONLY on its own replicas, even when the
+    other pool is idle (no silent cross-model leakage)."""
+    premium = FakeReplica(lambda p: OK_RESP)
+    economy = FakeReplica(lambda p: OK_RESP)
+    try:
+        router = _multi_model_router(premium, economy)
+        for _ in range(4):
+            resp = router.dispatch(protocol.model_envelope("resnet18", b"x"))
+            assert json.loads(resp)["pred"] == 1
+        assert economy.requests == 4 and premium.requests == 0
+        st = router.stats()
+        assert st["models"]["resnet18"]["requests"] == 4
+        assert st["models"]["resnet50"]["requests"] == 0
+        assert [p["model"] for p in st["per_replica"]] == [
+            "resnet50", "resnet18",
+        ]
+    finally:
+        premium.close()
+        economy.close()
+
+
+def test_slo_overflow_reroutes_to_cheap_model_deterministically():
+    """ISSUE 16 tentpole (a): every premium replica saturated ⇒ the
+    stripped payload spills to the overflow_to model; the answer comes
+    back and BOTH sides' degraded counters record it. Repeatable: same
+    saturation, same spill, every time."""
+    premium = FakeReplica(lambda p: BUSY_RESP)   # always saturated
+    economy = FakeReplica(lambda p: OK_RESP)     # always absorbs
+    try:
+        router = _multi_model_router(premium, economy)
+        for i in range(3):
+            resp = json.loads(router.dispatch(
+                protocol.model_envelope("resnet50", b"img")
+            ))
+            assert resp.get("pred") == 1, resp  # the economy answer, not busy
+        st = router.stats()
+        assert st["degraded"] == 3
+        assert st["models"]["resnet50"]["degraded_out"] == 3
+        assert st["models"]["resnet18"]["degraded_in"] == 3
+        assert st["models"]["resnet50"]["rejected"] == 0  # spill ≠ reject
+        # economy served every spill; premium only ever answered busy
+        assert economy.requests == 3
+    finally:
+        premium.close()
+        economy.close()
+
+
+def test_saturation_without_overflow_passes_busy_verbatim():
+    """A model with NO overflow_to keeps the verbatim-backpressure
+    contract: the client sees the replica's own retry-after rejection."""
+    premium = FakeReplica(lambda p: BUSY_RESP)
+    economy = FakeReplica(lambda p: BUSY_RESP)
+    try:
+        router = _multi_model_router(premium, economy)
+        resp = router.dispatch(protocol.model_envelope("resnet18", b"img"))
+        assert resp == BUSY_RESP
+        st = router.stats()
+        assert st["models"]["resnet18"]["rejected"] == 1
+        assert st["degraded"] == 0
+    finally:
+        premium.close()
+        economy.close()
+
+
+def test_runner_snapshot_is_rule_engine_compatible():
+    """The runner's serve-shaped snapshot feeds RuleEngine.evaluate
+    without KeyError for every campaign-armable kind."""
+    premium = FakeReplica(lambda p: OK_RESP)
+    economy = FakeReplica(lambda p: OK_RESP)
+    try:
+        router = _multi_model_router(premium, economy)
+        router.dispatch(protocol.model_envelope("resnet50", b"img"))
+        spec = parse_campaign(_doc(rules=[
+            {"kind": k, "threshold": 1e9}
+            for k in dsl.CAMPAIGN_RULE_KINDS
+        ]))
+        runner = CampaignRunner(
+            spec, router, payload_for=lambda m: b"img"
+        )
+        snap = runner._snapshot()
+        assert snap["totals"]["steps"] == 1
+        assert set(snap["serve"]["models"]) == {"resnet18", "resnet50"}
+        engine = live.RuleEngine(
+            [live.AlertRule(dict(r)) for r in spec.rules], spec.interval_s
+        )
+        assert engine.evaluate(snap) == []  # thresholds unreachable: calm
+        runner._pool.shutdown(wait=False)
+    finally:
+        premium.close()
+        economy.close()
+
+
+# -- the three new alert-rule kinds ------------------------------------------
+
+def _snap(steps, serve):
+    return {"schema": 1, "steps": steps, "totals": {"steps": steps},
+            "compiles": {"count": 0},
+            "events": {"stall": 0, "nonfinite": 0}, "serve": serve}
+
+
+def test_backpressure_rule_fires_on_rejected_growth():
+    engine = live.RuleEngine(
+        [live.AlertRule({"kind": "backpressure", "threshold": 10,
+                         "window_s": 2})], interval_s=1.0,
+    )
+    base = {"p99_ms": 5.0, "window_samples": 9, "queue_depth": 0}
+    # one serve snapshot: no delta to form yet — insufficient signal
+    assert engine.evaluate(_snap(1, {**base, "rejected": 0})) == []
+    # +20 rejected across the window ≥ threshold 10: fires
+    fired = engine.evaluate(_snap(2, {**base, "rejected": 20}))
+    assert [f["rule"] for f in fired] == ["backpressure"]
+    assert fired[0]["value"] == 20.0
+
+
+def test_degrade_spill_rule_fires_on_degraded_growth():
+    engine = live.RuleEngine(
+        [live.AlertRule({"kind": "degrade-spill", "threshold": 5,
+                         "window_s": 2})], interval_s=1.0,
+    )
+    base = {"p99_ms": 5.0, "window_samples": 9, "queue_depth": 0,
+            "rejected": 0}
+    assert engine.evaluate(_snap(1, {**base, "degraded": 0})) == []
+    assert engine.evaluate(_snap(2, {**base, "degraded": 3})) == []  # < 5
+    fired = engine.evaluate(_snap(3, {**base, "degraded": 9}))
+    assert [f["rule"] for f in fired] == ["degrade-spill"]
+
+
+def test_slo_breach_rule_reads_per_model_ratio():
+    engine = live.RuleEngine(
+        [live.AlertRule({"kind": "slo-breach", "threshold": 1.2,
+                         "min_steps": 4})], interval_s=1.0,
+    )
+    base = {"p99_ms": 5.0, "window_samples": 9, "queue_depth": 0,
+            "rejected": 0}
+    # under target: calm
+    calm = {"m": {"samples": 8, "p99_ms": 100.0, "target_ms": 300.0}}
+    assert engine.evaluate(_snap(1, {**base, "models": calm})) == []
+    # over target but too few samples: insufficient signal, not a breach
+    thin = {"m": {"samples": 2, "p99_ms": 900.0, "target_ms": 300.0}}
+    assert engine.evaluate(_snap(2, {**base, "models": thin})) == []
+    # a model with no target never votes
+    untargeted = {"m": {"samples": 50, "p99_ms": 900.0, "target_ms": None}}
+    assert engine.evaluate(_snap(3, {**base, "models": untargeted})) == []
+    # 450/300 = 1.5x ≥ 1.2x: fires with the ratio as the value
+    hot = {"m": {"samples": 8, "p99_ms": 450.0, "target_ms": 300.0}}
+    fired = engine.evaluate(_snap(4, {**base, "models": hot}))
+    assert [f["rule"] for f in fired] == ["slo-breach"]
+    assert fired[0]["value"] == 1.5
+
+
+def test_new_kinds_declared_everywhere():
+    # telemetry schema carries the four new kinds with required fields
+    assert schema.KINDS["campaign.phase"] >= {"campaign", "phase", "ok"}
+    assert schema.KINDS["campaign.verdict"] >= {"campaign", "ok"}
+    assert schema.KINDS["fleet.model_route"] >= {"model", "requests"}
+    assert schema.KINDS["serve.quantized"] >= {"arch", "mode"}
+    # and the shipped monitor rules file declares every engine kind
+    # (dormant where a baseline/serve peer is needed) — same pin shape
+    # as tests/test_monitor.py's
+    doc = yaml.safe_load(open(os.path.join(ROOT, "config",
+                                           "monitor_rules.yaml")))
+    declared = {r["kind"] for r in doc["rules"]}
+    assert {"backpressure", "slo-breach", "degrade-spill"} <= declared
+
+
+# -- quantized variants ------------------------------------------------------
+
+def _toy_model_and_variables():
+    import flax.linen as nn
+    import jax
+
+    class Toy(nn.Module):
+        @nn.compact
+        def __call__(self, x, train=False):
+            x = x.reshape((x.shape[0], -1))
+            x = nn.Dense(32)(x)   # (48, 32) kernel: int8-eligible (≥256)
+            x = nn.relu(x)
+            return nn.Dense(4)(x)  # (32, 4): too small, stays f32
+
+    model = Toy()
+    variables = model.init(
+        jax.random.key(0), np.zeros((1, 4, 4, 3), np.float32)
+    )
+    return model, {"params": variables["params"]}
+
+
+def test_quantize_variables_packs_and_dequantizes():
+    model, variables = _toy_model_and_variables()
+    packed, meta = quantize_lib.quantize_variables(variables, "int8")
+    assert meta["mode"] == "int8"
+    assert meta["quantized_leaves"] >= 1
+    assert meta["bytes_after"] < meta["bytes_before"]
+    # the big kernel became an int8 payload with per-output-axis scales
+    q = packed["params"]["Dense_0"]["kernel"]
+    assert q["q8"].dtype == np.int8 and q["q8"].shape == (48, 32)
+    assert q["q8_scale"].shape == (1, 32)  # keepdims broadcast scales
+    # the small kernel stayed float
+    small = packed["params"]["Dense_1"]["kernel"]
+    assert not isinstance(small, dict)
+    # in-graph dequant restores an apply-able tree
+    restored = quantize_lib.dequantize_in_graph(packed)
+    x = np.random.default_rng(0).standard_normal(
+        (2, 4, 4, 3)
+    ).astype(np.float32)
+    ref = model.apply(variables, x, train=False)
+    got = model.apply(restored, x, train=False)
+    assert np.asarray(got).shape == np.asarray(ref).shape
+
+
+@pytest.mark.parametrize("mode", ["bf16", "int8"])
+def test_quantized_logits_delta_within_tolerance(mode):
+    """ISSUE 16 tentpole (b) pin: the quantized variant's logits stay
+    within the mode's declared tolerance of f32 on a seeded batch."""
+    model, variables = _toy_model_and_variables()
+    images = np.random.default_rng(1).standard_normal(
+        (8, 4, 4, 3)
+    ).astype(np.float32)
+    rep = quantize_lib.quantized_delta(model, variables, images, mode)
+    assert rep["mode"] == mode
+    assert rep["tolerance"] == quantize_lib.TOLERANCE[mode]
+    assert rep["rel_logits_delta"] <= rep["tolerance"], rep
+    assert rep["ok"]
+    assert rep["top1_agree"] >= 0.75
+
+
+def test_quantize_rejects_unknown_mode():
+    _model, variables = _toy_model_and_variables()
+    with pytest.raises(ValueError, match="bf16"):
+        quantize_lib.quantize_variables(variables, "fp4")
+
+
+def test_engine_quantized_serving_same_buckets(tmp_path):
+    """ISSUE 16 acceptance: quantized bucket variants serve through the
+    UNCHANGED engine protocol — same buckets, same AOT compile count,
+    logits within tolerance of the f32 engine."""
+    from distribuuuu_tpu.serve.engine import Engine
+
+    model, variables = _toy_model_and_variables()
+    img = np.random.default_rng(2).standard_normal(
+        (4, 4, 3)
+    ).astype(np.float32)
+    with Engine(model, variables, 4, max_batch=2, max_wait_ms=1.0,
+                input_dtype=np.float32, quantize="") as ref_eng:
+        ref = ref_eng.submit(img).result()
+    with Engine(model, variables, 4, max_batch=2, max_wait_ms=1.0,
+                input_dtype=np.float32, quantize="int8") as q_eng:
+        assert q_eng.buckets == [1, 2]
+        assert q_eng.n_compiles == 2  # bucket set unchanged by the variant
+        assert q_eng.quantize_meta["mode"] == "int8"
+        assert q_eng.stats()["quantize"] == "int8"
+        got = q_eng.submit(img).result()
+    denom = max(float(np.max(np.abs(ref))), 1e-9)
+    delta = float(np.max(np.abs(got - ref))) / denom
+    assert delta <= quantize_lib.TOLERANCE["int8"], delta
+
+
+def test_shared_router_pools_stay_model_scoped():
+    """Two PoolManagers share ONE router (the multi-model fleet shape):
+    each must count, spawn, and drain only ITS OWN model's replicas.
+    Regression: the second pool used to see the first pool's replica in
+    the shared router, conclude its target was met, and never spawn —
+    leaving the overflow model with zero replicas during the
+    degrade-under-pressure campaign."""
+    from distribuuuu_tpu.serve.fleet.pool import PoolManager
+
+    warm = {"buckets": [1], "n_compiles": 1, "queue_depth": 0,
+            "batch_occupancy": 0.0, "jit_compiles": 1}
+
+    class Handle:
+        pid = 1
+
+        def __init__(self):
+            self._rc = None
+
+        def poll(self):
+            return self._rc
+
+        def terminate(self):
+            self._rc = 0
+
+        def kill(self):
+            self._rc = -9
+
+        def wait(self, timeout=None):
+            return self._rc
+
+    router = Router()
+    pools = {}
+    for name in ("premium", "economy"):
+        pools[name] = PoolManager(
+            router, lambda rid, port: Handle(),
+            probe=lambda addr: dict(warm), model=name, min_replicas=0,
+            warmup_timeout_s=2.0, warmup_poll_s=0.005,
+            health_period_s=0.05,
+        )
+    pools["premium"].set_target(1)
+    pools["premium"]._spawn_toward_target()
+    assert pools["premium"]._wait_routable(1)
+    # the second pool must STILL spawn toward its own target
+    pools["economy"].set_target(1)
+    assert len(pools["economy"]._spawn_toward_target()) == 1
+    assert pools["economy"]._wait_routable(1)
+    assert {r.model for r in router.replicas()} == {"premium", "economy"}
+    # shutdown drains only this pool's replica off the shared router
+    pools["economy"].shutdown(timeout=2.0)
+    assert [r.model for r in router.replicas()] == ["premium"]
+    pools["premium"].shutdown(timeout=2.0)
+    assert router.replicas() == []

@@ -161,6 +161,7 @@ def _tiny_train_setup():
     return trainer, mesh, model, step, eval_step
 
 
+@pytest.mark.slow  # 44s: trains at every prefetch depth; tier-1 budget
 def test_prefetch_ring_bit_identical_and_timeline(tmp_path):
     """Acceptance gate: train_epoch results are BIT-identical at every
     ring depth (0 = unoverlapped, 1, 2), and the per-batch path leaves one

@@ -122,6 +122,7 @@ def test_zero3_under_pp_trains_from_stanza_alone():
     assert deduped >= 10, deduped
 
 
+@pytest.mark.slow  # 41s: 3-axis mesh compile + train; tier-1 budget
 def test_three_axis_ep_with_zero1_trains_from_stanza_alone():
     """dp2×tp2×ep2 + ZeRO-1 — pathless before r11 (no expert axis
     existed) — trains from a YAML stanza alone: experts on the dedicated

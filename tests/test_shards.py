@@ -322,6 +322,7 @@ def test_data_state_checkpoint_encoding_roundtrip():
 
 
 # --------------------------------------------- trajectory through the trainer
+@pytest.mark.slow  # 83s: two full trainings + preempt subprocess; tier-1 budget
 def test_midepoch_preempt_resume_matches_uninterrupted(corpus, tmp_path):
     """The tentpole acceptance: preempt at batch k through the REAL
     signal → preempt-checkpoint → resume chain (FAULTS.PREEMPT_AT_BATCH,

@@ -236,6 +236,36 @@ def index_serve_bench(path: str, series: dict) -> None:
                fleet["fleet2_over_fleet1"], "x")
 
 
+def index_campaigns(path: str, series: dict) -> None:
+    """SERVE_CAMPAIGN_r*.json (tools/serve_campaign.py): per-campaign
+    verdict gates, the (model, dtype) latency/throughput frontier, and
+    the quantized accuracy-referee deltas. Every series name is
+    ``campaign_*`` — deliberately outside the img/s throughput-gate
+    patterns (the PR 8 clobbering lesson): CPU-container campaign
+    numbers must never become the training regression reference."""
+    with open(path) as f:
+        doc = json.load(f)
+    rnd, src = _round_of(path), os.path.basename(path)
+    for c in doc.get("campaigns") or []:
+        name = str(c.get("campaign", "unknown")).replace("-", "_")
+        _point(series, f"campaign_{name}_ok", rnd, src,
+               1.0 if c.get("ok") else 0.0)
+        _point(series, f"campaign_{name}_requests", rnd, src,
+               c.get("requests_scheduled"), "req")
+    for row in doc.get("frontier") or []:
+        key = f"{row.get('model')}_{row.get('dtype')}"
+        _point(series, f"campaign_frontier_p50_ms_{key}", rnd, src,
+               row.get("p50_ms"), "ms")
+        _point(series, f"campaign_frontier_p99_ms_{key}", rnd, src,
+               row.get("p99_ms"), "ms")
+        _point(series, f"campaign_frontier_rps_{key}", rnd, src,
+               row.get("throughput_rps"), "req/s")
+    for row in doc.get("quantized") or []:
+        key = f"{row.get('model')}_{row.get('mode')}"
+        _point(series, f"campaign_quantized_rel_delta_{key}", rnd, src,
+               row.get("rel_logits_delta"))
+
+
 def build_index(root: str) -> dict:
     series: dict[str, list] = {}
     train_files = sorted(glob.glob(os.path.join(root, "BENCH_r*.json")))
@@ -247,13 +277,19 @@ def build_index(root: str) -> dict:
     serve_path = os.path.join(root, "BENCH_serve.json")
     if os.path.exists(serve_path):
         index_serve_bench(serve_path, series)
+    campaign_files = sorted(
+        glob.glob(os.path.join(root, "SERVE_CAMPAIGN_r*.json"))
+    )
+    for path in campaign_files:
+        index_campaigns(path, series)
     for pts in series.values():
         pts.sort(key=lambda p: p["round"])
     return {
         "bench_index": INDEX_SCHEMA,
         "generated_by": "tools/bench_history.py",
         "sources": [os.path.basename(p) for p in train_files + cost_files]
-        + (["BENCH_serve.json"] if os.path.exists(serve_path) else []),
+        + (["BENCH_serve.json"] if os.path.exists(serve_path) else [])
+        + [os.path.basename(p) for p in campaign_files],
         "series": series,
     }
 

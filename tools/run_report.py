@@ -265,6 +265,61 @@ def _lm_section(ranks: dict[int, list[dict]]) -> dict | None:
     }
 
 
+def _campaign_section(ranks: dict[int, list[dict]]) -> dict | None:
+    """The traffic-campaign plane (serve/campaign/): per-campaign verdicts
+    (``campaign.verdict``), per-phase expected-vs-raised alert gates
+    (``campaign.phase``), per-model routing totals on multi-model fleets
+    (``fleet.model_route``, last record per model wins), and any quantized
+    engine starts (``serve.quantized``). None when the run carried no
+    campaign records (training and plain serve runs are untouched)."""
+    phases: list[dict] = []
+    verdicts: list[dict] = []
+    model_route: dict[str, dict] = {}
+    quantized: list[dict] = []
+    for recs in ranks.values():
+        for r in recs:
+            kind = r.get("kind")
+            if kind == "campaign.phase":
+                phases.append({
+                    "campaign": r.get("campaign"), "phase": r.get("phase"),
+                    "expected_alerts": r.get("expected_alerts"),
+                    "raised_alerts": r.get("raised_alerts"),
+                    "ok": r.get("ok"),
+                })
+            elif kind == "campaign.verdict":
+                verdicts.append({
+                    "campaign": r.get("campaign"),
+                    "phases": r.get("phases"),
+                    "alerts_exact": r.get("alerts_exact"),
+                    "control_clean": r.get("control_clean"),
+                    "ok": r.get("ok"),
+                })
+            elif kind == "fleet.model_route":
+                model_route[str(r.get("model"))] = {
+                    "requests": r.get("requests"),
+                    "rejected": r.get("rejected"),
+                    "degraded_in": r.get("degraded_in"),
+                    "degraded_out": r.get("degraded_out"),
+                    "p99_ms": r.get("p99_ms"),
+                }
+            elif kind == "serve.quantized":
+                quantized.append({
+                    "arch": r.get("arch"), "mode": r.get("mode"),
+                    "bytes_before": r.get("bytes_before"),
+                    "bytes_after": r.get("bytes_after"),
+                })
+    if not (phases or verdicts or model_route or quantized):
+        return None
+    return {
+        "campaigns": len(verdicts),
+        "ok": all(v["ok"] for v in verdicts) if verdicts else None,
+        "verdicts": verdicts,
+        "phases": phases,
+        "model_route": model_route or None,
+        "quantized": quantized or None,
+    }
+
+
 def _kernels_section(ranks: dict[int, list[dict]]) -> dict | None:
     """The Pallas kernel tier (ops/pallas/): which impl actually ran per
     op (``kernel.select``), every forced-but-unsupported fallback with
@@ -473,6 +528,7 @@ def build_report(run_dir: str, phase: str = "train") -> dict:
         "sequencer": sequencer,
         "lm": _lm_section(ranks),
         "kernels": _kernels_section(ranks),
+        "campaign": _campaign_section(ranks),
     }
     return report
 
@@ -699,6 +755,33 @@ def _print_report(rep: dict) -> None:
               f"wait(s) ({seq['fence_wait_s']}s)"
               + (f", {seq['wedges']} WEDGE flag(s)" if seq["wedges"]
                  else ""))
+    camp = rep.get("campaign")
+    if camp:
+        verdict = {True: "PASS", False: "FAIL", None: "n/a"}[camp["ok"]]
+        print(f"traffic campaigns: {camp['campaigns']} verdict(s), "
+              f"gate {verdict}")
+        for v in camp["verdicts"]:
+            print(f"  {v['campaign']:<24} phases={v['phases']} "
+                  f"alerts_exact={v['alerts_exact']} "
+                  f"control_clean={v['control_clean']} "
+                  f"{'ok' if v['ok'] else 'FAIL'}")
+        for p in camp["phases"]:
+            if not p["ok"]:
+                print(f"  PHASE FAIL {p['campaign']}/{p['phase']}: "
+                      f"expected {p['expected_alerts']} "
+                      f"raised {p['raised_alerts']}")
+        if camp.get("model_route"):
+            for name, row in sorted(camp["model_route"].items()):
+                print(f"  model {name:<12} requests={row['requests']} "
+                      f"rejected={row['rejected']} "
+                      f"spill_out={row['degraded_out']} "
+                      f"spill_in={row['degraded_in']} "
+                      f"p99={row['p99_ms']}ms")
+        for q in camp.get("quantized") or []:
+            ratio = (q["bytes_after"] / q["bytes_before"]
+                     if q.get("bytes_before") else None)
+            print(f"  quantized {q['arch']} [{q['mode']}]"
+                  + (f": weights x{ratio:.2f}" if ratio else ""))
 
 
 def _print_compare(cmp: dict, baseline_path: str) -> None:

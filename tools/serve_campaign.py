@@ -1,0 +1,333 @@
+"""Run traffic campaigns against a real multi-model fleet and archive
+the scored verdicts (SERVE_CAMPAIGN_r*.json) — ISSUE 16's referee CLI.
+
+    # everything the committed artifact contains (campaigns + quantized
+    # referee + (model, dtype) latency frontier), into ./SERVE_CAMPAIGN_r01.json:
+    python tools/serve_campaign.py --out SERVE_CAMPAIGN_r01.json
+
+    # one campaign, faster iteration:
+    python tools/serve_campaign.py --campaign config/campaigns/flash_crowd.yaml
+
+    # skip the slow parts:
+    python tools/serve_campaign.py --no-frontier --no-quantized
+
+Per campaign YAML (config/campaigns/): build the fleet topology the
+campaign declares (MultiModelFleet — real serve_net.py replica
+processes, per-model pools, one router), replay the seeded schedule
+open-loop (campaign/runner.py), score every phase with the alert-rule
+engine (raised == expected EXACTLY, control phases silent), and record
+the determinism pin (the schedule built twice must hash identically).
+
+The quantized section is the accuracy referee (zoo_check's measurement,
+serve/quantize.quantized_delta): per (model, dtype) the served logits
+must stay within TOLERANCE of f32. The frontier section measures the
+latency/throughput cost of each (model, dtype) variant through the real
+engine (in-process, AOT bucket path) — the serving-side cost ledger.
+
+Everything runs on whatever host executes this; cpu_count lands in the
+artifact so single-core numbers read as single-core numbers.
+"""
+
+from __future__ import annotations
+
+import argparse
+import glob
+import io
+import json
+import os
+import sys
+import tempfile
+import threading
+import time
+
+import _path  # noqa: F401  — repo root onto sys.path for the package import
+import numpy as np
+
+# replica counts per campaign model (the YAML declares traffic + SLO;
+# topology is the harness's concern — keep it one honest table)
+TOPOLOGY = {
+    "rolling_update": {"resnet18": 2},  # >=2: one replica stays routable
+    "degrade_under_pressure": {"resnet50": 1, "resnet18": 1},
+}
+
+IM_SIZE = 16
+NUM_CLASSES = 4
+FRONTIER_ARCHS = ("resnet18", "resnet50")
+FRONTIER_MODES = ("", "bf16", "int8")
+
+
+def base_cfg(work: str):
+    """The campaign serve config: the soak's toy-but-real recipe
+    (float payloads, tiny images, real replicas) with a SMALL admission
+    queue so backpressure is reachable inside a short campaign."""
+    import distribuuuu_tpu.config as config
+    from distribuuuu_tpu.config import cfg
+
+    config.reset_cfg()
+    cfg.MODEL.ARCH = "resnet18"
+    cfg.MODEL.NUM_CLASSES = NUM_CLASSES
+    cfg.MODEL.BN_GROUP = 8
+    cfg.DEVICE.COMPUTE_DTYPE = "float32"
+    cfg.DEVICE.PLATFORM = "cpu"
+    cfg.TRAIN.IM_SIZE = IM_SIZE
+    cfg.TEST.IM_SIZE = IM_SIZE
+    cfg.RNG_SEED = 0
+    cfg.DATA.DEVICE_NORMALIZE = False  # float payloads, no PIL
+    cfg.OUT_DIR = work
+    # singles, no batch amplification: a replica serves ~1/service_time
+    # rps, so campaign rates in the YAMLs mean what they say, and the
+    # 16-deep admission queue puts ~16 service times of wait (well past
+    # the 150ms p99 rule) between "saturated" and "rejecting"
+    cfg.SERVE.MAX_BATCH = 1
+    cfg.SERVE.MAX_WAIT_MS = 0.0
+    cfg.SERVE.MAX_QUEUE = 16
+    cfg.SERVE.FLEET.AUTOSCALE = False  # campaigns pin their topology
+    cfg.SERVE.FLEET.MIN_REPLICAS = 0
+    cfg.SERVE.FLEET.HEALTH_PERIOD_S = 0.5
+    return cfg
+
+
+def payload_bank(n: int = 8, seed: int = 0) -> list:
+    rng = np.random.default_rng(seed)
+    out = []
+    for _ in range(n):
+        buf = io.BytesIO()
+        np.save(
+            buf,
+            rng.standard_normal((IM_SIZE, IM_SIZE, 3)).astype(np.float32),
+        )
+        out.append(buf.getvalue())
+    return out
+
+
+def fleet_specs(spec) -> list:
+    """Campaign models (name/SLO from the YAML) + harness topology."""
+    topo = TOPOLOGY.get(spec.name, {})
+    return [
+        {
+            "name": m["name"],
+            "replicas": int(topo.get(m["name"], 1)),
+            "slo_class": m["slo_class"],
+            "p99_slo_ms": m["p99_slo_ms"],
+            "overflow_to": m["overflow_to"],
+        }
+        for m in spec.models
+    ]
+
+
+def run_campaign(path: str, work: str, log) -> dict:
+    from distribuuuu_tpu.serve.campaign import dsl
+    from distribuuuu_tpu.serve.campaign.fleet import MultiModelFleet
+    from distribuuuu_tpu.serve.campaign.runner import CampaignRunner
+
+    spec = dsl.load_campaign(path)
+    # the determinism pin: the schedule is a pure function of (YAML, seed)
+    h1 = dsl.schedule_hash(dsl.build_schedule(spec))
+    h2 = dsl.schedule_hash(dsl.build_schedule(spec))
+
+    cdir = os.path.join(work, spec.name)
+    cfg = base_cfg(cdir)
+    specs = fleet_specs(spec)
+    log(f"campaign {spec.name}: fleet "
+        f"{ {s['name']: s['replicas'] for s in specs} } warming up ...")
+    fleet = MultiModelFleet(cfg, specs, out_dir=cdir)
+    t0 = time.perf_counter()
+    fleet.start(wait=True)
+    log(f"campaign {spec.name}: fleet routable in "
+        f"{time.perf_counter() - t0:.1f}s")
+    payloads = payload_bank()
+    counter = {"i": 0}
+    lock = threading.Lock()
+
+    def payload_for(model: str) -> bytes:
+        with lock:
+            counter["i"] += 1
+            return payloads[counter["i"] % len(payloads)]
+
+    try:
+        runner = CampaignRunner(
+            spec, fleet.router, payload_for=payload_for, fleet=fleet
+        )
+        verdict = runner.run()
+    finally:
+        fleet.shutdown()
+    verdict["yaml"] = os.path.relpath(path, os.path.dirname(
+        os.path.dirname(os.path.abspath(__file__))))
+    verdict["fleet"] = {s["name"]: s["replicas"] for s in specs}
+    verdict["deterministic"] = (
+        h1 == h2 == verdict["schedule_hash"]
+    )
+    verdict["ok"] = verdict["ok"] and verdict["deterministic"]
+    log(f"campaign {spec.name}: ok={verdict['ok']} "
+        f"(alerts_exact={verdict['alerts_exact']} "
+        f"control_clean={verdict['control_clean']} "
+        f"deterministic={verdict['deterministic']})")
+    return verdict
+
+
+def measure_frontier(work: str, log, n_lat: int = 80,
+                     burst_s: float = 2.0) -> list:
+    """The (model, dtype) serving cost frontier through the REAL engine:
+    per variant, sequential single-request p50/p99 (the bucket-1 path)
+    and a short closed-loop throughput probe (4 clients)."""
+    from distribuuuu_tpu.serve.engine import engine_from_cfg
+
+    rng = np.random.default_rng(0)
+    img = rng.standard_normal((IM_SIZE, IM_SIZE, 3)).astype(np.float32)
+    rows = []
+    for arch in FRONTIER_ARCHS:
+        for mode in FRONTIER_MODES:
+            cfg = base_cfg(os.path.join(work, f"frontier_{arch}_{mode or 'f32'}"))
+            cfg.MODEL.ARCH = arch
+            cfg.SERVE.QUANTIZE = mode
+            t0 = time.perf_counter()
+            eng = engine_from_cfg().start()  # from_cfg returns it unstarted
+            compile_s = time.perf_counter() - t0
+            try:
+                for _ in range(5):  # warm the bucket-1 path
+                    eng.submit(img).result()
+                lats = []
+                for _ in range(n_lat):
+                    t1 = time.perf_counter()
+                    eng.submit(img).result()
+                    lats.append((time.perf_counter() - t1) * 1e3)
+                lats.sort()
+                done = {"n": 0}
+                stop_at = time.perf_counter() + burst_s
+
+                def client():
+                    while time.perf_counter() < stop_at:
+                        eng.submit(img).result()
+                        done["n"] += 1
+
+                threads = [
+                    threading.Thread(target=client, daemon=True)
+                    for _ in range(4)
+                ]
+                for t in threads:
+                    t.start()
+                for t in threads:
+                    t.join()
+                qmeta = getattr(eng, "quantize_meta", None)
+                row = {
+                    "model": arch,
+                    "dtype": mode or "f32",
+                    "p50_ms": round(lats[len(lats) // 2], 2),
+                    "p99_ms": round(lats[min(len(lats) - 1,
+                                             int(len(lats) * 0.99))], 2),
+                    "throughput_rps": round(done["n"] / burst_s, 1),
+                    "compile_s": round(compile_s, 1),
+                    "weight_bytes": (
+                        int(qmeta["bytes_after"]) if qmeta else None
+                    ),
+                }
+                rows.append(row)
+                log(f"frontier {arch}/{mode or 'f32'}: "
+                    f"p50 {row['p50_ms']}ms p99 {row['p99_ms']}ms "
+                    f"{row['throughput_rps']} rps")
+            finally:
+                eng.drain()
+    # f32 weight bytes for the shrink column (from the quantize meta of
+    # the bf16 run's 'before' side is equivalent; record via referee rows)
+    return rows
+
+
+def quantized_report(log) -> list:
+    """The accuracy referee (same measurement zoo_check --quantize
+    certifies): per (model, mode), served logits vs f32 within
+    TOLERANCE."""
+    import jax
+
+    import distribuuuu_tpu.config as config
+    from distribuuuu_tpu import trainer
+    from distribuuuu_tpu.config import cfg
+    from distribuuuu_tpu.parallel import mesh as mesh_lib
+    from distribuuuu_tpu.serve import quantize as qlib
+
+    rng = np.random.default_rng(0)
+    rows = []
+    for arch in FRONTIER_ARCHS:
+        config.reset_cfg()
+        cfg.MODEL.ARCH = arch
+        cfg.MODEL.NUM_CLASSES = NUM_CLASSES
+        cfg.TRAIN.IM_SIZE = IM_SIZE
+        for axis, default in (("DATA", -1), ("MODEL", 1), ("SEQ", 1),
+                              ("PIPE", 1), ("EXPERT", 1)):
+            cfg.MESH[axis] = default
+        mesh = mesh_lib.build_mesh()
+        model = trainer.build_model_from_cfg()
+        state = trainer.create_train_state(
+            model, jax.random.key(0), mesh, IM_SIZE
+        )
+        variables = {"params": state.params}
+        if getattr(state, "batch_stats", None):
+            variables["batch_stats"] = state.batch_stats
+        images = rng.standard_normal(
+            (8, IM_SIZE, IM_SIZE, 3)
+        ).astype(np.float32)
+        for mode in ("bf16", "int8"):
+            row = qlib.quantized_delta(model, variables, images, mode)
+            row["model"] = arch
+            rows.append(row)
+            log(f"quantized {arch}/{mode}: rel_delta "
+                f"{row['rel_logits_delta']} (tol {row['tolerance']}) "
+                f"ok={row['ok']}")
+    return rows
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--campaign", action="append", default=None,
+                    help="campaign YAML (repeatable; default: "
+                         "config/campaigns/*.yaml)")
+    ap.add_argument("--out", default=None, help="artifact JSON path")
+    ap.add_argument("--work", default=None, help="work dir (default: tmp)")
+    ap.add_argument("--round", type=int, default=1)
+    ap.add_argument("--no-frontier", action="store_true")
+    ap.add_argument("--no-quantized", action="store_true")
+    args = ap.parse_args(argv)
+
+    def log(msg):
+        print(f"[serve_campaign] {msg}", flush=True)
+
+    root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    paths = args.campaign or sorted(
+        glob.glob(os.path.join(root, "config", "campaigns", "*.yaml"))
+    )
+    work = args.work or tempfile.mkdtemp(prefix="serve_campaign_")
+    log(f"work dir {work}")
+
+    from distribuuuu_tpu.telemetry import spans
+
+    spans.setup_telemetry(os.path.join(work, "telemetry"), rank=0)
+
+    campaigns = [run_campaign(p, work, log) for p in paths]
+    frontier = [] if args.no_frontier else measure_frontier(work, log)
+    quantized = [] if args.no_quantized else quantized_report(log)
+
+    ok = (
+        all(c["ok"] for c in campaigns)
+        and all(q["ok"] for q in quantized)
+    )
+    artifact = {
+        "schema": 1,
+        "generated_by": "tools/serve_campaign.py",
+        "round": args.round,
+        "cpu_count": os.cpu_count(),
+        "im_size": IM_SIZE,
+        "campaigns": campaigns,
+        "frontier": frontier,
+        "quantized": quantized,
+        "ok": ok,
+    }
+    spans.close_telemetry()
+    out = args.out or os.path.join(root, f"SERVE_CAMPAIGN_r{args.round:02d}.json")
+    with open(out, "w") as f:
+        json.dump(artifact, f, indent=1, sort_keys=True)
+        f.write("\n")
+    log(f"wrote {out} ok={ok}")
+    return 0 if ok else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -52,6 +52,12 @@ def main():
         help="certify the shipped YAML configs in DIR (default: config/) "
              "instead of bare registry defaults",
     )
+    ap.add_argument(
+        "--quantize", default=None, metavar="MODE", choices=("bf16", "int8"),
+        help="instead of a step, pin the serving quantization accuracy "
+             "delta: quantize each arch's weights (serve/quantize.py) and "
+             "check the relative logits delta against the mode's tolerance",
+    )
     args = ap.parse_args()
 
     import distribuuuu_tpu.config as config
@@ -130,6 +136,29 @@ def main():
                     ).astype(np.int32),
                     "mask": np.ones((args.batch,), np.float32),
                 })
+            if args.quantize:
+                if cfg.MODEL.ARCH.startswith("gpt"):
+                    print(f"  skip {label:<30}  (quantized serving is the "
+                          "image engine's path)", flush=True)
+                    continue
+                from distribuuuu_tpu.serve import quantize as quantize_lib
+
+                variables = {"params": state.params}
+                if state.batch_stats:
+                    variables["batch_stats"] = state.batch_stats
+                rep = quantize_lib.quantized_delta(
+                    model, variables,
+                    jnp.asarray(batch["image"]), args.quantize,
+                )
+                dt = time.perf_counter() - t0
+                ok = rep["ok"]
+                if not ok:
+                    failures.append(label)
+                print(f"  {'ok ' if ok else 'FAIL'} {label:<30} {dt:6.1f}s  "
+                      f"{args.quantize} rel_delta {rep['rel_logits_delta']:.4f} "
+                      f"(tol {rep['tolerance']:g}, top1_agree "
+                      f"{rep['top1_agree']:.2f})", flush=True)
+                continue
             if args.train_step:
                 step = trainer.make_train_step(
                     model, construct_optimizer(), topk=5
