@@ -383,6 +383,48 @@ _C.GENERATE.EOS_ID = 256
 # Scheduler admission poll (seconds) while decode slots are free.
 _C.GENERATE.POLL_S = 0.002
 
+# ------------------------------- sampling -----------------------------------
+# Decode-time token selection (lm/generate.sample_token). The default is
+# greedy (TEMPERATURE=0.0 ⇒ argmax, the pre-ISSUE-17 behaviour, and what
+# the speculative greedy-identity pin runs against). Any sampled stream
+# is REPLAYABLE: selection uses counter-based uniforms keyed on
+# (SEED, stream, decision-index), never a stateful RNG, so the same seed
+# in the ctrl frame reproduces the same token stream bit-for-bit on any
+# replica regardless of batching — the serving-side twin of the
+# (seed, epoch, idx) augmentation invariant.
+_C.GENERATE.SAMPLE = CfgNode()
+# 0.0 = greedy argmax (deterministic, ignores TOP_K/TOP_P/SEED).
+# > 0 scales logits by 1/T before the softmax.
+_C.GENERATE.SAMPLE.TEMPERATURE = 0.0
+# Keep only the k highest-probability tokens (0 = off).
+_C.GENERATE.SAMPLE.TOP_K = 0
+# Nucleus sampling: keep the minimal prefix of the probability-sorted
+# vocab with cumulative mass >= TOP_P (1.0 = off).
+_C.GENERATE.SAMPLE.TOP_P = 1.0
+# Default replay seed when a request carries none.
+_C.GENERATE.SAMPLE.SEED = 0
+
+# ----------------------------- speculative decode ---------------------------
+# Draft-model speculation (lm/generate.py, ISSUE 17): a small draft
+# model proposes SPECULATE.K tokens per round; the target verifies all K
+# in ONE prefill-shaped call through the existing cache tiles (the
+# roofline-native fix — decode is memory-bound, so K verify positions
+# cost barely more than 1). Standard accept/reject + bonus-token rule:
+# the emitted distribution is IDENTICAL to target-only decoding (greedy:
+# exact token match for any draft; sampled: same seed ⇒ same stream).
+_C.GENERATE.SPECULATE = CfgNode()
+_C.GENERATE.SPECULATE.ENABLED = False
+# Draft arch (a gpt_* zoo name, e.g. gpt_nano drafting for gpt_nano_moe).
+# Must share the target's tokenizer identity + vocab (validated with the
+# exact values in-message at engine build).
+_C.GENERATE.SPECULATE.DRAFT_ARCH = ""
+# Optional draft checkpoint (same restore path as MODEL.WEIGHTS).
+_C.GENERATE.SPECULATE.DRAFT_WEIGHTS = ""
+# Tokens proposed per round. Each round may append up to K+1 tokens, so
+# the largest cache tile must hold PROMPT_LEN + MAX_NEW_TOKENS + K
+# (validated with the sum named in-message).
+_C.GENERATE.SPECULATE.K = 4
+
 # ------------------------------- kernel tier ---------------------------------
 # The Pallas kernel tier (ops/pallas/, ISSUE 13): hand-fused kernels for
 # the memory-bound regions the cost ledger pinned, each behind its own

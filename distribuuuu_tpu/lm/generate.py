@@ -45,6 +45,7 @@ to the full teacher-forced ``GPT.__call__`` forward — the test
 
 from __future__ import annotations
 
+import dataclasses
 import functools
 import threading
 import time
@@ -55,6 +56,7 @@ import flax.linen as nn
 import jax
 import jax.numpy as jnp
 import numpy as np
+from jax.sharding import NamedSharding, PartitionSpec as P
 
 from distribuuuu_tpu.config import cfg
 from distribuuuu_tpu.models.layers import Dense, head_dtype
@@ -309,6 +311,164 @@ def validate_generate_cfg(seq_len: int, prompt_len: int, max_new: int,
     return batch_tiles, cache_tiles
 
 
+# --------------------------------------------------------------- sampling
+#
+# Decode-time token selection (ISSUE 17b). Greedy (temperature <= 0) is
+# argmax and draws NO randomness — the pre-17 behaviour, bit-for-bit.
+# Sampled selection is REPLAYABLE by construction: every random decision
+# consumes exactly one counter-based uniform ``_uniform(seed, stream, n)``
+# where ``n`` is a per-request per-stream draw counter — never a stateful
+# RNG — so the same ctrl-frame seed reproduces the same token stream on
+# any replica regardless of how requests were batched (the serving-side
+# twin of the (seed, epoch, idx) augmentation invariant).
+
+# uniform streams: one lane per decision kind, so the plain-decode,
+# acceptance, draft-proposal and residual-resample draws of one request
+# never collide
+_U_PLAIN, _U_ACCEPT, _U_DRAFT, _U_RESID = 0, 1, 2, 3
+
+
+@dataclasses.dataclass(frozen=True)
+class SampleParams:
+    """Per-request selection knobs (``GENERATE.SAMPLE`` defaults; the
+    ``op="generate"`` ctrl frame may override all four per request)."""
+
+    temperature: float = 0.0
+    top_k: int = 0
+    top_p: float = 1.0
+    seed: int = 0
+
+    @property
+    def greedy(self) -> bool:
+        return self.temperature <= 0.0
+
+
+def validate_sample_cfg(temperature: float, top_k: int, top_p: float):
+    """The GENERATE.SAMPLE refusals (exact values in-message)."""
+    if temperature < 0.0:
+        raise ValueError(
+            f"GENERATE.SAMPLE.TEMPERATURE={temperature} must be >= 0 "
+            "(0 = greedy argmax)"
+        )
+    if top_k < 0:
+        raise ValueError(
+            f"GENERATE.SAMPLE.TOP_K={top_k} must be >= 0 (0 = disabled)"
+        )
+    if not 0.0 < top_p <= 1.0:
+        raise ValueError(
+            f"GENERATE.SAMPLE.TOP_P={top_p} must lie in (0, 1] "
+            "(1.0 = disabled)"
+        )
+
+
+def sample_params(obj: SampleParams | dict | None = None) -> SampleParams:
+    """Resolve request-side sampling knobs: a :class:`SampleParams`
+    passes through, a dict (the ctrl-frame fields) overlays the
+    ``GENERATE.SAMPLE`` defaults, ``None`` IS the defaults. Validated."""
+    if isinstance(obj, SampleParams):
+        sp = obj
+    else:
+        d = dict(obj or {})
+        node = cfg.GENERATE.SAMPLE
+        sp = SampleParams(
+            temperature=float(d.get("temperature", node.TEMPERATURE)),
+            top_k=int(d.get("top_k", node.TOP_K)),
+            top_p=float(d.get("top_p", node.TOP_P)),
+            seed=int(d.get("seed", node.SEED)),
+        )
+    validate_sample_cfg(sp.temperature, sp.top_k, sp.top_p)
+    return sp
+
+
+def _uniform(seed: int, stream: int, n: int) -> float:
+    """The (seed, stream, n) → [0, 1) uniform every sampled decision
+    consumes: a fresh Philox generator per draw, so draw ``n`` is a pure
+    function of its coordinates and replay needs no RNG state carry."""
+    return float(
+        np.random.default_rng(
+            [int(seed) % (2 ** 63), int(stream), int(n)]
+        ).random()
+    )
+
+
+def warp_probs(logits, sp: SampleParams) -> np.ndarray:
+    """Temperature / top-k / top-p warped probabilities of ONE logit row
+    (float64 numpy, ties broken by vocab id) — the single distribution
+    both plain sampling and the speculative accept/reject rule read."""
+    x = np.asarray(logits, np.float64) / float(sp.temperature)
+    if sp.top_k and sp.top_k < x.size:
+        # keep everything >= the k-th largest logit (ties keep extras —
+        # deterministic, and renormalization absorbs them)
+        x = np.where(x >= np.sort(x)[-sp.top_k], x, -np.inf)
+    x = x - x.max()
+    p = np.exp(x)
+    p /= p.sum()
+    if sp.top_p < 1.0:
+        # minimal probability-sorted prefix with cumulative mass >= top_p
+        order = np.argsort(-p, kind="stable")
+        cut = int(np.searchsorted(np.cumsum(p[order]), sp.top_p)) + 1
+        keep = order[:cut]
+        masked = np.zeros_like(p)
+        masked[keep] = p[keep]
+        p = masked / masked.sum()
+    return p
+
+
+def _pick(p: np.ndarray, u: float) -> int:
+    """Inverse-CDF selection in vocab-id order — deterministic in
+    ``(p, u)``, always lands on a positive-mass token."""
+    cum = np.cumsum(p)
+    return int(min(np.searchsorted(cum, u * cum[-1], side="right"),
+                   p.size - 1))
+
+
+def sample_token(logits, sp: SampleParams, u: float | None = None) -> int:
+    """One token from one logit row: greedy argmax when
+    ``sp.temperature <= 0`` (``u`` unused), else inverse-CDF over the
+    warped distribution with the caller-supplied uniform."""
+    if sp.greedy:
+        return int(np.asarray(logits).argmax())
+    return _pick(warp_probs(logits, sp), u)
+
+
+def validate_speculate_cfg(k: int, target_model, draft_model,
+                           prompt_len: int, max_new: int,
+                           cache_tiles: list[int]):
+    """The GENERATE.SPECULATE refusals, exact arithmetic in-message
+    (ISSUE 17 satellite): draft/target pairing and draft-K cache-tile
+    headroom — a speculative round may write K+1 positions past the
+    current length, so the largest cache tile needs K more rows than the
+    plain-decode bound."""
+    if k < 1:
+        raise ValueError(f"GENERATE.SPECULATE.K={k} must be >= 1")
+    tv, dv = int(target_model.vocab_size), int(draft_model.vocab_size)
+    if tv != dv:
+        raise ValueError(
+            f"GENERATE.SPECULATE draft/target vocab mismatch: draft "
+            f"vocab_size={dv} != target vocab_size={tv} — the accept/"
+            "reject rule compares the two distributions token by token, "
+            "which is undefined across vocabularies"
+        )
+    need = prompt_len + max_new + k
+    if cache_tiles[-1] < need:
+        raise ValueError(
+            f"largest GENERATE.CACHE_TILES entry {cache_tiles[-1]} cannot "
+            f"hold a speculative round: GENERATE.PROMPT_LEN={prompt_len} + "
+            f"MAX_NEW_TOKENS={max_new} + SPECULATE.K={k} = {need} cached "
+            f"positions — raise CACHE_TILES to >= {need} or lower "
+            "K/MAX_NEW_TOKENS/PROMPT_LEN"
+        )
+    ds = int(draft_model.seq_len)
+    if cache_tiles[-1] > ds:
+        raise ValueError(
+            f"GENERATE.CACHE_TILES largest entry {cache_tiles[-1]} exceeds "
+            f"the draft model's trained context LM.SEQ_LEN={ds}: the draft "
+            "mirrors every cached position and its learned position table "
+            "has no entry past that — use a draft trained for the context "
+            "or lower the cache tiles"
+        )
+
+
 # -------------------------------------------------------------- the engine
 
 
@@ -375,23 +535,47 @@ class GenStream:
 
 
 class _Slot:
-    __slots__ = ("stream", "length", "last_token", "new_tokens", "max_new")
+    __slots__ = ("stream", "length", "last_token", "new_tokens", "max_new",
+                 "sample", "draws", "draft_len", "history")
 
-    def __init__(self, stream, length, last_token, max_new):
+    def __init__(self, stream, length, last_token, max_new, sample):
         self.stream = stream
         self.length = length          # cached positions (prompt + generated-1)
         self.last_token = last_token  # feeds the next decode step
         self.new_tokens = 0
         self.max_new = max_new
+        self.sample = sample          # SampleParams for this request
+        self.draws = [0, 0, 0, 0]     # per-stream uniform draw counters
+        # speculative bookkeeping: token at every position 0..length (the
+        # last entry is ``last_token``, not yet cached) and how many
+        # positions the DRAFT cache holds (it can trail the target by one
+        # after a fully-accepted round)
+        self.draft_len = 0
+        self.history: list[int] = []
 
 
 class GenerateEngine:
-    """Continuous-batching generation over one device.
+    """Continuous-batching generation over one device — or one dp×tp
+    replica (``mesh=``, ISSUE 17a): with a model axis > 1 the param tree
+    is placed by the SAME ``lm_spec_table`` rules that place training
+    state (the decoder mirrors the training module names), the paged
+    cache shards its heads on ``model`` (``specs.lm_cache_spec``), and
+    the head stays vocab-parallel inside each executable with logits
+    gathered at the output — pinned logit-identical to the single-device
+    path.
 
     ``variables`` is ``{"params": ...}`` — the TRAINING param tree (no
     batch_stats: the LM is LayerNorm-only). All tile executables compile
     AOT at construction; ``start()`` runs the scheduler thread; ``submit``
     returns a :class:`GenStream`.
+
+    ``draft_model``/``draft_variables`` switch on speculative decoding
+    (ISSUE 17c): the draft proposes ``spec_k`` tokens per round, the
+    target verifies all of them in ONE prefill-shaped call, and the
+    standard accept/reject + bonus rule keeps the emitted stream
+    IDENTICAL to target-only decoding (greedy: exact match for ANY
+    draft; sampled: same seed ⇒ same stream as the acceptance-rule
+    reference).
     """
 
     def __init__(
@@ -407,6 +591,11 @@ class GenerateEngine:
         max_queue: int | None = None,
         poll_s: float | None = None,
         emit_interval_s: float = 10.0,
+        mesh=None,
+        draft_model=None,
+        draft_variables: dict | None = None,
+        spec_k: int | None = None,
+        sample: SampleParams | dict | None = None,
     ):
         self.model = model
         self.decoder = decoder_for(model)
@@ -457,6 +646,51 @@ class GenerateEngine:
         self.prompt_tiles = [
             t for t in default_tiles(self.prompt_len)
         ]
+        self._default_sample = sample_params(sample)
+
+        # -- tensor-parallel decode (ISSUE 17a) ---------------------------
+        self._mesh = None
+        self._tp = 1
+        if mesh is not None and int(dict(mesh.shape).get("model", 1)) > 1:
+            tp = int(dict(mesh.shape)["model"])
+            if model.num_heads % tp:
+                raise ValueError(
+                    f"MESH.MODEL={tp} does not divide the LM's num_heads="
+                    f"{model.num_heads} ({model.num_heads} % {tp} = "
+                    f"{model.num_heads % tp}) — TP decode shards attention "
+                    "heads (and the cache's head dim) over the model axis"
+                )
+            if model.vocab_size % tp:
+                raise ValueError(
+                    f"MESH.MODEL={tp} does not divide vocab_size="
+                    f"{model.vocab_size} ({model.vocab_size} % {tp} = "
+                    f"{model.vocab_size % tp}) — the vocab-parallel head "
+                    "splits logits over the model axis"
+                )
+            self._mesh = mesh
+            self._tp = tp
+
+        # -- speculative decoding (ISSUE 17c) -----------------------------
+        self.spec_k = 0
+        if draft_model is not None:
+            k = int(spec_k if spec_k is not None else cfg.GENERATE.SPECULATE.K)
+            validate_speculate_cfg(
+                k, model, draft_model, self.prompt_len, self.max_new,
+                self.cache_tiles,
+            )
+            if self._mesh is not None and draft_model.num_heads % self._tp:
+                raise ValueError(
+                    f"MESH.MODEL={self._tp} does not divide the DRAFT "
+                    f"model's num_heads={draft_model.num_heads} "
+                    f"({draft_model.num_heads} % {self._tp} = "
+                    f"{draft_model.num_heads % self._tp}) — the draft "
+                    "shards its heads over the same model axis"
+                )
+            self.spec_k = k
+            self.draft_model = draft_model
+            self.draft_decoder = decoder_for(draft_model)
+            self._draft_variables = {"params": draft_variables["params"]}
+
         self.n_slots = self.batch_tiles[-1]
         self._admission = AdmissionController(
             max_queue if max_queue is not None else cfg.SERVE.MAX_QUEUE
@@ -466,6 +700,37 @@ class GenerateEngine:
         self._heads = model.num_heads
         self._head_dim = model.dim // model.num_heads
         self._depth = model.depth
+        if self.spec_k:
+            dm = self.draft_model
+            self._d_dtype = dm.dtype
+            self._d_heads = dm.num_heads
+            self._d_head_dim = dm.dim // dm.num_heads
+            self._d_depth = dm.depth
+
+        # TP placement: params by the lm_spec_table path rules (the
+        # decoder tree IS the training tree), cache heads on ``model``.
+        # On a dp×tp mesh the data axis appears in no decode spec — a
+        # replica's whole request stream is replicated over dp.
+        if self._mesh is not None:
+            from distribuuuu_tpu.parallel.partition import specs as pspecs
+
+            self._cache_sharding = NamedSharding(
+                self._mesh, pspecs.lm_cache_spec()
+            )
+            self._rep_sharding = NamedSharding(self._mesh, P())
+            self._var_shardings = pspecs.lm_decode_shardings(
+                self._mesh, self._variables
+            )
+            self._variables = jax.device_put(
+                self._variables, self._var_shardings
+            )
+            if self.spec_k:
+                self._draft_var_shardings = pspecs.lm_decode_shardings(
+                    self._mesh, self._draft_variables
+                )
+                self._draft_variables = jax.device_put(
+                    self._draft_variables, self._draft_var_shardings
+                )
 
         # -- AOT compile every tile shape, exactly once, at startup -------
         # (the serve-engine bucket discipline generalized to 2D tiles)
@@ -474,7 +739,15 @@ class GenerateEngine:
         self._prefill_exec: dict[int, Any] = {}
         self._insert_exec: dict[tuple[int, int, int], Any] = {}
         self._grow_exec: dict[tuple, Any] = {}
+        self._verify_exec: dict[tuple[int, int], Any] = {}
+        self._draft_decode_exec: dict[tuple[int, int], Any] = {}
+        self._draft_propose_exec: dict[tuple[int, int, int], Any] = {}
+        self._draft_prefill_exec: dict[int, Any] = {}
+        self._draft_insert_exec: dict[tuple[int, int, int], Any] = {}
+        self._draft_grow_exec: dict[tuple, Any] = {}
         self._compile_tiles()
+        if self.spec_k:
+            self._compile_draft_tiles()
 
         # -- live state ----------------------------------------------------
         self._lock = threading.Condition()
@@ -483,6 +756,10 @@ class GenerateEngine:
         self._b_tile = self.batch_tiles[0]
         self._c_tile = self.cache_tiles[0]
         self._cache = self._zero_cache(self._b_tile, self._c_tile)
+        if self.spec_k:
+            self._draft_cache = self._zero_cache(
+                self._b_tile, self._c_tile, draft=True
+            )
         self._draining = False
         self._started = False
         self._next_id = 0
@@ -491,6 +768,11 @@ class GenerateEngine:
             "prompt_tokens": 0, "new_tokens": 0, "decode_steps": 0,
             "requests": 0, "retired": 0,
         }
+        if self.spec_k:
+            self._counters.update(
+                spec_rounds=0, spec_proposed=0, spec_accepted=0,
+                spec_bonus=0,
+            )
         self._decode_ms: deque = deque(maxlen=4096)
         self._prefill_ms: deque = deque(maxlen=1024)
         self._thread = threading.Thread(
@@ -498,12 +780,55 @@ class GenerateEngine:
         )
 
     # ------------------------------------------------------------ compiles
-    def _cache_sds(self, b: int, c: int):
-        shape = (self._depth, b, self._heads, c, self._head_dim)
+    def _cache_dims(self, draft: bool) -> tuple:
+        if draft:
+            return (self._d_depth, self._d_heads, self._d_head_dim,
+                    self._d_dtype)
+        return (self._depth, self._heads, self._head_dim, self._dtype)
+
+    def _cache_sds(self, b: int, c: int, *, draft: bool = False):
+        depth, heads, hdim, dtype = self._cache_dims(draft)
+        shape = (depth, b, heads, c, hdim)
+        kw = {} if self._mesh is None else {"sharding": self._cache_sharding}
         return {
-            "k": jax.ShapeDtypeStruct(shape, self._dtype),
-            "v": jax.ShapeDtypeStruct(shape, self._dtype),
+            "k": jax.ShapeDtypeStruct(shape, dtype, **kw),
+            "v": jax.ShapeDtypeStruct(shape, dtype, **kw),
         }
+
+    def _tok_sds(self, shape):
+        kw = {} if self._mesh is None else {"sharding": self._rep_sharding}
+        return jax.ShapeDtypeStruct(shape, jnp.int32, **kw)
+
+    def _vars_sds(self, variables, shardings):
+        if self._mesh is None:
+            return jax.tree.map(
+                lambda x: jax.ShapeDtypeStruct(jnp.shape(x), x.dtype),
+                variables,
+            )
+        return jax.tree.map(
+            lambda x, s: jax.ShapeDtypeStruct(
+                jnp.shape(x), x.dtype, sharding=s
+            ),
+            variables, shardings,
+        )
+
+    def _jit(self, fn, *, donate=()):
+        """jax.jit with the TP output contract pinned when a mesh is
+        live: logits gathered (replicated — the 'gathered argmax/sample'
+        happens at executable exit), cache outputs kept head-sharded.
+        Without a mesh this is plain jit (the single-device path,
+        byte-identical to pre-TP behaviour)."""
+        if self._mesh is None:
+            return jax.jit(fn, donate_argnums=donate)
+        name = getattr(fn, "func", fn).__name__
+        cs, rs = self._cache_sharding, self._rep_sharding
+        cdict = {"k": cs, "v": cs}
+        # decode/verify/prefill return (logits, cache); insert/grow the
+        # cache alone
+        outs = (rs, cdict) if name in (
+            "decode_fn", "verify_fn", "prefill_fn"
+        ) else cdict
+        return jax.jit(fn, donate_argnums=donate, out_shardings=outs)
 
     def _compile_tiles(self) -> None:
         from distribuuuu_tpu.serve.engine import COMPILE_EVENTS
@@ -514,17 +839,24 @@ class GenerateEngine:
             )
             return logits[:, 0], cache
 
+        def verify_fn(variables, tokens, lengths, cache):
+            # ONE prefill-shaped call over [last_token, d_1..d_K]: logits
+            # at all K+1 positions for the accept/reject rule — the
+            # memory-bound decode's roofline-native batching (K+1 target
+            # positions for barely more HBM traffic than 1)
+            return self.decoder.apply(variables, tokens, lengths, cache)
+
         def prefill_fn(variables, tokens):
             # fresh page: the prompt's K/V builds in a zeros cache sized
             # exactly to the prompt tile; insert_fn pages it into the slot
-            B, P = tokens.shape
+            B, Pt = tokens.shape
             zero = {
                 "k": jnp.zeros(
-                    (self._depth, B, self._heads, P, self._head_dim),
+                    (self._depth, B, self._heads, Pt, self._head_dim),
                     self._dtype,
                 ),
                 "v": jnp.zeros(
-                    (self._depth, B, self._heads, P, self._head_dim),
+                    (self._depth, B, self._heads, Pt, self._head_dim),
                     self._dtype,
                 ),
             }
@@ -547,24 +879,32 @@ class GenerateEngine:
 
             return jax.tree.map(pad, cache)
 
-        vars_sds = jax.tree.map(
-            lambda x: jax.ShapeDtypeStruct(jnp.shape(x), x.dtype),
-            self._variables,
+        vars_sds = self._vars_sds(
+            self._variables, getattr(self, "_var_shardings", None)
         )
-        tok1 = functools.partial(jax.ShapeDtypeStruct, dtype=jnp.int32)
+        tok1 = self._tok_sds
         for b in self.batch_tiles:
             for c in self.cache_tiles:
                 self._decode_exec[(b, c)] = (
-                    jax.jit(decode_fn, donate_argnums=(3,))
+                    self._jit(decode_fn, donate=(3,))
                     .lower(vars_sds, tok1((b,)), tok1((b,)),
                            self._cache_sds(b, c))
                     .compile()
                 )
                 self.n_compiles += 1
                 COMPILE_EVENTS.append(b)
+                if self.spec_k:
+                    self._verify_exec[(b, c)] = (
+                        self._jit(verify_fn, donate=(3,))
+                        .lower(vars_sds, tok1((b, self.spec_k + 1)),
+                               tok1((b,)), self._cache_sds(b, c))
+                        .compile()
+                    )
+                    self.n_compiles += 1
+                    COMPILE_EVENTS.append(b)
         for p in self.prompt_tiles:
             self._prefill_exec[p] = (
-                jax.jit(prefill_fn)
+                self._jit(prefill_fn)
                 .lower(vars_sds, tok1((1, p)))
                 .compile()
             )
@@ -575,9 +915,9 @@ class GenerateEngine:
                     if p > c:
                         continue
                     self._insert_exec[(p, b, c)] = (
-                        jax.jit(insert_fn, donate_argnums=(0,))
+                        self._jit(insert_fn, donate=(0,))
                         .lower(self._cache_sds(b, c), self._cache_sds(1, p),
-                               jax.ShapeDtypeStruct((), jnp.int32))
+                               self._tok_sds(()))
                         .compile()
                     )
                     self.n_compiles += 1
@@ -586,7 +926,7 @@ class GenerateEngine:
             for (b2, c2) in tiles:
                 if (b2, c2) != (b1, c1) and b2 >= b1 and c2 >= c1:
                     self._grow_exec[(b1, c1, b2, c2)] = (
-                        jax.jit(functools.partial(grow_fn, b=b2, c=c2))
+                        self._jit(functools.partial(grow_fn, b=b2, c=c2))
                         .lower(self._cache_sds(b1, c1))
                         .compile()
                     )
@@ -612,12 +952,157 @@ class GenerateEngine:
                     images=1, arch=cfg.MODEL.ARCH,
                 )
 
-    def _zero_cache(self, b: int, c: int):
-        shape = (self._depth, b, self._heads, c, self._head_dim)
-        return {
-            "k": jnp.zeros(shape, self._dtype),
-            "v": jnp.zeros(shape, self._dtype),
+    def _compile_draft_tiles(self) -> None:
+        """The draft model's mirror of the target tile set: T=1 decode
+        per (batch, cache) tile (the K proposal steps), prefill + insert
+        per prompt tile (the draft caches the prompt at admit), grow per
+        tile pair — so a speculative round never recompiles either
+        model."""
+        from distribuuuu_tpu.serve.engine import COMPILE_EVENTS
+
+        def draft_decode_fn(variables, tokens, lengths, cache):
+            logits, cache = self.draft_decoder.apply(
+                variables, tokens[:, None], lengths, cache
+            )
+            return logits[:, 0], cache
+
+        def draft_propose_fn(variables, feed, lags, lens0, cache):
+            # the whole greedy propose phase in ONE executable: a scan
+            # over the round's S draft steps with argmax feedback, so a
+            # speculative round costs 2 device calls (propose + verify)
+            # instead of K+2. The K-1 intermediate host syncs it deletes
+            # cost ~0.5 ms each on CPU — more than a nano draft step.
+            # Step s feeds history (the feed matrix) while s <= lag, the
+            # previous step's argmax after; exactly the per-step loop's
+            # catch-up rule. Sampled slots never take this path: their
+            # proposals are drawn host-side in float64 (the replay
+            # contract), one decode step at a time.
+            def step(carry, xs):
+                cache, prev = carry
+                f, s = xs
+                tok = jnp.where(s <= lags, f, prev)
+                logits, cache = self.draft_decoder.apply(
+                    variables, tok[:, None], lens0 + s, cache
+                )
+                out = jnp.argmax(logits[:, 0], axis=-1).astype(jnp.int32)
+                return (cache, out), out
+
+            S = feed.shape[1]
+            xs = (feed.T, jnp.arange(S, dtype=jnp.int32))
+            (cache, _), outs = jax.lax.scan(
+                step, (cache, jnp.zeros_like(lens0)), xs
+            )
+            return outs, cache  # [S, b] per-step argmaxes
+
+        def draft_prefill_fn(variables, tokens):
+            B, Pt = tokens.shape
+            zero = {
+                "k": jnp.zeros(
+                    (self._d_depth, B, self._d_heads, Pt, self._d_head_dim),
+                    self._d_dtype,
+                ),
+                "v": jnp.zeros(
+                    (self._d_depth, B, self._d_heads, Pt, self._d_head_dim),
+                    self._d_dtype,
+                ),
+            }
+            lengths = jnp.zeros((B,), jnp.int32)
+            return self.draft_decoder.apply(variables, tokens, lengths, zero)
+
+        def draft_insert_fn(cache, kv, slot):
+            return jax.tree.map(
+                lambda c, n: jax.lax.dynamic_update_slice(
+                    c, n, (0, slot, 0, 0, 0)
+                ),
+                cache, kv,
+            )
+
+        def draft_grow_fn(cache, b, c):
+            def pad(x):
+                db = b - x.shape[1]
+                dc = c - x.shape[3]
+                return jnp.pad(x, ((0, 0), (0, db), (0, 0), (0, dc), (0, 0)))
+
+            return jax.tree.map(pad, cache)
+
+        # the TP output contract matches the target's executables: logits
+        # gathered, cache head-sharded (_jit keys on the fn name)
+        draft_decode_fn.__name__ = "decode_fn"
+        draft_propose_fn.__name__ = "decode_fn"  # (tokens, cache) out pair
+        draft_prefill_fn.__name__ = "prefill_fn"
+
+        vars_sds = self._vars_sds(
+            self._draft_variables, getattr(self, "_draft_var_shardings", None)
+        )
+        tok1 = self._tok_sds
+        n0 = self.n_compiles
+        for b in self.batch_tiles:
+            for c in self.cache_tiles:
+                self._draft_decode_exec[(b, c)] = (
+                    self._jit(draft_decode_fn, donate=(3,))
+                    .lower(vars_sds, tok1((b,)), tok1((b,)),
+                           self._cache_sds(b, c, draft=True))
+                    .compile()
+                )
+                self.n_compiles += 1
+                COMPILE_EVENTS.append(b)
+                # a round runs K steps (every draft cache caught up) or
+                # K+1 (some slot one behind after a fully-accepted
+                # round) — the only two lags the reconciliation rule can
+                # leave, so two static shapes cover every greedy round
+                for S in (self.spec_k, self.spec_k + 1):
+                    self._draft_propose_exec[(b, c, S)] = (
+                        self._jit(draft_propose_fn, donate=(4,))
+                        .lower(vars_sds, tok1((b, S)), tok1((b,)),
+                               tok1((b,)),
+                               self._cache_sds(b, c, draft=True))
+                        .compile()
+                    )
+                    self.n_compiles += 1
+                    COMPILE_EVENTS.append(b)
+        for p in self.prompt_tiles:
+            self._draft_prefill_exec[p] = (
+                self._jit(draft_prefill_fn)
+                .lower(vars_sds, tok1((1, p)))
+                .compile()
+            )
+            self.n_compiles += 1
+            for b in self.batch_tiles:
+                for c in self.cache_tiles:
+                    if p > c:
+                        continue
+                    self._draft_insert_exec[(p, b, c)] = (
+                        self._jit(draft_insert_fn, donate=(0,))
+                        .lower(self._cache_sds(b, c, draft=True),
+                               self._cache_sds(1, p, draft=True),
+                               self._tok_sds(()))
+                        .compile()
+                    )
+                    self.n_compiles += 1
+        tiles = [(b, c) for b in self.batch_tiles for c in self.cache_tiles]
+        for (b1, c1) in tiles:
+            for (b2, c2) in tiles:
+                if (b2, c2) != (b1, c1) and b2 >= b1 and c2 >= c1:
+                    self._draft_grow_exec[(b1, c1, b2, c2)] = (
+                        self._jit(functools.partial(draft_grow_fn, b=b2, c=c2))
+                        .lower(self._cache_sds(b1, c1, draft=True))
+                        .compile()
+                    )
+                    self.n_compiles += 1
+        telemetry_registry.get_registry().counter(
+            "serve.aot_compiles"
+        ).inc(self.n_compiles - n0)
+
+    def _zero_cache(self, b: int, c: int, *, draft: bool = False):
+        depth, heads, hdim, dtype = self._cache_dims(draft)
+        shape = (depth, b, heads, c, hdim)
+        z = {
+            "k": jnp.zeros(shape, dtype),
+            "v": jnp.zeros(shape, dtype),
         }
+        if self._mesh is not None:
+            z = jax.device_put(z, self._cache_sharding)
+        return z
 
     # ------------------------------------------------------- client surface
     def start(self) -> "GenerateEngine":
@@ -631,10 +1116,17 @@ class GenerateEngine:
     def __exit__(self, *exc) -> None:
         self.drain()
 
-    def submit(self, prompt, max_new_tokens: int | None = None) -> GenStream:
+    def submit(self, prompt, max_new_tokens: int | None = None,
+               sample: SampleParams | dict | None = None) -> GenStream:
         """Enqueue one prompt (iterable of token ids). Returns the token
         stream. Raises ``QueueFullError``/``EngineClosedError`` like the
-        image engine's admission contract."""
+        image engine's admission contract. ``sample`` overrides the
+        engine's default :class:`SampleParams` for this request (the
+        ctrl-frame temperature/top_k/top_p/seed fields land here)."""
+        sp = (
+            self._default_sample if sample is None
+            else sample_params(sample)
+        )
         ids = np.asarray(list(prompt), np.int32)
         if ids.ndim != 1 or len(ids) < 1:
             raise ValueError("prompt must be a non-empty 1-D token list")
@@ -655,7 +1147,7 @@ class GenerateEngine:
             self._admission.admit(len(self._waiting), self._retry_after_ms())
             stream = GenStream(self._next_id, len(ids))
             self._next_id += 1
-            self._waiting.append((stream, ids, max_new))
+            self._waiting.append((stream, ids, max_new, sp))
             self._counters["requests"] += 1
             self._lock.notify_all()
         return stream
@@ -675,7 +1167,7 @@ class GenerateEngine:
 
             with self._lock:
                 while self._waiting:
-                    stream, _, _ = self._waiting.popleft()
+                    stream = self._waiting.popleft()[0]
                     stream._close(
                         "drained",
                         EngineClosedError("engine drained before start()"),
@@ -730,12 +1222,14 @@ class GenerateEngine:
         c = tile_for(self.cache_tiles, max(c_need, self._c_tile))
         if (b, c) == (self._b_tile, self._c_tile):
             return
-        self._cache = self._grow_exec[(self._b_tile, self._c_tile, b, c)](
-            self._cache
-        )
+        key = (self._b_tile, self._c_tile, b, c)
+        self._cache = self._grow_exec[key](self._cache)
+        if self.spec_k:
+            self._draft_cache = self._draft_grow_exec[key](self._draft_cache)
         self._b_tile, self._c_tile = b, c
 
-    def _admit(self, stream: GenStream, ids: np.ndarray, max_new: int) -> None:
+    def _admit(self, stream: GenStream, ids: np.ndarray, max_new: int,
+               sp: SampleParams) -> None:
         from distribuuuu_tpu.telemetry import spans
 
         slot = self._free_slot()
@@ -743,22 +1237,34 @@ class GenerateEngine:
         t0 = time.perf_counter()
         plen = len(ids)
         ptile = tile_for(self.prompt_tiles, plen)
-        self._ensure_tile(slot + 1, plen + max_new)
+        self._ensure_tile(slot + 1, plen + max_new + self.spec_k)
         padded = np.zeros((1, ptile), np.int32)
         padded[0, :plen] = ids
         logits, kv = self._prefill_exec[ptile](
             self._variables, jnp.asarray(padded)
         )
-        first = int(np.asarray(logits[0, plen - 1]).argmax())
         self._cache = self._insert_exec[(ptile, self._b_tile, self._c_tile)](
             self._cache, kv, jnp.int32(slot)
         )
-        self._slots[slot] = _Slot(stream, plen, first, max_new)
+        s = _Slot(stream, plen, 0, max_new, sp)
+        first = self._select(s, np.asarray(logits[0, plen - 1]))
+        s.last_token = first
+        s.history = list(int(t) for t in ids) + [first]
+        self._slots[slot] = s
+        if self.spec_k:
+            # the draft mirrors the prompt into its own paged cache
+            _, dkv = self._draft_prefill_exec[ptile](
+                self._draft_variables, jnp.asarray(padded)
+            )
+            self._draft_cache = self._draft_insert_exec[
+                (ptile, self._b_tile, self._c_tile)
+            ](self._draft_cache, dkv, jnp.int32(slot))
+            s.draft_len = plen
         self._counters["prompt_tokens"] += plen
         ms = (time.perf_counter() - t0) * 1e3
         self._prefill_ms.append(ms)
         stream._emit(first)
-        self._slots[slot].new_tokens = 1  # prefill produced token #1
+        s.new_tokens = 1  # prefill produced token #1
         self._counters["new_tokens"] += 1
         if spans.enabled():
             spans.emit_event(
@@ -768,6 +1274,12 @@ class GenerateEngine:
             spans.emit_event(
                 "gen.prefill", tokens=plen, tile=ptile, ms=round(ms, 3),
             )
+            if not sp.greedy:
+                spans.emit_event(
+                    "gen.sample", request=stream.request_id,
+                    temperature=sp.temperature, top_k=sp.top_k,
+                    top_p=sp.top_p, seed=sp.seed,
+                )
         self._maybe_finish(slot, first)
 
     def _retire(self, slot: int, reason: str) -> None:
@@ -796,6 +1308,30 @@ class GenerateEngine:
             return True
         return False
 
+    @staticmethod
+    def _select(s: _Slot, row, stream: int = _U_PLAIN) -> int:
+        """One token off one logit row for slot ``s``: greedy argmax
+        draws nothing; sampled selection consumes the slot's next
+        counter-based uniform on ``stream``."""
+        if s.sample.greedy:
+            return int(np.asarray(row).argmax())
+        u = _uniform(s.sample.seed, stream, s.draws[stream])
+        s.draws[stream] += 1
+        return _pick(warp_probs(row, s.sample), u)
+
+    def _emit_tok(self, i: int, tok: int) -> bool:
+        """Emit one generated token on slot ``i`` (the length/history
+        bookkeeping shared by the plain and speculative paths); returns
+        True if the slot retired."""
+        s = self._slots[i]
+        s.length += 1
+        s.last_token = tok
+        s.history.append(tok)
+        s.new_tokens += 1
+        self._counters["new_tokens"] += 1
+        s.stream._emit(tok)
+        return self._maybe_finish(i, tok)
+
     def _decode_step(self) -> None:
         from distribuuuu_tpu.telemetry import spans
 
@@ -818,18 +1354,185 @@ class GenerateEngine:
         self._decode_ms.append(ms)
         self._counters["decode_steps"] += 1
         for i in live:
-            s = self._slots[i]
-            s.length += 1
-            nxt = int(logits[i].argmax())
-            s.last_token = nxt
-            s.new_tokens += 1
-            self._counters["new_tokens"] += 1
-            s.stream._emit(nxt)
-            self._maybe_finish(i, nxt)
+            self._emit_tok(i, self._select(self._slots[i], logits[i]))
         if spans.enabled():
             spans.emit_event(
                 "gen.decode", active=len(live), tile_b=b,
                 tile_c=self._c_tile, ms=round(ms, 3),
+            )
+
+    def _spec_propose_steps(self, live, props, qrows, steps, b, c) -> None:
+        """Per-step propose path: one draft decode call (and one host
+        sync) per step, with proposals selected host-side in float64.
+        Any sampled slot in the round lands here — the replay contract
+        pins sampled selection to the host's numpy math. All-greedy
+        rounds take the fused propose executable instead."""
+        K = self.spec_k
+        for s_idx in range(steps):
+            tokens = np.zeros((b,), np.int32)
+            lengths = np.zeros((b,), np.int32)
+            for i in live:
+                sl = self._slots[i]
+                pos = sl.draft_len + s_idx  # the position this step feeds
+                if pos <= sl.length:
+                    tokens[i] = sl.history[pos]
+                else:
+                    tokens[i] = props[i][pos - sl.length - 1]
+                lengths[i] = pos
+            dlogits, self._draft_cache = self._draft_decode_exec[(b, c)](
+                self._draft_variables, jnp.asarray(tokens),
+                jnp.asarray(lengths), self._draft_cache,
+            )
+            dlogits = np.asarray(dlogits)
+            for i in live:
+                sl = self._slots[i]
+                if sl.draft_len + s_idx >= sl.length and len(props[i]) < K:
+                    row = dlogits[i]
+                    props[i].append(self._select(sl, row, _U_DRAFT))
+                    if not sl.sample.greedy:
+                        qrows.setdefault(i, []).append(row)
+
+    def _spec_round(self) -> None:
+        """One speculative round over every live slot (ISSUE 17c).
+
+        1. PROPOSE — K batched T=1 draft decode steps sample K proposals
+           per slot from the warped draft distribution (greedy: draft
+           argmax). A slot whose draft cache trails the target by one
+           position (the previous round fully accepted — its d_K was
+           never fed to the draft) catches up inside the same loop: its
+           first step feeds history instead of proposing, and the loop
+           runs one extra step so every slot still proposes K. An
+           all-greedy round runs the whole loop as ONE fused scan
+           executable (argmax feedback on-device); any sampled slot
+           drops the round to the per-step host path, whose float64
+           numpy selection is what the replay contract pins.
+        2. VERIFY — ONE prefill-shaped target call over
+           ``[last_token, d_1..d_K]`` per slot returns target logits at
+           all K+1 positions.
+        3. ACCEPT — per slot, left to right: greedy accepts d_j iff it
+           equals the target argmax; sampled accepts iff
+           ``u·q(d_j) <= p(d_j)`` and resamples a rejected position from
+           the residual ``max(p−q, 0)``. All K accepted ⇒ a bonus token
+           from the (K+1)-th verify row. Rejection costs NOTHING in the
+           cache: stale positions past a slot's length are invisible to
+           the ragged mask and get overwritten by the next write there.
+        """
+        from distribuuuu_tpu.telemetry import spans
+
+        t0 = time.perf_counter()
+        K = self.spec_k
+        live = [i for i, s in enumerate(self._slots) if s is not None]
+        max_len = max(self._slots[i].length for i in live)
+        self._ensure_tile(max(live) + 1, max_len + K + 1)
+        b, c = self._b_tile, self._c_tile
+
+        props: dict[int, list[int]] = {i: [] for i in live}
+        qrows: dict[int, list[np.ndarray]] = {}
+        steps = K + max(
+            self._slots[i].length - self._slots[i].draft_len for i in live
+        )
+        all_greedy = all(self._slots[i].sample.greedy for i in live)
+        if all_greedy and (b, c, steps) in self._draft_propose_exec:
+            # fused propose: all S draft steps in one executable, no
+            # per-step host sync. Proposal j for a slot with lag L is
+            # the argmax out of step L+j (step L both feeds
+            # history[length] and yields proposal #1).
+            feed = np.zeros((b, steps), np.int32)
+            lags = np.zeros((b,), np.int32)
+            lens0 = np.zeros((b,), np.int32)
+            for i in live:
+                sl = self._slots[i]
+                lag = sl.length - sl.draft_len
+                lags[i] = lag
+                lens0[i] = sl.draft_len
+                for s in range(lag + 1):
+                    feed[i, s] = sl.history[sl.draft_len + s]
+            outs, self._draft_cache = self._draft_propose_exec[
+                (b, c, steps)
+            ](
+                self._draft_variables, jnp.asarray(feed),
+                jnp.asarray(lags), jnp.asarray(lens0), self._draft_cache,
+            )
+            outs = np.asarray(outs)
+            for i in live:
+                lag = int(lags[i])
+                props[i] = [int(outs[s, i]) for s in range(lag, lag + K)]
+        else:
+            self._spec_propose_steps(live, props, qrows, steps, b, c)
+
+        tokens = np.zeros((b, K + 1), np.int32)
+        lengths = np.zeros((b,), np.int32)
+        for i in live:
+            sl = self._slots[i]
+            tokens[i, 0] = sl.last_token
+            tokens[i, 1:] = props[i]
+            lengths[i] = sl.length
+        vlogits, self._cache = self._verify_exec[(b, c)](
+            self._variables, jnp.asarray(tokens), jnp.asarray(lengths),
+            self._cache,
+        )
+        vlogits = np.asarray(vlogits)  # [b, K+1, V]
+
+        n_acc = n_bonus = 0
+        for i in live:
+            sl = self._slots[i]
+            old_draft_len = sl.draft_len
+            for j in range(K):
+                d = int(props[i][j])
+                trow = vlogits[i, j]
+                if sl.sample.greedy:
+                    tgt = int(trow.argmax())
+                    if d == tgt:
+                        n_acc += 1
+                        if self._emit_tok(i, d):
+                            break
+                        continue
+                    # greedy rejection: the corrective token IS the
+                    # target argmax — exactly what target-only greedy
+                    # decode would have emitted here
+                    self._emit_tok(i, tgt)
+                    break
+                p = warp_probs(trow, sl.sample)
+                q = warp_probs(qrows[i][j], sl.sample)
+                u = _uniform(sl.sample.seed, _U_ACCEPT, sl.draws[_U_ACCEPT])
+                sl.draws[_U_ACCEPT] += 1
+                if u * q[d] <= p[d]:
+                    n_acc += 1
+                    if self._emit_tok(i, d):
+                        break
+                    continue
+                # rejected: resample from the residual max(p − q, 0)
+                r = np.maximum(p - q, 0.0)
+                if r.sum() <= 0.0:
+                    r = p
+                u = _uniform(sl.sample.seed, _U_RESID, sl.draws[_U_RESID])
+                sl.draws[_U_RESID] += 1
+                self._emit_tok(i, _pick(r, u))
+                break
+            else:
+                # every draft accepted and the slot is still live: the
+                # bonus token comes free off the (K+1)-th verify row
+                n_bonus += 1
+                self._emit_tok(i, self._select(sl, vlogits[i, K]))
+            if self._slots[i] is not None:
+                # draft-cache reconciliation: valid through the last
+                # accepted position, capped by what this round's steps
+                # actually wrote (a fully-accepted round leaves the draft
+                # one position behind — next round's catch-up)
+                sl.draft_len = min(old_draft_len + steps, sl.length)
+
+        ms = (time.perf_counter() - t0) * 1e3
+        self._decode_ms.append(ms)
+        self._counters["decode_steps"] += 1
+        self._counters["spec_rounds"] += 1
+        self._counters["spec_proposed"] += K * len(live)
+        self._counters["spec_accepted"] += n_acc
+        self._counters["spec_bonus"] += n_bonus
+        if spans.enabled():
+            spans.emit_event(
+                "gen.speculate", k=K, active=len(live),
+                proposed=K * len(live), accepted=n_acc, bonus=n_bonus,
+                ms=round(ms, 3),
             )
 
     def _emit_token_counters(self) -> None:
@@ -852,9 +1555,9 @@ class GenerateEngine:
                 # boundary — a retired sequence's page is reusable on the
                 # very next step, ragged completions never stall the batch
                 while self._waiting and self._free_slot() is not None:
-                    stream, ids, max_new = self._waiting.popleft()
+                    stream, ids, max_new, sp = self._waiting.popleft()
                     try:
-                        self._admit(stream, ids, max_new)
+                        self._admit(stream, ids, max_new, sp)
                     except Exception as e:  # noqa: BLE001 — fail ONE request
                         stream._close("error", e)
                 active = any(s is not None for s in self._slots)
@@ -864,7 +1567,10 @@ class GenerateEngine:
                     self._lock.wait(timeout=self._poll_s)
                     continue
                 try:
-                    self._decode_step()
+                    if self.spec_k:
+                        self._spec_round()
+                    else:
+                        self._decode_step()
                 except Exception as e:  # noqa: BLE001 — device fault: fail
                     # every in-flight request loudly, keep serving new ones
                     for i, s in enumerate(self._slots):

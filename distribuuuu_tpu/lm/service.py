@@ -35,13 +35,22 @@ from distribuuuu_tpu.serve.admission import EngineClosedError, QueueFullError
 
 def engine_from_cfg() -> GenerateEngine:
     """Build the generation engine from the global cfg: the configured
-    gpt_* arch on one device, weights from ``MODEL.WEIGHTS`` (orbax dir)
-    when set, GENERATE.* tiles AOT-compiled. The single-replica sibling of
-    ``serve/engine.engine_from_cfg``."""
+    gpt_* arch, weights from ``MODEL.WEIGHTS`` (orbax dir) when set,
+    GENERATE.* tiles AOT-compiled. The single-replica sibling of
+    ``serve/engine.engine_from_cfg``.
+
+    ``MESH.MODEL > 1`` (a dp×tp stanza, from YAML alone — ISSUE 17a)
+    builds the engine over a dp×tp mesh instead of one device: params
+    placed by the lm_spec_table rules, cache heads sharded on ``model``,
+    logits gathered — pinned logit-identical to the single-device path.
+    ``GENERATE.SPECULATE.ENABLED`` (ISSUE 17c) additionally builds the
+    DRAFT_ARCH model and turns every decode step into a speculative
+    round."""
     import jax
 
     from distribuuuu_tpu import trainer
     from distribuuuu_tpu.parallel import mesh as mesh_lib
+    from distribuuuu_tpu.parallel.partition import topology as topo_lib
 
     if not cfg.MODEL.ARCH.startswith("gpt"):
         raise ValueError(
@@ -53,27 +62,114 @@ def engine_from_cfg() -> GenerateEngine:
     )
     mesh_lib.apply_platform(cfg.DEVICE.PLATFORM)
     devices = jax.local_devices()
-    idx = cfg.SERVE.DEVICE
-    if not 0 <= idx < len(devices):
-        raise ValueError(
-            f"SERVE.DEVICE={idx} out of range: {len(devices)} local devices"
+    tp = int(cfg.MESH.MODEL)
+    if tp > 1:
+        dp = int(cfg.MESH.DATA) if int(cfg.MESH.DATA) > 0 else 1
+        need = dp * tp
+        if need > len(devices):
+            raise ValueError(
+                f"MESH.DATA={dp} x MESH.MODEL={tp} = {need} devices but "
+                f"only {len(devices)} are local — shrink the decode mesh"
+            )
+        mesh = mesh_lib.build_mesh(
+            data=dp, model=tp, seq=1, pipe=1, devices=devices[:need]
         )
-    mesh = mesh_lib.build_mesh(data=1, model=1, seq=1, pipe=1,
-                               devices=[devices[idx]])
-    model = trainer.build_model_from_cfg()
+        gen_mesh = mesh
+    else:
+        idx = cfg.SERVE.DEVICE
+        if not 0 <= idx < len(devices):
+            raise ValueError(
+                f"SERVE.DEVICE={idx} out of range: {len(devices)} local "
+                "devices"
+            )
+        mesh = mesh_lib.build_mesh(data=1, model=1, seq=1, pipe=1,
+                                   devices=[devices[idx]])
+        gen_mesh = None
+    # decode models are built topology-neutral: the ENGINE owns placement
+    # (lm_decode_shardings + out_shardings over its own dp×tp mesh), so
+    # the trainer's mesh threading — resolved against ALL local devices —
+    # must not leak into construction here. MESH.MODEL is read above as
+    # the decode tp degree instead.
+    model = trainer.build_model_from_cfg(topology=topo_lib.Topology())
     state = trainer.create_train_state(
         model, jax.random.key(cfg.RNG_SEED or 0), mesh, cfg.TRAIN.IM_SIZE
     )
     if cfg.MODEL.WEIGHTS:
         state = trainer._with_restored_weights(state, cfg.MODEL.WEIGHTS, model)
-    return GenerateEngine(model, {"params": state.params})
+    kwargs = {}
+    if cfg.GENERATE.SPECULATE.ENABLED:
+        kwargs.update(_draft_from_cfg(model, mesh))
+    return GenerateEngine(
+        model, {"params": state.params}, mesh=gen_mesh, **kwargs
+    )
+
+
+def _draft_from_cfg(target_model, mesh) -> dict:
+    """Build the draft half of a speculative engine from
+    ``GENERATE.SPECULATE``: the DRAFT_ARCH zoo model (its own seeded
+    init, or DRAFT_WEIGHTS when set) after the tokenizer-identity check —
+    speculation verifies DRAFT token ids under the TARGET distribution,
+    so the two models must agree on what a token id means."""
+    import jax
+
+    from distribuuuu_tpu import models, trainer
+    from distribuuuu_tpu.models.layers import resolve_dtype
+
+    arch = cfg.GENERATE.SPECULATE.DRAFT_ARCH
+    if not arch.startswith("gpt"):
+        raise ValueError(
+            f"GENERATE.SPECULATE.DRAFT_ARCH={arch!r} is not a gpt_* zoo "
+            "arch — the draft decodes through the same GPTDecoder"
+        )
+    # tokenizer-identity pairing: every gpt_* arch tokenizes with the
+    # one in-repo ByteTokenizer, so the fingerprints coincide today —
+    # the check is the declaration a second tokenizer would trip
+    t_id = ByteTokenizer().identity()
+    d_id = ByteTokenizer().identity()
+    if t_id != d_id:
+        raise ValueError(
+            f"GENERATE.SPECULATE.DRAFT_ARCH={arch}: draft tokenizer "
+            f"identity {d_id} != target tokenizer identity {t_id} — "
+            "draft proposals are token ids; accept/reject is undefined "
+            "across tokenizers"
+        )
+    kwargs = dict(
+        num_classes=cfg.MODEL.NUM_CLASSES,
+        dtype=resolve_dtype(cfg.DEVICE.COMPUTE_DTYPE),
+        seq_len=int(cfg.LM.SEQ_LEN),
+    )
+    if arch.endswith("_moe"):
+        kwargs.update(
+            moe_experts=cfg.MODEL.MOE.NUM_EXPERTS,
+            moe_top_k=cfg.MODEL.MOE.TOP_K,
+            moe_every=cfg.MODEL.MOE.EVERY,
+            moe_capacity_factor=cfg.MODEL.MOE.CAPACITY_FACTOR,
+        )
+    draft_model = models.build_model(arch, **kwargs)
+    draft_state = trainer.create_train_state(
+        draft_model, jax.random.key(cfg.RNG_SEED or 0), mesh,
+        cfg.TRAIN.IM_SIZE,
+    )
+    if cfg.GENERATE.SPECULATE.DRAFT_WEIGHTS:
+        draft_state = trainer._with_restored_weights(
+            draft_state, cfg.GENERATE.SPECULATE.DRAFT_WEIGHTS, draft_model
+        )
+    return {
+        "draft_model": draft_model,
+        "draft_variables": {"params": draft_state.params},
+        "spec_k": int(cfg.GENERATE.SPECULATE.K),
+    }
 
 
 def handle_generate(engine: GenerateEngine, ctrl: dict, send) -> None:
     """Serve one ``op="generate"`` ctrl request: submit, then stream one
     frame per token and a final done frame through ``send(payload_bytes)``.
     Error shapes mirror the image protocol (queue_full carries the
-    retry-after hint verbatim)."""
+    retry-after hint verbatim). The optional ``temperature``/``top_k``/
+    ``top_p``/``seed`` ctrl fields override the replica's
+    ``GENERATE.SAMPLE`` defaults per request — a sampled stream is
+    replayable from its ctrl frame alone (same seed ⇒ same tokens, on
+    any replica)."""
     tok = ByteTokenizer()
     if "tokens" in ctrl:
         ids = [int(t) for t in ctrl["tokens"]]
@@ -84,8 +180,14 @@ def handle_generate(engine: GenerateEngine, ctrl: dict, send) -> None:
             {"error": "generate needs 'tokens' or 'text'"}
         ).encode())
         return
+    sample = {
+        k: ctrl[k] for k in ("temperature", "top_k", "top_p", "seed")
+        if k in ctrl
+    }
     try:
-        stream = engine.submit(ids, ctrl.get("max_new_tokens"))
+        stream = engine.submit(
+            ids, ctrl.get("max_new_tokens"), sample=sample or None
+        )
     except QueueFullError as e:
         send(json.dumps({
             "error": "queue_full",
@@ -121,10 +223,15 @@ def handle_generate(engine: GenerateEngine, ctrl: dict, send) -> None:
 
 
 def generate_request(host: str, port: int, *, tokens=None, text=None,
-                     max_new_tokens: int | None = None, timeout: float = 60.0):
+                     max_new_tokens: int | None = None,
+                     temperature: float | None = None,
+                     top_k: int | None = None, top_p: float | None = None,
+                     seed: int | None = None, timeout: float = 60.0):
     """Client helper (tests/bench/RUNBOOK): send one generate request to a
     replica OR the fleet router and yield the decoded frames — token
-    frames as they stream, the done frame last. Raises on error frames."""
+    frames as they stream, the done frame last. Raises on error frames.
+    The sampling kwargs ride the ctrl frame; a request that sets them is
+    replayable verbatim (same frame ⇒ same stream on any replica)."""
     fields = {}
     if tokens is not None:
         fields["tokens"] = [int(t) for t in tokens]
@@ -132,6 +239,14 @@ def generate_request(host: str, port: int, *, tokens=None, text=None,
         fields["text"] = text
     if max_new_tokens is not None:
         fields["max_new_tokens"] = int(max_new_tokens)
+    if temperature is not None:
+        fields["temperature"] = float(temperature)
+    if top_k is not None:
+        fields["top_k"] = int(top_k)
+    if top_p is not None:
+        fields["top_p"] = float(top_p)
+    if seed is not None:
+        fields["seed"] = int(seed)
     with socket.create_connection((host, port), timeout=timeout) as conn:
         conn.settimeout(timeout)
         protocol.send_frame(conn, protocol.ctrl_request("generate", **fields))

@@ -267,6 +267,39 @@ def lm_spec_table(moe_axis: str = "model") -> SpecTable:
     )
 
 
+def lm_cache_spec() -> P:
+    """Placement of the paged KV cache ``[L, B, H, C, Dh]`` under TP
+    decode (ISSUE 17): heads sharded over ``model`` — the axis the qkv
+    column-parallel kernels already split heads on, so each model shard
+    writes and reads ONLY its own heads' pages and the cache never moves
+    between shards. Every other dim (layers, slots, positions, head dim)
+    is replicated."""
+    return P(None, None, "model", None, None)
+
+
+def lm_decode_shardings(mesh: Mesh, params) -> Any:
+    """NamedSharding tree for a PLAIN (unboxed) GPTDecoder param tree:
+    the :func:`lm_spec_table` path rules applied leaf-by-leaf, unmatched
+    leaves replicated. The decoder mirrors the training GPT module names
+    exactly (lm/generate.GPTDecoder), so the SAME declaration that places
+    training state places decode state — zero decode-specific rules.
+    Every derived spec is validated before it can reach GSPMD."""
+    table = lm_spec_table()
+    axis_sizes = {k: int(v) for k, v in dict(mesh.shape).items()}
+    flat, treedef = jax.tree_util.tree_flatten_with_path(params)
+    out = []
+    for path, leaf in flat:
+        pstr = leaf_path(path)
+        spec = table.spec_for(pstr)
+        if spec is None:
+            spec = P()
+        validate_leaf_spec(
+            pstr, spec, tuple(jax.numpy.shape(leaf)), axis_sizes
+        )
+        out.append(NamedSharding(mesh, spec))
+    return jax.tree.unflatten(treedef, out)
+
+
 def apply_spec_table(base, table: SpecTable, mesh: Mesh):
     """Overlay a path-pattern table onto a NamedSharding tree (the
     annotation-derived base): a leaf a rule matches gets the rule's spec;

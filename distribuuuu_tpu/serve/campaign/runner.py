@@ -78,12 +78,37 @@ class CampaignRunner:
     def _job(self, t: float, model: str, size: int) -> None:
         pi = self._phase_index(t)
         payload = self._payload_for(model)
-        frame = protocol.model_envelope(model, payload)
+        # LM campaigns (config/campaigns/lm_decode.yaml): payload_for
+        # returns an ``op="generate"`` ctrl frame — the model rides IN
+        # the ctrl frame (dispatch_stream contract), not the envelope,
+        # and the final streamed frame is what classifies the request
+        generate = payload.startswith(protocol.CTRL_MAGIC)
+        frame = payload if generate else protocol.model_envelope(
+            model, payload
+        )
         for _ in range(size):
             if self._stop.is_set():
                 return
             cls = "failed"
             try:
+                if generate:
+                    # final frame of the stream: a clean done frame has
+                    # no "error" key; a mid-stream failure rides the done
+                    # frame itself, so classify on the parsed record
+                    rec = json.loads(self.router.dispatch_generate(
+                        frame, model=model
+                    ))
+                    err = rec.get("error")
+                    if err is None and rec.get("stream") == "done":
+                        cls = "ok"
+                    elif err in _BACKOFF:
+                        cls = "busy"
+                    elif err == "unknown_model":
+                        cls = "unknown_model"
+                    with self._lock:
+                        self._counts[pi]["sent"] += 1
+                        self._counts[pi][cls] += 1
+                    continue
                 resp = self.router.dispatch(frame)
                 if not resp.startswith(b'{"error"'):
                     cls = "ok"

@@ -550,6 +550,47 @@ class Router:
             {"error": "no_routable_replicas", "retry_after_ms": 1000.0}
         ).encode())
 
+    def dispatch_generate(self, payload: bytes,
+                          model: str | None = None) -> bytes:
+        """In-process façade over ``dispatch_stream`` for callers that
+        want one classified outcome per generate request rather than a
+        client socket to relay into — the campaign runner's LM path
+        (config/campaigns/lm_decode.yaml). Relays the stream into a
+        local socketpair, drains the token frames, and returns the FINAL
+        frame (done / busy / error) — the same bytes ``dispatch``-style
+        callers classify on."""
+        ours, theirs = socket.socketpair()
+        frames: list[bytes] = []
+
+        def _drain() -> None:
+            try:
+                ours.settimeout(self.request_timeout_s)
+                while True:
+                    frame = protocol.recv_frame(ours)
+                    if frame is None:
+                        return
+                    frames.append(frame)
+            except (OSError, ValueError):
+                return
+
+        reader = threading.Thread(target=_drain, daemon=True)
+        reader.start()
+        try:
+            self.dispatch_stream(payload, theirs, model=model)
+        finally:
+            try:
+                theirs.shutdown(socket.SHUT_WR)
+            except OSError:
+                pass
+            reader.join(self.request_timeout_s)
+            theirs.close()
+            ours.close()
+        if not frames:
+            return json.dumps(
+                {"error": "no_routable_replicas", "retry_after_ms": 1000.0}
+            ).encode()
+        return frames[-1]
+
     # -- observability -----------------------------------------------------
     def window_stats(self, window_s: float) -> dict:
         """Latency percentiles over the trailing ``window_s`` plus total

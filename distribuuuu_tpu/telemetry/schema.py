@@ -108,6 +108,18 @@ KINDS: dict[str, frozenset] = {
     "gen.decode": frozenset({"active", "tile_b", "tile_c", "ms"}),
     # one per sequence retirement (reason: eos/max_new_tokens/cache_full)
     "gen.retire": frozenset({"slot", "new_tokens", "reason", "request"}),
+    # one per speculative round (ISSUE 17c): K drafted, `proposed` actual
+    # proposals across active slots, `accepted` + `bonus` tokens emitted —
+    # run_report's acceptance-ratio source. accepted/proposed ≈ draft
+    # quality; (accepted+bonus)/rounds > 1 is the speedup condition.
+    "gen.speculate": frozenset(
+        {"k", "active", "proposed", "accepted", "bonus", "ms"}
+    ),
+    # one per non-greedy admission: the ctrl-frame sampling params that
+    # replay this stream bit-identically on any replica (ISSUE 17b)
+    "gen.sample": frozenset(
+        {"request", "temperature", "top_k", "top_p", "seed"}
+    ),
     # -- Pallas kernel tier (ops/pallas/, ISSUE 13) ----------------------
     # one per kernel-impl resolution (ops.pallas.select): which impl
     # actually runs for an op vs what KERNELS.* requested — the source

@@ -485,9 +485,11 @@ def test_lm_fleet_streams_with_zero_drops(tmp_path):
     """ISSUE 12 acceptance, end to end: REAL gpt replicas behind the REAL
     fleet router; concurrent clients with ragged budgets all stream to
     completion (zero dropped requests), every stream's token frames match
-    its done frame, and — deterministic greedy + same seed on every
-    replica — every client of the same prompt gets the same tokens no
-    matter which replica served it."""
+    its done frame, and every client of the same request gets the same
+    tokens no matter which replica served it — greedy requests via
+    deterministic decode, SAMPLED requests via the ctrl-frame key replay
+    contract (ISSUE 17 acceptance: same temperature/top_p/seed ⇒
+    bit-identical streams across real replicas)."""
     import socket
 
     from distribuuuu_tpu.lm import service as lm_service
@@ -527,18 +529,25 @@ def test_lm_fleet_streams_with_zero_drops(tmp_path):
         )
         server.start()
         rng = np.random.default_rng(12)
-        prompts = [
-            rng.integers(0, 256, (2 + i % 6,)).astype(int).tolist()
-            for i in range(10)
+        # 5 request groups x 2 identical clients: groups 0-1 greedy,
+        # groups 2-4 sampled with a per-group ctrl-frame key — the pair
+        # may land on different replicas and must still match
+        gprompts = [
+            rng.integers(0, 256, (2 + g,)).astype(int).tolist()
+            for g in range(5)
         ]
         results: dict[int, dict] = {}
         errors: list = []
 
         def client(i):
+            g = i % 5
+            kw = {} if g < 2 else dict(
+                temperature=0.9, top_p=0.9, seed=50 + g,
+            )
             try:
                 frames = list(lm_service.generate_request(
-                    "127.0.0.1", port, tokens=prompts[i],
-                    max_new_tokens=3 + i % 4, timeout=120.0,
+                    "127.0.0.1", port, tokens=gprompts[g],
+                    max_new_tokens=3 + g, timeout=120.0, **kw,
                 ))
                 toks = [
                     f["token"] for f in frames if f.get("stream") == "token"
@@ -547,9 +556,10 @@ def test_lm_fleet_streams_with_zero_drops(tmp_path):
             except Exception as e:  # noqa: BLE001
                 errors.append((i, e))
 
+        n_clients = 10
         threads = [
             threading.Thread(target=client, args=(i,))
-            for i in range(len(prompts))
+            for i in range(n_clients)
         ]
         for t in threads:
             t.start()
@@ -558,21 +568,21 @@ def test_lm_fleet_streams_with_zero_drops(tmp_path):
         stop.set()
         server.join(5)
         assert not errors, errors
-        assert len(results) == len(prompts)  # zero dropped requests
-        by_prompt: dict[tuple, list] = {}
+        assert len(results) == n_clients  # zero dropped requests
+        by_group: dict[int, list] = {}
         for i, r in results.items():
             done = r["frames"][-1]
             assert done["stream"] == "done" and "error" not in done
             assert done["tokens"] == r["tokens"]
             assert len(r["tokens"]) >= 1
-            key = (tuple(prompts[i]), 3 + i % 4)
-            by_prompt.setdefault(key, []).append(tuple(r["tokens"]))
-        for key, outs in by_prompt.items():
-            # greedy determinism across replicas: same prompt+budget →
-            # same stream, whichever replica decoded it
-            assert len(set(outs)) == 1, (key, outs)
+            by_group.setdefault(i % 5, []).append(tuple(r["tokens"]))
+        for g, outs in by_group.items():
+            # determinism across replicas: an identical request — greedy
+            # (g < 2) or sampled with the same ctrl-frame key (g >= 2) —
+            # streams the same tokens, whichever replica decoded it
+            assert len(outs) == 2 and len(set(outs)) == 1, (g, outs)
         assert int(svc.router.registry.counter("fleet.streams").value) \
-            == len(prompts)
+            == n_clients
     finally:
         svc.shutdown()
 

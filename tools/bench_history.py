@@ -153,17 +153,45 @@ def index_zero_overlap(path: str, doc: dict, series: dict) -> None:
                    row.get("step_ms"), "ms")
 
 
+def index_lm_speculative(path: str, doc: dict, series: dict) -> None:
+    """BENCH_r11+ ``lm_speculative`` section (tools/lm_bench.py
+    --speculative): per draft-K, tokens/s, acceptance ratio, and emitted
+    tokens/round, plus the best-K speedup over the target-only baseline
+    (k=0). Every series name is ``lm_spec_*`` — deliberately outside the
+    ``images_per_sec``/``img_per_sec`` gate patterns (the PR 8 clobbering
+    lesson): single-core CPU token rates are trajectory data, never the
+    throughput regression reference."""
+    spec = doc.get("lm_speculative") or {}
+    rnd, src = _round_of(path), os.path.basename(path)
+    for row in spec.get("rows") or []:
+        k = row.get("k")
+        _point(series, f"lm_spec_tokens_per_s_k{k}", rnd, src,
+               row.get("tokens_per_s"), "tok/s")
+        _point(series, f"lm_spec_round_p50_ms_k{k}", rnd, src,
+               row.get("round_p50_ms"), "ms")
+        if k:
+            _point(series, f"lm_spec_acceptance_k{k}", rnd, src,
+                   row.get("acceptance_ratio"), "ratio")
+            _point(series, f"lm_spec_tokens_per_round_k{k}", rnd, src,
+                   row.get("accepted_per_round"), "tok/round")
+    _point(series, "lm_spec_speedup_best", rnd, src,
+           spec.get("speedup_best"), "x")
+
+
 def index_train_bench(path: str, series: dict) -> None:
     """BENCH_r*.json: the ``parsed`` block is the metric (r06+ may
     instead carry an ``asyncplane`` section, r08+ an ``lm`` section,
     r09+ a kernel-tier ``kernels``/``step_ab`` matrix, r10+ a
-    ``zero_overlap`` schedule A/B — indexed separately)."""
+    ``zero_overlap`` schedule A/B, r11+ an ``lm_speculative`` draft-K
+    A/B — indexed separately)."""
     with open(path) as f:
         doc = json.load(f)
     if doc.get("asyncplane"):
         index_asyncplane(path, doc, series)
     if doc.get("lm"):
         index_lm(path, doc, series)
+    if doc.get("lm_speculative"):
+        index_lm_speculative(path, doc, series)
     if doc.get("kernels") or doc.get("step_ab"):
         index_kernels(path, doc, series)
     if doc.get("zero_overlap"):

@@ -224,6 +224,7 @@ def _lm_section(ranks: dict[int, list[dict]]) -> dict | None:
     pre_ms: list[float] = []
     admits = retires = 0
     reasons: dict[str, int] = {}
+    spec_rounds = spec_proposed = spec_accepted = spec_bonus = 0
     for rank, recs in sorted(ranks.items()):
         for r in recs:
             kind = r.get("kind")
@@ -239,6 +240,11 @@ def _lm_section(ranks: dict[int, list[dict]]) -> dict | None:
                 retires += 1
                 reason = str(r.get("reason"))
                 reasons[reason] = reasons.get(reason, 0) + 1
+            elif kind == "gen.speculate":
+                spec_rounds += 1
+                spec_proposed += int(r.get("proposed", 0))
+                spec_accepted += int(r.get("accepted", 0))
+                spec_bonus += int(r.get("bonus", 0))
     if not (last_tokens or dec_ms or pre_ms):
         return None
     new_tokens = sum(int(r.get("new_tokens", 0)) for r in last_tokens.values())
@@ -252,7 +258,7 @@ def _lm_section(ranks: dict[int, list[dict]]) -> dict | None:
         int(r.get("new_tokens", 0)) / max(float(r.get("elapsed_s", 0.0)), 1e-9)
         for r in last_tokens.values()
     ), 3) if last_tokens else None
-    return {
+    out = {
         "prompt_tokens": prompt_tokens,
         "new_tokens": new_tokens,
         "decode_steps": decode_steps,
@@ -263,6 +269,23 @@ def _lm_section(ranks: dict[int, list[dict]]) -> dict | None:
         "decode": _summary_ms([v / 1e3 for v in dec_ms]),
         "prefill": _summary_ms([v / 1e3 for v in pre_ms]),
     }
+    if spec_rounds:
+        # acceptance ratio = accepted/proposed (draft quality); tokens
+        # per round = (accepted+bonus+rejections-resampled)/rounds — the
+        # roofline win condition is emitted tokens/round > 1 (ISSUE 17)
+        out["speculate"] = {
+            "rounds": spec_rounds,
+            "proposed": spec_proposed,
+            "accepted": spec_accepted,
+            "bonus": spec_bonus,
+            "acceptance_ratio": round(
+                spec_accepted / max(spec_proposed, 1), 4
+            ),
+            "accepted_per_round": round(
+                (spec_accepted + spec_bonus) / spec_rounds, 3
+            ),
+        }
+    return out
 
 
 def _campaign_section(ranks: dict[int, list[dict]]) -> dict | None:

@@ -48,6 +48,7 @@ import numpy as np
 TOPOLOGY = {
     "rolling_update": {"resnet18": 2},  # >=2: one replica stays routable
     "degrade_under_pressure": {"resnet50": 1, "resnet18": 1},
+    "lm_decode": {"gpt_nano": 1},  # one replica: the burst MUST overflow it
 }
 
 IM_SIZE = 16
@@ -87,6 +88,35 @@ def base_cfg(work: str):
     return cfg
 
 
+def lm_base_cfg(work: str):
+    """The LM campaign serve config: toy-but-real gpt_nano replicas
+    (seeded init, greedy decode) with tiny tiles and a SMALL admission
+    queue, so a flash burst of generate streams hits backpressure inside
+    a short campaign while admitted streams keep decoding."""
+    import distribuuuu_tpu.config as config
+    from distribuuuu_tpu.config import cfg
+
+    config.reset_cfg()
+    cfg.MODEL.ARCH = "gpt_nano"
+    cfg.MODEL.NUM_CLASSES = 320  # the byte tokenizer's vocab
+    cfg.DEVICE.COMPUTE_DTYPE = "float32"
+    cfg.DEVICE.PLATFORM = "cpu"
+    cfg.LM.SEQ_LEN = 32
+    cfg.GENERATE.PROMPT_LEN = 8
+    cfg.GENERATE.MAX_NEW_TOKENS = 10
+    cfg.GENERATE.BATCH_TILES = [2]
+    cfg.GENERATE.CACHE_TILES = [32]
+    cfg.RNG_SEED = 0
+    cfg.OUT_DIR = work
+    # ~4 stream service times of queue between "saturated" and
+    # "rejecting": the burst must bounce, the control phase must not
+    cfg.SERVE.MAX_QUEUE = 4
+    cfg.SERVE.FLEET.AUTOSCALE = False
+    cfg.SERVE.FLEET.MIN_REPLICAS = 0
+    cfg.SERVE.FLEET.HEALTH_PERIOD_S = 0.5
+    return cfg
+
+
 def payload_bank(n: int = 8, seed: int = 0) -> list:
     rng = np.random.default_rng(seed)
     out = []
@@ -97,6 +127,24 @@ def payload_bank(n: int = 8, seed: int = 0) -> list:
             rng.standard_normal((IM_SIZE, IM_SIZE, 3)).astype(np.float32),
         )
         out.append(buf.getvalue())
+    return out
+
+
+def lm_payload_bank(n: int = 8, seed: int = 0) -> list:
+    """Token-prompt generate ctrl frames (lm/service.py wire shape) —
+    the LM twin of ``payload_bank``. Ragged prompt lengths exercise the
+    prefill tiles; the budgets keep one stream ~6 decode steps."""
+    from distribuuuu_tpu.serve import protocol
+
+    rng = np.random.default_rng(seed)
+    out = []
+    for i in range(n):
+        plen = int(rng.integers(2, 9))
+        out.append(protocol.ctrl_request(
+            "generate",
+            tokens=[int(t) for t in rng.integers(0, 256, plen)],
+            max_new_tokens=6 + i % 4,
+        ))
     return out
 
 
@@ -126,7 +174,11 @@ def run_campaign(path: str, work: str, log) -> dict:
     h2 = dsl.schedule_hash(dsl.build_schedule(spec))
 
     cdir = os.path.join(work, spec.name)
-    cfg = base_cfg(cdir)
+    # an all-gpt model list makes it an LM campaign: generate ctrl
+    # frames through the router's streaming branch instead of image
+    # payloads through dispatch (runner._job classifies on done frames)
+    is_lm = all(m["name"].startswith("gpt") for m in spec.models)
+    cfg = lm_base_cfg(cdir) if is_lm else base_cfg(cdir)
     specs = fleet_specs(spec)
     log(f"campaign {spec.name}: fleet "
         f"{ {s['name']: s['replicas'] for s in specs} } warming up ...")
@@ -135,7 +187,7 @@ def run_campaign(path: str, work: str, log) -> dict:
     fleet.start(wait=True)
     log(f"campaign {spec.name}: fleet routable in "
         f"{time.perf_counter() - t0:.1f}s")
-    payloads = payload_bank()
+    payloads = lm_payload_bank() if is_lm else payload_bank()
     counter = {"i": 0}
     lock = threading.Lock()
 
