@@ -57,8 +57,30 @@ The host snapshot itself (``snapshot_tree``) materializes every leaf
 from this host's addressable shards — replicated leaves and leaves
 sharded over local devices assemble to the full array. A tree sharded
 ACROSS hosts (ZeRO over a cross-host axis) cannot be materialized
-host-locally; ``MultiHostSnapshotError`` then degrades the save to the
-synchronous collective protocol (utils/checkpoint.py warns once).
+host-locally; those saves take the SHARDED protocol instead (ISSUE 18,
+deleting the sync-collective degrade PR 11 shipped with):
+
+* every host evaluates the same metadata-only predicate
+  (``tree_is_cross_host_sharded``) — no communication, same answer
+  everywhere — and snapshots on-path only the shards it OWNS
+  (``replica_id == 0``: exactly one host owns each index block, so the
+  union covers every element exactly once, replicated leaves included);
+* each host's committer thread writes its own ``shards_host<r>.npz`` +
+  ``SHARDS_host<r>.json`` sharding manifest under the SAME barrier
+  (peers write between the OPEN wait and their arrival — arrival still
+  attests durability), and the primary commits MANIFEST.json strictly
+  last, so the digest walk covers every shard file and a dropped shard
+  fails verification → quarantine + walk-back, exactly like any other
+  torn save;
+* restore (``read_sharded_checkpoint``) reassembles the full tree from
+  all recorded shard files and REFUSES a shard-count mismatch
+  (``ShardLayoutError`` naming the recorded sharding) rather than
+  silently restoring a partial tree.
+
+``MultiHostSnapshotError`` remains the safety valve for trees the
+sharded protocol cannot represent (non-dict containers, exotic
+shardings): utils/checkpoint.py still degrades those to the synchronous
+collective save with a warning.
 """
 
 from __future__ import annotations
@@ -159,6 +181,313 @@ def snapshot_tree(tree):
     import jax
 
     return jax.tree.map(_materialize, tree)
+
+
+# ---------------------------------------------- cross-host sharded snapshot
+SHARD_FORMAT = "dtpu_sharded_v1"
+
+
+class ShardLayoutError(RuntimeError):
+    """A sharded checkpoint cannot be restored as recorded: shard files
+    are missing or the layouts disagree — the caller refuses (direct
+    load) or walks back (auto-resume, via manifest verification)."""
+
+
+def _layout_name(rank: int) -> str:
+    return f"SHARDS_host{rank}.json"
+
+
+def _shards_name(rank: int) -> str:
+    return f"shards_host{rank}.npz"
+
+
+def _dict_path(path) -> list:
+    """Key-path → list of dict keys; only dict containers are sharded
+    (the checkpoint payload is dicts all the way down — pack_opt_state
+    exists exactly to dictify the optax tuple). Anything else signals
+    the caller to degrade to the sync collective save."""
+    import jax
+
+    parts = []
+    for p in path:
+        if isinstance(p, jax.tree_util.DictKey):
+            parts.append(str(p.key))
+        else:
+            raise MultiHostSnapshotError(
+                f"checkpoint payload has a non-dict container on the "
+                f"path {path!r} — the sharded save protocol records "
+                "dict key-paths only"
+            )
+    return parts
+
+
+def _normalize_index(index, shape) -> list:
+    """One shard's index as json-able ``[start, stop]`` per dimension
+    (step must be 1 — anything else is not a block sharding)."""
+    out = []
+    for sl, dim in zip(index, shape):
+        start, stop, step = sl.indices(dim)
+        if step != 1:
+            raise MultiHostSnapshotError(
+                f"shard index {index!r} has step {step} — not a block "
+                "sharding the shard layout can record"
+            )
+        out.append([int(start), int(stop)])
+    return out
+
+
+def tree_is_cross_host_sharded(tree) -> bool:
+    """Metadata-only: does any leaf's local shard set fail to cover the
+    full array? Every host computes the same answer from its OWN shards
+    — a leaf is cross-host-sharded for all hosts or none — so this
+    predicate needs no communication and safely picks the save protocol
+    on every host independently."""
+    import jax
+
+    for leaf in jax.tree.leaves(tree):
+        if not isinstance(leaf, jax.Array) or leaf.is_fully_addressable:
+            continue
+        shape = tuple(leaf.shape)
+        total = int(np.prod(shape)) if shape != () else 1
+        covered, seen = 0, set()
+        for s in leaf.addressable_shards:
+            key = tuple(
+                (i.start, i.stop, i.step) if isinstance(i, slice) else i
+                for i in s.index
+            )
+            if key in seen:
+                continue
+            seen.add(key)
+            covered += _index_size(s.index, shape)
+        if covered < total:
+            return True
+    return False
+
+
+def _index_size(index, shape) -> int:
+    n = 1
+    for sl, dim in zip(index, shape):
+        start, stop, step = sl.indices(dim)
+        n *= max(0, (stop - start + step - 1) // step)
+    return int(n)
+
+
+def snapshot_host_shards(tree, rank: int):
+    """Donation-safe, host-local snapshot of the shards THIS host owns
+    (ownership = ``replica_id == 0``: exactly one host worldwide owns
+    each index block, so the union over hosts covers every element of
+    every leaf exactly once). Host-side leaves (the epoch cursor, data
+    cursors — identical on every host by construction) are owned by
+    rank 0. Returns ``(owned, layout)``: raw shard arrays keyed for the
+    npz, and the json-able layout whose ``leaves`` spec is IDENTICAL on
+    every host (each shard file is self-describing). Raises
+    ``MultiHostSnapshotError`` for trees the format cannot record — the
+    caller degrades to the sync collective save."""
+    import jax
+
+    flat = jax.tree_util.tree_flatten_with_path(tree)[0]
+    leaves_spec, owned, shards_meta = [], {}, []
+    for ln, (path, leaf) in enumerate(flat):
+        parts = _dict_path(path)
+        if isinstance(leaf, jax.Array) and not leaf.is_fully_addressable:
+            shape = tuple(leaf.shape)
+            dtype = np.dtype(leaf.dtype)
+            leaves_spec.append(
+                {"path": parts, "shape": list(shape), "dtype": dtype.name}
+            )
+            si = 0
+            for s in leaf.addressable_shards:
+                if s.replica_id != 0:
+                    continue
+                data = np.asarray(s.data)  # blocks: donation-safe
+                key = f"{ln:05d}.{si}"
+                owned[key] = data
+                shards_meta.append({
+                    "leaf": ln, "key": key,
+                    "index": _normalize_index(s.index, shape),
+                    "shape": list(data.shape), "dtype": dtype.name,
+                })
+                si += 1
+        else:
+            host = np.asarray(leaf)
+            if host.dtype.kind in ("U", "S"):
+                # string leaves (pack_opt_state's format marker): utf-8
+                # bytes under a "utf8" dtype tag — numpy unicode dtype
+                # names do not round-trip through np.dtype()
+                if host.shape != ():
+                    raise MultiHostSnapshotError(
+                        f"non-scalar string leaf at {'/'.join(parts)} — "
+                        "the shard layout records scalar strings only"
+                    )
+                raw = np.frombuffer(str(host).encode("utf-8"), np.uint8)
+                leaves_spec.append(
+                    {"path": parts, "shape": [], "dtype": "utf8"}
+                )
+                if rank == 0:
+                    key = f"{ln:05d}.0"
+                    owned[key] = raw
+                    shards_meta.append({
+                        "leaf": ln, "key": key, "index": [],
+                        "shape": [int(raw.size)], "dtype": "utf8",
+                    })
+                continue
+            if host.dtype.kind == "O":
+                raise MultiHostSnapshotError(
+                    f"object-dtype leaf at {'/'.join(parts)} — the shard "
+                    "layout records numeric/string leaves only"
+                )
+            dtype = np.dtype(host.dtype)
+            leaves_spec.append({
+                "path": parts, "shape": list(host.shape),
+                "dtype": dtype.name,
+            })
+            if rank == 0:  # host-side leaves: identical everywhere
+                key = f"{ln:05d}.0"
+                owned[key] = host
+                shards_meta.append({
+                    "leaf": ln, "key": key,
+                    "index": [[0, int(d)] for d in host.shape],
+                    "shape": list(host.shape), "dtype": dtype.name,
+                })
+    layout = {
+        "format": SHARD_FORMAT, "leaves": leaves_spec,
+        "shards": shards_meta,
+    }
+    return owned, layout
+
+
+def write_host_shards(path: str, rank: int, world: int, owned: dict,
+                      layout: dict) -> int:
+    """Durably write this host's shard payload + sharding manifest under
+    the checkpoint dir (raw little-endian bytes in the npz — dtypes like
+    bfloat16 round-trip through the layout's dtype names, not numpy's
+    header). Returns the payload byte count. Runs on the committer
+    thread, off the critical path."""
+    os.makedirs(path, exist_ok=True)
+    nbytes = 0
+    packed = {}
+    for key, arr in owned.items():
+        arr = np.ascontiguousarray(arr)
+        nbytes += arr.nbytes
+        packed[key] = np.frombuffer(arr.tobytes(), dtype=np.uint8)
+    npz = os.path.join(path, _shards_name(rank))
+    with open(npz, "wb") as f:
+        np.savez(f, **packed)
+        f.flush()
+        os.fsync(f.fileno())
+    import json
+
+    meta = dict(layout, host=int(rank), hosts=int(world))
+    lpath = os.path.join(path, _layout_name(rank))
+    tmp = lpath + ".tmp"
+    with open(tmp, "w") as f:
+        json.dump(meta, f)
+        f.flush()
+        os.fsync(f.fileno())
+    os.replace(tmp, lpath)
+    return nbytes
+
+
+def sharded_layout_present(path: str) -> bool:
+    """Does this checkpoint dir hold a sharded-save layout? (The restore
+    dispatch check: sharded checkpoints are not orbax-readable.)"""
+    return os.path.isfile(os.path.join(path, _layout_name(0)))
+
+
+def read_sharded_checkpoint(path: str) -> dict:
+    """Reassemble the full checkpoint tree (nested dicts of host numpy
+    arrays) from every recorded shard file. Refuses a shard-count
+    mismatch — fewer layout/payload files than ``SHARDS_host0.json``
+    records — with a ``ShardLayoutError`` naming the recorded sharding;
+    restoring a partial tree silently is never an option."""
+    import json
+
+    l0_path = os.path.join(path, _layout_name(0))
+    with open(l0_path) as f:
+        l0 = json.load(f)
+    hosts = int(l0["hosts"])
+    expected = [_layout_name(r) for r in range(hosts)] + [
+        _shards_name(r) for r in range(hosts)
+    ]
+    missing = [n for n in expected
+               if not os.path.isfile(os.path.join(path, n))]
+    if missing:
+        raise ShardLayoutError(
+            f"sharded checkpoint {path} records hosts={hosts} in "
+            f"{_layout_name(0)} (shard files "
+            f"{_shards_name(0)}..{_shards_name(hosts - 1)} + their "
+            f"layouts) but {len(missing)} file(s) are missing: "
+            f"{', '.join(missing)} — refusing to restore a partial "
+            "tree; restore every recorded shard file or walk back to "
+            "an earlier intact checkpoint"
+        )
+    leaves = l0["leaves"]
+    arrays = [
+        None if sp["dtype"] == "utf8"
+        else np.empty(tuple(sp["shape"]), _np_dtype(sp["dtype"]))
+        for sp in leaves
+    ]
+    covered = [0] * len(leaves)
+    for r in range(hosts):
+        with open(os.path.join(path, _layout_name(r))) as f:
+            lay = json.load(f)
+        if lay["leaves"] != leaves:
+            raise ShardLayoutError(
+                f"sharded checkpoint {path}: {_layout_name(r)} records "
+                f"a different tree spec than {_layout_name(0)} — the "
+                "shard files are not from the same save"
+            )
+        with np.load(os.path.join(path, _shards_name(r))) as z:
+            for m in lay["shards"]:
+                raw = z[m["key"]]
+                if m["dtype"] == "utf8":
+                    arrays[m["leaf"]] = raw.tobytes().decode("utf-8")
+                    covered[m["leaf"]] = 1
+                    continue
+                arr = np.frombuffer(
+                    raw.tobytes(), dtype=_np_dtype(m["dtype"])
+                ).reshape(tuple(m["shape"]))
+                idx = tuple(slice(a, b) for a, b in m["index"])
+                arrays[m["leaf"]][idx] = arr
+                covered[m["leaf"]] += arr.size
+    for ln, sp in enumerate(leaves):
+        total = int(np.prod(tuple(sp["shape"]))) if sp["shape"] else 1
+        if covered[ln] < total:
+            raise ShardLayoutError(
+                f"sharded checkpoint {path}: leaf "
+                f"{'/'.join(sp['path'])} of shape {tuple(sp['shape'])} "
+                f"is only covered {covered[ln]}/{total} elements by the "
+                f"recorded shards of hosts 0..{hosts - 1}"
+            )
+    root: dict = {}
+    for sp, arr in zip(leaves, arrays):
+        node = root
+        for p in sp["path"][:-1]:
+            node = node.setdefault(p, {})
+        node[sp["path"][-1]] = arr
+    return root
+
+
+def _np_dtype(name: str):
+    """Dtype by layout name; accelerator dtypes (bfloat16, float8_*)
+    resolve through ml_dtypes' numpy registration (imported by jax)."""
+    try:
+        return np.dtype(name)
+    except TypeError:
+        import ml_dtypes  # registered by jax; explicit for clarity
+
+        return np.dtype(getattr(ml_dtypes, name))
+
+
+def emit_shard_record(ckpt: str, host: int, hosts: int, shards: int,
+                      nbytes: int, write_s: float) -> None:
+    """One ``kind="ckpt.shard"`` record per host per sharded async save:
+    the per-host shard-commit cost run_report surfaces."""
+    telemetry_spans.emit_event(
+        "ckpt.shard", ckpt=ckpt, host=int(host), hosts=int(hosts),
+        shards=int(shards), bytes=int(nbytes),
+        write_s=round(float(write_s), 6),
+    )
 
 
 def pending_commits() -> bool:
@@ -277,11 +606,17 @@ def barrier_dir(path: str) -> str:
 def _fsync_tree(root: str) -> None:
     """fsync every file and directory under ``root`` — the durability
     attestation a host makes by ARRIVING at the barrier (the manifest's
-    own fsync pass is then redundant and skipped)."""
+    own fsync pass is then redundant and skipped). A vanished file is a
+    peer's in-flight atomic rename (sharded saves write concurrently
+    into the same dir) — that peer fsyncs its own files before arriving,
+    so skipping it here loses nothing."""
     for dirpath, _, names in os.walk(root):
         for name in names:
-            with open(os.path.join(dirpath, name), "rb") as f:
-                os.fsync(f.fileno())
+            try:
+                with open(os.path.join(dirpath, name), "rb") as f:
+                    os.fsync(f.fileno())
+            except FileNotFoundError:
+                continue
         fd = os.open(dirpath, os.O_RDONLY)
         try:
             os.fsync(fd)
@@ -352,14 +687,22 @@ def emit_barrier_record(ckpt: str, host: int, hosts: int,
 
 def multihost_commit(path: str, payload: dict, epoch_cursor: int,
                      write_payload, write_manifest, post_commit=None,
-                     rank: int | None = None,
-                     world: int | None = None) -> None:
+                     rank: int | None = None, world: int | None = None,
+                     write_local=None, sharded: bool = False) -> None:
     """One host's share of a cross-host async commit (runs on that
     host's committer thread). ``write_payload()`` writes the orbax
     payload from the primary's host snapshot; ``write_manifest()``
     commits the marker. The manifest stays strictly LAST, now behind the
     all-hosts-durable barrier. ``rank``/``world`` default from the live
-    jax process (explicit for the single-process protocol tests)."""
+    jax process (explicit for the single-process protocol tests).
+
+    Sharded saves (ISSUE 18) generalize the peer side: ``write_local``
+    is each PEER's own durable payload write (its shard file + layout),
+    run between the OPEN wait and its arrival — so arrival keeps its
+    meaning ("my share of the payload is durable") and the primary's
+    manifest digest walk covers every host's files. ``sharded=True``
+    additionally arms the ``FAULTS.KILL_AT_SHARD_BARRIER`` crash window
+    (all shards durable, manifest not committed)."""
     import jax
 
     from distribuuuu_tpu.config import cfg
@@ -387,6 +730,8 @@ def multihost_commit(path: str, payload: dict, epoch_cursor: int,
         )
         # the injectable crash window: all hosts durable, manifest NOT
         faults.maybe_kill_at_commit_barrier(path, epoch_cursor)
+        if sharded:
+            faults.maybe_kill_at_shard_barrier(path, epoch_cursor)
         write_manifest()
         if post_commit is not None:
             post_commit(payload)
@@ -397,6 +742,8 @@ def multihost_commit(path: str, payload: dict, epoch_cursor: int,
             lambda: os.path.isfile(os.path.join(bdir, _BARRIER_OPEN)),
             f"cross-host barrier open ({name})", timeout,
         )
+        if write_local is not None:
+            write_local()  # durable (fsynced) before arrival attests it
         arrive_barrier(path, rank)
         # a concurrent barrier reset (primary re-opening after a crash
         # of a previous attempt) may clear our marker: re-assert it

@@ -29,9 +29,13 @@ per-device program order that two free-running host threads used to
 scramble (the pinned PR 10 deadlock: eval's AllReduce cross-waiting
 train's at the XLA rendezvous on the 8-virtual-device mesh) is now a
 single agreed sequence. ``ASYNC.SEQUENCER=False`` restores the old
-single-device gate with a logged warning. Multi-host processes still
-degrade to synchronous eval — overlapping eval and train collectives
-ACROSS hosts needs a cross-host dispatch agreement (future work).
+single-device gate with a logged warning. Multi-host processes attach
+the cross-host dispatch ring (asyncplane/ring.py, ISSUE 18): the leader
+publishes its grant order through the run directory, followers grant
+only in that order, and eval overlaps train ACROSS hosts too. A host
+starving past ``ASYNC.RING_DEADLINE_S`` flags ``dispatch.wedge`` and
+the next epoch boundary collectively degrades that epoch's eval to
+synchronous — graceful degradation, never a hang.
 """
 
 from __future__ import annotations

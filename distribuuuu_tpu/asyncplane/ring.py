@@ -1,0 +1,226 @@
+"""Cross-host dispatch ring: ONE agreed per-device dispatch order across
+hosts (ISSUE 18 tentpole (a), lifts PR 11's multi-host concurrent-eval
+gate).
+
+Why the sequencer alone is not enough on a pod: each host's
+``DispatchSequencer`` serializes that host's threads into one local FIFO,
+but two hosts' FIFOs are independent — host 0 can grant its eval thread
+slot N while host 1 grants its train thread the same slot, the two SPMD
+programs enqueue inverted across the mesh, and the collectives cross-wait
+at the XLA rendezvous (the exact deadlock the sequencer removes within a
+host, re-created between hosts). SPMD guarantees every host's MAIN thread
+dispatches the identical train/snapshot sequence; only the concurrent-eval
+worker's interleaving position is nondeterministic per host. So the ring's
+job is small and precise: agree on WHICH STREAM owns each global dispatch
+slot, nothing else — the per-host sequencer keeps its completion-fence
+discipline untouched.
+
+Protocol (the ``multihost_commit`` barrier-directory pattern, not a
+socket ring — same shared-filesystem assumption, same bounded-wait
+contract):
+
+* the LEADER (process 0) grants its local FIFO exactly as before and
+  *publishes* each decision: a ``sw_NNNNNN`` record whenever the granted
+  stream CHANGES (``{"seq": first slot of the new stream, "stream": s}``)
+  and then an atomically-replaced ``watermark`` file
+  (``{"seq": last granted slot, "sw": switch records valid}``). Switch
+  records are written before the watermark that advertises them, so a
+  follower never reads a dangling reference. The leader never waits on
+  followers — publishing is O(one rename) per grant.
+* FOLLOWERS replace the ticket FIFO with agreed-order acquire: grant
+  local slot N to stream S only when the watermark covers N *and* the
+  published switch history says slot N belongs to S. A follower thread
+  whose stream does not own the slot waits for a local peer thread to
+  consume it (that peer always eventually arrives, by SPMD symmetry).
+  Followers may lag the leader by a poll interval; they can never
+  OUTRUN it — which is the correctness property.
+
+Degradation, never a hang: a follower blocked past
+``ASYNC.RING_DEADLINE_S`` flags ``dispatch.wedge`` (the same stall
+contract as every other wedge) and marks the ring wedged — the trainer
+sees the flag at the epoch boundary and runs THAT epoch's eval
+synchronously (a single-threaded sync eval needs no cross-host agreement:
+one thread per host is already one program order). Past
+``ASYNC.BARRIER_TIMEOUT_S`` of zero leader progress the follower DETACHES
+(local-FIFO fallback, error-logged): a leader silent that long is a dead
+or partitioned host, which is the group scheduler's restart to make — the
+follower's job is to not hang forever on it. ``FAULTS.WEDGE_RING`` injects
+the finite version of this failure for the ``ring_wedge_degrade`` drill.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import shutil
+import time
+from bisect import bisect_right
+
+from distribuuuu_tpu.utils.logger import get_logger
+
+_OPEN = "OPEN"
+_WATERMARK = "watermark"
+
+
+def _write_atomic(path: str, payload: dict) -> None:
+    """tmp + fsync + rename: a reader sees the old record or the new one,
+    never a torn write (same discipline as the checkpoint manifest)."""
+    tmp = path + ".tmp"
+    with open(tmp, "w") as f:
+        json.dump(payload, f)
+        f.flush()
+        os.fsync(f.fileno())
+    os.replace(tmp, path)
+
+
+def _read_json(path: str):
+    try:
+        with open(path) as f:
+            return json.load(f)
+    except (OSError, ValueError):
+        return None
+
+
+class CrossHostRing:
+    """The published-order half of cross-host dispatch agreement; the
+    per-host ``DispatchSequencer`` drives it (leader: ``publish``,
+    follower: ``agreed_stream``)."""
+
+    def __init__(self, root: str, rank: int, world: int, deadline_s: float,
+                 *, detach_after_s: float = 600.0, logger=None):
+        if not deadline_s > 0:
+            raise ValueError(
+                "ASYNC.RING_DEADLINE_S must be a positive number of "
+                f"seconds (got {deadline_s!r}) — it bounds how long a "
+                "follower waits for the leader's dispatch watermark "
+                "before flagging dispatch.wedge and degrading to "
+                "sync-eval for the epoch"
+            )
+        self.root = os.path.abspath(root)
+        self.rank = int(rank)
+        self.world = int(world)
+        self.leader = self.rank == 0
+        self.deadline_s = float(deadline_s)
+        self.detach_after_s = float(detach_after_s)
+        self.logger = logger or get_logger()
+        self.wedged = False     # sticky: a deadline was missed
+        self.detached = False   # terminal: local-FIFO fallback
+        self.stats = {
+            "slots": 0,             # leader: published; follower: granted
+            "switches": 0,          # leader: switch records written
+            "total_wait_s": 0.0,    # follower: agreed-slot waits
+            "max_wait_s": 0.0,
+            "deadline_misses": 0,
+        }
+        # leader publish state
+        self._pub_stream: str | None = None
+        self._pub_switches = 0
+        # follower cache of the published order
+        self._wm_seq = -1
+        self._switch_seqs: list[int] = []
+        self._switch_streams: list[str] = []
+
+    # ------------------------------------------------------------ set-up
+    def open(self, timeout: float) -> None:
+        """Leader: fresh-clear the ring directory and raise the OPEN
+        sentinel (stale state from a previous attempt must never leak
+        into this run's order). Follower: bounded wait for OPEN."""
+        if self.leader:
+            shutil.rmtree(self.root, ignore_errors=True)
+            os.makedirs(self.root, exist_ok=True)
+            sentinel = os.path.join(self.root, _OPEN)
+            with open(sentinel, "w") as f:
+                f.write("open\n")
+                f.flush()
+                os.fsync(f.fileno())
+            return
+        sentinel = os.path.join(self.root, _OPEN)
+        deadline = time.monotonic() + float(timeout)
+        while not os.path.exists(sentinel):
+            if time.monotonic() > deadline:
+                raise TimeoutError(
+                    f"dispatch ring never opened: host {self.rank} waited "
+                    f"{timeout:.0f}s (ASYNC.BARRIER_TIMEOUT_S) for the "
+                    f"leader's OPEN sentinel under {self.root}"
+                )
+            time.sleep(0.02)
+
+    # ------------------------------------------------------------ leader
+    def publish(self, seq: int, stream: str) -> None:
+        """Record that global slot ``seq`` was granted to ``stream``.
+        Called by the leader's sequencer under its token — already
+        serialized, and in exactly the granted order."""
+        if stream != self._pub_stream:
+            _write_atomic(
+                os.path.join(self.root, f"sw_{self._pub_switches:06d}"),
+                {"seq": int(seq), "stream": stream},
+            )
+            self._pub_switches += 1
+            self._pub_stream = stream
+            self.stats["switches"] += 1
+        _write_atomic(
+            os.path.join(self.root, _WATERMARK),
+            {"seq": int(seq), "sw": self._pub_switches},
+        )
+        self.stats["slots"] += 1
+
+    # ---------------------------------------------------------- follower
+    def agreed_stream(self, seq: int) -> str | None:
+        """The stream the leader granted global slot ``seq`` to, or None
+        while the watermark has not covered it yet (poll again)."""
+        if self._wm_seq < seq and not self._refresh(seq):
+            return None
+        i = bisect_right(self._switch_seqs, seq) - 1
+        if i < 0:
+            return None
+        return self._switch_streams[i]
+
+    def _refresh(self, seq: int) -> bool:
+        """Re-read the watermark (and any switch records it newly
+        advertises) into the local cache; False = slot not covered yet."""
+        wm = _read_json(os.path.join(self.root, _WATERMARK))
+        if wm is None or int(wm.get("seq", -1)) < seq:
+            return False
+        want = int(wm["sw"])
+        fresh_seqs, fresh_streams = [], []
+        for k in range(len(self._switch_seqs), want):
+            sw = _read_json(os.path.join(self.root, f"sw_{k:06d}"))
+            if sw is None:
+                return False  # advertised but not visible yet: retry
+            fresh_seqs.append(int(sw["seq"]))
+            fresh_streams.append(str(sw["stream"]))
+        self._switch_seqs.extend(fresh_seqs)
+        self._switch_streams.extend(fresh_streams)
+        self._wm_seq = int(wm["seq"])
+        return True
+
+    def detach(self, waited: float) -> None:
+        """Last-resort fallback after ``detach_after_s`` of zero leader
+        progress: stop agreeing, grant locally (and say so loudly) — a
+        hung follower helps nobody, and a leader dead this long means
+        the group scheduler owes everyone a restart anyway."""
+        self.detached = True
+        self.logger.error(
+            "dispatch ring DETACHED on host %d: no leader watermark "
+            "progress for %.0fs (ASYNC.BARRIER_TIMEOUT_S=%.0fs) — "
+            "falling back to host-local dispatch order; cross-host "
+            "dispatch agreement is OFF for the rest of this attempt "
+            "(see docs/RUNBOOK.md 'Async on a pod, for real')",
+            self.rank, waited, self.detach_after_s,
+        )
+
+    # --------------------------------------------------------- telemetry
+    def snapshot_stats(self) -> dict:
+        st = self.stats
+        return {
+            "host": self.rank,
+            "hosts": self.world,
+            "role": "leader" if self.leader else "follower",
+            "slots": st["slots"],
+            "switches": st["switches"],
+            "total_wait_s": round(st["total_wait_s"], 6),
+            "max_wait_s": round(st["max_wait_s"], 6),
+            "deadline_misses": st["deadline_misses"],
+            "wedged": bool(self.wedged),
+            "detached": bool(self.detached),
+        }

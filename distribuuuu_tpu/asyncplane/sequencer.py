@@ -54,6 +54,17 @@ trainer-blocked time with concurrent eval ON at 8 devices).
 
 ``ASYNC.SEQUENCER=False`` is the escape hatch: the trainer then
 restores the PR 10 degrade-to-sync gates with a logged warning.
+
+On MULTI-HOST runs the local FIFO is not enough — two hosts' FIFOs can
+grant the same global slot to different streams and re-create the
+inversion between hosts. ``install_ring`` attaches a
+``ring.CrossHostRing`` (ISSUE 18): the leader (process 0) publishes its
+grant order through an atomically-replaced watermark file, followers
+grant slots only in that published order (``_acquire_agreed``), and a
+follower blocked past ``ASYNC.RING_DEADLINE_S`` flags ``dispatch.wedge``
+and marks the ring wedged so the trainer degrades THAT epoch's eval to
+sync instead of hanging. Ring aggregates ride out as
+``kind="dispatch.ring"`` records next to the token stats.
 """
 
 from __future__ import annotations
@@ -84,6 +95,9 @@ class DispatchSequencer:
         self._last_stream: str | None = None  # stream of the last dispatch
         self._fence = None      # last dispatched outputs of _last_stream
         self._wedges = 0
+        self._ring = None       # CrossHostRing when multi-host (ISSUE 18)
+        self._slot = 0          # next global slot (follower agreed-order)
+        self._ring_wedged = False  # sticky until the trainer re-arms
         self.stats = {
             "tokens": 0,
             "streams": {},          # stream -> tokens granted
@@ -134,9 +148,18 @@ class DispatchSequencer:
             yield
 
     # ---------------------------------------------------------- the ring
+    def attach_ring(self, ring) -> None:
+        """Wire a ``ring.CrossHostRing``: the leader publishes every local
+        grant, followers switch to agreed-order acquire. Called once by
+        ``install_ring`` before the second dispatch stream starts."""
+        self._ring = ring
+
     def acquire(self, stream: str) -> int:
         """Block until this thread holds the dispatch token; returns the
         token number (tokens are granted in one global FIFO order)."""
+        ring = self._ring
+        if ring is not None and not ring.leader:
+            return self._acquire_agreed(stream)
         with self._cond:
             ticket = self._next_ticket
             self._next_ticket += 1
@@ -154,7 +177,62 @@ class DispatchSequencer:
         st["total_wait_s"] += wait
         st["max_wait_s"] = max(st["max_wait_s"], wait)
         self._holder = stream
+        if ring is not None:  # leader: publish the grant for followers
+            from distribuuuu_tpu.utils import faults
+
+            faults.maybe_wedge_ring(ticket)  # injection no-op
+            ring.publish(ticket, stream)
         return ticket
+
+    def _acquire_agreed(self, stream: str) -> int:
+        """Follower acquire on a multi-host ring: grant local slot N to
+        ``stream`` only when the leader's published order says slot N
+        belongs to it — a follower may LAG the leader by a poll interval
+        but can never outrun its decisions, which is what keeps every
+        host's per-device enqueue order identical. Blocked past the ring
+        deadline: flag ``dispatch.wedge`` + mark the ring wedged (the
+        trainer degrades that epoch's eval to sync). Blocked past
+        ``detach_after_s`` with no leader progress: detach (local FIFO,
+        error-logged) — degradation over a silent hang, always."""
+        ring = self._ring
+        t0 = time.perf_counter()
+        flagged = False
+        with self._watched(f"ring slot wait, stream {stream!r}"):
+            with self._cond:
+                while True:
+                    if self._holder is None:
+                        if ring.detached:
+                            break
+                        agreed = ring.agreed_stream(self._slot)
+                        if agreed == stream:
+                            break
+                    waited = time.perf_counter() - t0
+                    if not flagged and waited > ring.deadline_s:
+                        flagged = True
+                        ring.wedged = True
+                        self._ring_wedged = True
+                        ring.stats["deadline_misses"] += 1
+                        self._flag_wedge(
+                            f"ring slot {self._slot} ({stream!r})", waited
+                        )
+                    if waited > ring.detach_after_s:
+                        ring.detach(waited)
+                        continue  # re-check: grant on _holder alone now
+                    self._cond.wait(0.05)
+                slot = self._slot
+                self._slot += 1
+                self._holder = stream
+        wait = time.perf_counter() - t0
+        st = self.stats
+        st["tokens"] += 1
+        st["streams"][stream] = st["streams"].get(stream, 0) + 1
+        st["total_wait_s"] += wait
+        st["max_wait_s"] = max(st["max_wait_s"], wait)
+        rst = ring.stats
+        rst["slots"] += 1
+        rst["total_wait_s"] += wait
+        rst["max_wait_s"] = max(rst["max_wait_s"], wait)
+        return slot
 
     def _fence_previous(self, stream: str) -> None:
         """The stream-switch fence: before dispatching into a different
@@ -244,6 +322,51 @@ def install(wedge_timeout: float = 0.0, logger=None) -> DispatchSequencer:
     return _active
 
 
+def install_ring(root: str, rank: int, world: int, deadline_s: float, *,
+                 detach_after_s: float = 600.0, logger=None):
+    """Attach the cross-host dispatch ring to the installed sequencer
+    (the trainer calls this on multi-host runs right after ``install``).
+    The leader fresh-clears ``root`` and raises the OPEN sentinel;
+    followers block (bounded by ``detach_after_s``, the barrier-timeout
+    contract) until it appears — stale order from a previous attempt can
+    never leak in. Idempotent once attached."""
+    from distribuuuu_tpu.asyncplane import ring as ring_mod
+
+    seq = _active
+    if seq is None:
+        raise RuntimeError(
+            "install_ring requires an installed sequencer — call "
+            "sequencer.install() first"
+        )
+    if seq._ring is not None:
+        return seq._ring
+    r = ring_mod.CrossHostRing(
+        root, rank, world, deadline_s,
+        detach_after_s=detach_after_s, logger=logger or seq.logger,
+    )
+    r.open(timeout=detach_after_s)
+    seq.attach_ring(r)
+    return r
+
+
+def ring_installed() -> bool:
+    return _active is not None and _active._ring is not None
+
+
+def ring_wedged() -> bool:
+    """True when a follower missed its ring deadline since the last
+    re-arm — the trainer's epoch-boundary signal to run THAT epoch's
+    eval synchronously instead of launching the concurrent worker."""
+    return _active is not None and _active._ring_wedged
+
+
+def clear_ring_wedge() -> None:
+    """Re-arm after the degraded epoch (the wedge record already
+    flagged; a persistent wedge just flags again next epoch)."""
+    if _active is not None:
+        _active._ring_wedged = False
+
+
 def installed() -> bool:
     return _active is not None
 
@@ -283,3 +406,13 @@ def emit_stats(**extra) -> None:
     telemetry_spans.emit_event(
         "dispatch.token", **seq.snapshot_stats(), **extra
     )
+    ring = seq._ring
+    if ring is not None:
+        rs = ring.snapshot_stats()
+        telemetry_spans.emit_event(
+            "dispatch.ring", host=rs["host"], hosts=rs["hosts"],
+            role=rs["role"], slots=rs["slots"], switches=rs["switches"],
+            total_wait_s=rs["total_wait_s"], max_wait_s=rs["max_wait_s"],
+            deadline_misses=rs["deadline_misses"], wedged=rs["wedged"],
+            detached=rs["detached"], **extra,
+        )

@@ -664,6 +664,32 @@ _C.FAULTS.WEDGE_S = 0.0
 # quarantine the manifest-less dir and walk back
 # (tools/resilience_drill.py multihost_async_save_kill). -1 = off.
 _C.FAULTS.KILL_AT_COMMIT_BARRIER = -1
+# Hold the LEADER's cross-host ring slot #WEDGE_RING for WEDGE_RING_S
+# seconds BEFORE its order publishes (asyncplane/ring.py): followers
+# starve at that slot past ASYNC.RING_DEADLINE_S, must flag
+# kind="dispatch.wedge", and the trainer must run that epoch's eval
+# synchronously — degraded, never hung (tools/resilience_drill.py
+# ring_wedge_degrade). WEDGE_RING_S must exceed ASYNC.RING_DEADLINE_S or
+# the wedge is unobservable (validated, utils/faults.validate_cfg).
+# -1 = off.
+_C.FAULTS.WEDGE_RING = -1
+_C.FAULTS.WEDGE_RING_S = 0.0
+# SIGKILL the PRIMARY inside the SHARDED async-commit crash window:
+# every host's shard file durable + all barrier arrivals in, but
+# MANIFEST.json not committed (the sharded protocol's analogue of
+# KILL_AT_COMMIT_BARRIER). The restart must quarantine the manifest-less
+# dir — shard files and all — and walk back
+# (tools/resilience_drill.py sharded_save_kill_at_barrier). -1 = off.
+_C.FAULTS.KILL_AT_SHARD_BARRIER = -1
+# After ckpt_ep_{DROP_SHARD_FILE} fully commits: delete host
+# DROP_SHARD_HOST's shards_host<r>.npz from it (primary's post-commit
+# hook). The next restart's manifest verification must fail the digest
+# walk, quarantine, and walk back; a DIRECT load must refuse with the
+# recorded sharding named (tools/resilience_drill.py
+# sharded_restore_fewer_shards). DROP_SHARD_HOST must be a valid host
+# rank — validated against the live world at the hook site. -1 = off.
+_C.FAULTS.DROP_SHARD_FILE = -1
+_C.FAULTS.DROP_SHARD_HOST = 1
 
 # ------------------------------- async dispatch plane ------------------------
 # The dispatch sequencer (asyncplane/sequencer.py): the primitive that
@@ -687,6 +713,14 @@ _C.ASYNC.SEQUENCER = True
 # before the background commit fails (surfaced as AsyncCommitError at
 # the next join barrier — never silent, never a hang).
 _C.ASYNC.BARRIER_TIMEOUT_S = 600.0
+# Cross-host dispatch ring (multi-host concurrent eval, ISSUE 18): how
+# long a FOLLOWER waits for the leader's published dispatch order before
+# flagging kind="dispatch.wedge" and degrading that epoch's eval to
+# synchronous (asyncplane/ring.py). The run keeps going either way; past
+# BARRIER_TIMEOUT_S of zero leader progress the follower detaches to
+# host-local order with an error log (a leader silent that long is a
+# dead host — the group scheduler's restart to make). Seconds, > 0.
+_C.ASYNC.RING_DEADLINE_S = 30.0
 
 # ------------------------------- checkpointing ------------------------------
 # Async execution plane (distribuuuu_tpu/asyncplane/): checkpoint commit off
@@ -706,9 +740,14 @@ _C.ASYNC.BARRIER_TIMEOUT_S = 600.0
 # on a cross-host commit barrier — per-host background threads, payload
 # durable on every host, MANIFEST.json strictly last behind the
 # all-hosts-durable barrier (asyncplane/committer.py; a host killed
-# between barrier and manifest is recovered by the walk-back). Only a
-# state tree sharded ACROSS hosts (e.g. ZeRO over a cross-host axis)
-# still degrades to the synchronous collective save, with a warning.
+# between barrier and manifest is recovered by the walk-back). A state
+# tree sharded ACROSS hosts (e.g. ZeRO over a cross-host axis) commits
+# through the SHARDED variant of the same protocol: each host writes its
+# own shards_host<r>.npz + layout under the barrier, the manifest
+# records the sharding, restore reassembles elastically (ISSUE 18).
+# Only trees a host snapshot cannot represent at all (non-dict
+# containers, object-dtype leaves) still degrade to the synchronous
+# collective save, with a warning.
 _C.CHECKPOINT = CfgNode()
 _C.CHECKPOINT.ASYNC = False
 
@@ -726,11 +765,14 @@ _C.CHECKPOINT.ASYNC = False
 # (ASYNC.SEQUENCER, asyncplane/sequencer.py): train/eval/snapshot
 # dispatches are token-ordered into one global program sequence, which
 # removes the cross-thread collective deadlock PR 10 pinned on the
-# 8-virtual-device mesh. Multi-host processes still degrade to
-# synchronous eval with a logged warning (eval collectives cannot
-# overlap train collectives across hosts without a cross-host dispatch
-# agreement — future work), as does ASYNC.SEQUENCER=False on
-# multi-device (the explicit escape hatch).
+# 8-virtual-device mesh. Multi-host processes attach the cross-host
+# dispatch ring (asyncplane/ring.py, ISSUE 18): the leader publishes
+# its grant order through the run directory and followers grant only
+# in that order, so eval overlaps train ACROSS hosts too; a host
+# starving past ASYNC.RING_DEADLINE_S flags dispatch.wedge and that
+# epoch's eval collectively degrades to sync (never a hang).
+# ASYNC.SEQUENCER=False on multi-device remains the explicit escape
+# hatch, degrading to synchronous eval with a logged warning.
 _C.TRAIN.CONCURRENT_EVAL = False
 
 # ------------------------------- compilation cache ---------------------------

@@ -75,6 +75,13 @@ def config_fingerprint() -> str:
     ).hexdigest()
 
 
+def _leaf_dtype(leaf) -> str:
+    # lazy fallback only: np.asarray on a cross-host-sharded jax.Array
+    # raises, and getattr's default argument would evaluate it EAGERLY
+    dt = getattr(leaf, "dtype", None)
+    return str(dt) if dt is not None else str(np.asarray(leaf).dtype)
+
+
 def tree_spec(tree) -> dict:
     """Flattened leaf spec: jax key path → {"shape", "dtype"}. Works on
     host numpy and device arrays alike (only metadata is read — safe for
@@ -83,7 +90,7 @@ def tree_spec(tree) -> dict:
     return {
         jax.tree_util.keystr(path): {
             "shape": list(np.shape(leaf)),
-            "dtype": str(getattr(leaf, "dtype", np.asarray(leaf).dtype)),
+            "dtype": _leaf_dtype(leaf),
         }
         for path, leaf in leaves
     }
@@ -132,7 +139,10 @@ def manifest_path(ckpt_dir: str) -> str:
 
 def write_manifest(ckpt_dir: str, payload, kind: str = "full",
                    epoch: int | None = None,
-                   fsync_payload: bool = False) -> str:
+                   fsync_payload: bool = False,
+                   tree: dict | None = None,
+                   topology: dict | None = None,
+                   sharded: dict | None = None) -> str:
     """Commit marker for a completed save. Call AFTER the orbax write has
     returned on every process, from the primary only (a plain filesystem
     op, like ``prune_preempts``). Atomic: tmp file + ``os.replace``.
@@ -143,7 +153,14 @@ def write_manifest(ckpt_dir: str, payload, kind: str = "full",
     through a power loss, not just a process death: a durable manifest
     can then never describe payload bytes the kernel still held. Off the
     critical path the fsync pass is free to the trainer; the synchronous
-    protocol keeps the classic ordering (process-death-safe) by default."""
+    protocol keeps the classic ordering (process-death-safe) by default.
+
+    ``tree``/``topology`` override the live-payload reads for saves whose
+    committer thread holds no full payload (the sharded multi-host
+    protocol computes both eagerly on-path and passes them in; ``payload``
+    may then be None). ``sharded`` records the shard layout summary
+    (hosts + shard file names) so the manifest itself names the recorded
+    sharding."""
     files = {}
     dirs = set()
     for dirpath, _, names in os.walk(ckpt_dir):
@@ -171,10 +188,13 @@ def write_manifest(ckpt_dir: str, payload, kind: str = "full",
         "kind": kind,
         "epoch": None if epoch is None else int(epoch),
         "fingerprint": config_fingerprint(),
-        "topology": world_topology(payload),
-        "tree": tree_spec(payload),
+        "topology": world_topology(payload) if topology is None
+        else topology,
+        "tree": tree_spec(payload) if tree is None else tree,
         "files": files,
     }
+    if sharded is not None:
+        man["sharded"] = sharded
     dest = manifest_path(ckpt_dir)
     tmp = dest + ".tmp"
     with open(tmp, "w") as f:

@@ -72,9 +72,20 @@ KINDS: dict[str, frozenset] = {
     # a wedged dispatcher flagged by the sequencer's watchdog (the
     # monitor's dispatch-wedge rule input)
     "dispatch.wedge": frozenset({"age_s", "holder", "count"}),
+    # cross-host dispatch ring aggregates (asyncplane/ring.py), emitted
+    # at epoch boundaries next to dispatch.token: role is "leader" |
+    # "follower", slots/waits are the ring-granted dispatch counts
+    "dispatch.ring": frozenset(
+        {"host", "hosts", "role", "slots", "max_wait_s", "wedged"}
+    ),
     # one per host per multi-host async save: the cross-host commit
     # barrier wait (asyncplane/committer.py multihost_commit)
     "ckpt.barrier": frozenset({"ckpt", "host", "hosts", "wait_s"}),
+    # one per host per SHARDED async save (utils/checkpoint._save_sharded):
+    # this host's own-shard write — count, bytes, duration
+    "ckpt.shard": frozenset(
+        {"ckpt", "host", "hosts", "shards", "bytes", "write_s"}
+    ),
     # -- XLA cost-model ledger (telemetry/costmodel.py) ------------------
     # per-step flops/bytes from cost_analysis (source "xla") or the hand
     # table (source "analytic"); peak_flops is the full-mesh peak so

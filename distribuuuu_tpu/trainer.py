@@ -23,6 +23,7 @@ TPU-first redesign of the hot loop (ref call stack: SURVEY.md §3.1):
 from __future__ import annotations
 
 import functools
+import os
 import time
 from typing import Any
 
@@ -1133,6 +1134,9 @@ def train_model():
     topo = check_trainer_mesh()
     setup_env()
     logger = setup_logger()
+    # armed FAULTS.* knobs with impossible arithmetic fail HERE, naming
+    # the knobs and units — not hours later at the injection point
+    faults.validate_cfg()
     setup_metrics_log(cfg.OUT_DIR, primary=mesh_lib.is_primary())
     # per-rank telemetry sink (telemetry/): spans, compile events, registry
     # snapshots, mirrored resilience events — rank-local signals survive on
@@ -1252,20 +1256,16 @@ def train_model():
     # (asyncplane/sequencer.py): train/eval/snapshot dispatches are
     # token-ordered into one global program sequence, which removes the
     # cross-thread collective deadlock PR 10 pinned on the
-    # 8-virtual-device mesh. Multi-host still degrades (cross-host
-    # dispatch agreement is future work), as does ASYNC.SEQUENCER=False
-    # on multi-device — the explicit escape hatch.
+    # 8-virtual-device mesh. Multi-host additionally attaches the
+    # cross-host dispatch ring (asyncplane/ring.py, ISSUE 18): process 0
+    # publishes its grant order through the shared OUT_DIR, followers
+    # grant only in that order — two SPMD programs from two host threads
+    # enqueue in ONE per-device order on EVERY host, which lifts the
+    # PR 11 degrade-to-sync. ASYNC.SEQUENCER=False on multi-device stays
+    # the explicit escape hatch.
     conc_eval = None
     if cfg.TRAIN.CONCURRENT_EVAL:
-        if jax.process_count() > 1:
-            logger.warning(
-                "TRAIN.CONCURRENT_EVAL requested but process_count=%d — "
-                "multi-host eval collectives cannot overlap train "
-                "collectives without a cross-host dispatch agreement; "
-                "falling back to synchronous eval",
-                jax.process_count(),
-            )
-        elif jax.device_count() > 1 and not cfg.ASYNC.SEQUENCER:
+        if jax.device_count() > 1 and not cfg.ASYNC.SEQUENCER:
             logger.warning(
                 "TRAIN.CONCURRENT_EVAL requested with "
                 "ASYNC.SEQUENCER=False and device_count=%d — without "
@@ -1282,6 +1282,36 @@ def train_model():
                     "dispatches token-ordered across %d devices "
                     "(ASYNC.SEQUENCER)", jax.device_count(),
                 )
+            if jax.process_count() > 1:
+                # leader opens (fresh-clears) the ring FIRST, then every
+                # host syncs, then followers attach — a follower can
+                # never read a stale OPEN/watermark from a previous
+                # attempt of this OUT_DIR
+                from jax.experimental import multihost_utils
+
+                ring_root = os.path.join(cfg.OUT_DIR, ".dispatch_ring")
+                rank, world = jax.process_index(), jax.process_count()
+                if rank == 0:
+                    sequencer.install_ring(
+                        ring_root, rank, world, cfg.ASYNC.RING_DEADLINE_S,
+                        detach_after_s=cfg.ASYNC.BARRIER_TIMEOUT_S,
+                        logger=logger,
+                    )
+                multihost_utils.sync_global_devices("dtpu dispatch ring open")
+                if rank != 0:
+                    sequencer.install_ring(
+                        ring_root, rank, world, cfg.ASYNC.RING_DEADLINE_S,
+                        detach_after_s=cfg.ASYNC.BARRIER_TIMEOUT_S,
+                        logger=logger,
+                    )
+                logger.info(
+                    "cross-host dispatch ring active: host %d/%d %s via "
+                    "%s (deadline %.0fs — see docs/RUNBOOK.md 'Async on "
+                    "a pod, for real')", rank, world,
+                    "publishes the grant order" if rank == 0
+                    else "follows the published order", ring_root,
+                    cfg.ASYNC.RING_DEADLINE_S,
+                )
             conc_eval = asyncplane.ConcurrentEval(
                 lambda snap, ep: validate(
                     val_loader, mesh, snap, eval_step, ep, logger,
@@ -1292,6 +1322,25 @@ def train_model():
                 "concurrent eval: validate() overlaps the next train "
                 "epoch; results join one boundary later"
             )
+
+    def _ring_degraded_boundary():
+        """Did ANY host miss its ring deadline this epoch? The answer is
+        collective (``requested_global`` idiom) because the degraded
+        boundary dispatches a different program sequence — a host-local
+        decision would re-create the very cross-host inversion the ring
+        exists to prevent. Safe to run a collective here: the previous
+        eval has joined and the epoch's train steps are dispatched, so
+        every host appends this program at the same sequence point.
+        Clears the sticky flag (a persistent wedge re-flags next epoch)."""
+        if not sequencer.ring_installed():
+            return False
+        from jax.experimental import multihost_utils
+
+        flags = multihost_utils.process_allgather(
+            np.int32(1 if sequencer.ring_wedged() else 0)
+        )
+        sequencer.clear_ring_wedge()
+        return bool(np.asarray(flags).sum() > 0)
 
     def _join_concurrent_eval():
         """Join the in-flight eval (no-op when none): emit the deferred
@@ -1460,10 +1509,25 @@ def train_model():
                 # records best_acc1 as of the previous eval (this epoch's is
                 # in flight); the best side-write itself lands at join.
                 _join_concurrent_eval()
-                ckpt.save_checkpoint(
-                    _state_tree(state), epoch, best_acc1, is_best=False
-                )
-                conc_eval.launch(state, epoch)
+                if _ring_degraded_boundary():
+                    # a host missed its ring deadline this epoch: every
+                    # host (collectively agreed) runs THIS epoch's eval
+                    # synchronously — graceful degradation, never a hang;
+                    # the next boundary re-tries the concurrent path
+                    logger.warning(
+                        "dispatch ring wedged during epoch %d — running "
+                        "this epoch's eval synchronously (the ring "
+                        "re-arms next epoch; persistent wedges re-flag)",
+                        epoch + 1,
+                    )
+                    path = _finish_epoch(epoch)
+                    if path is not None:
+                        return _preempt_exit(path, epoch + 1)
+                else:
+                    ckpt.save_checkpoint(
+                        _state_tree(state), epoch, best_acc1, is_best=False
+                    )
+                    conc_eval.launch(state, epoch)
             else:
                 path = _finish_epoch(epoch)
                 if path is not None:  # eval was preempted (validate → None)

@@ -28,10 +28,13 @@ strictly LAST — the crash-consistency story above is byte-for-byte the
 same, just off the critical path. Multi-host runs commit async too:
 each host's committer thread runs the cross-host commit barrier
 (asyncplane/committer.py ``multihost_commit`` — payload durable on
-every host BEFORE the primary's manifest), unless ``ASYNC.SEQUENCER``
-is off (the escape hatch) or the state tree is sharded across hosts
-(host-local snapshots cannot represent it) — those degrade to the
-synchronous collective protocol with one logged warning. Preempt saves
+every host BEFORE the primary's manifest). A tree sharded ACROSS hosts
+commits through the SHARDED variant (``_save_sharded``, ISSUE 18):
+each host writes its own addressable shards under the barrier and the
+manifest records the sharding. Degrades to the synchronous collective
+protocol with one logged warning remain only for ``ASYNC.SEQUENCER``
+off (the escape hatch) and trees a host snapshot cannot represent at
+all (non-dict containers, object-dtype leaves). Preempt saves
 always drain the committer first and commit synchronously — the
 process is about to exit, and the grace window must end with a durable
 manifest.
@@ -252,8 +255,9 @@ def async_enabled() -> bool:
     background committer threads rendezvous on payload durability and
     the manifest commits strictly last — unless ``ASYNC.SEQUENCER`` is
     off (the explicit escape hatch restoring the PR 10 single-host
-    gate, warned once). A state tree sharded ACROSS hosts additionally
-    degrades at snapshot time (see ``_save_full``)."""
+    gate, warned once). A state tree sharded ACROSS hosts commits
+    through the SHARDED protocol (``_save_sharded``, ISSUE 18) — each
+    host writes its own shards under the barrier."""
     if not cfg.CHECKPOINT.ASYNC:
         return False
     if jax.process_count() > 1 and not cfg.ASYNC.SEQUENCER:
@@ -345,6 +349,34 @@ def _save_full(
         # committer thread only runs the barrier protocol.
         multihost = jax.process_count() > 1
         snapshot_s = 0.0
+        if multihost and committer.tree_is_cross_host_sharded(payload):
+            # state sharded ACROSS hosts (ZeRO over a cross-host axis):
+            # the SHARDED protocol (ISSUE 18) — each host snapshots the
+            # shards it owns on-path and its committer thread writes
+            # them under the cross-host barrier. This replaces the PR 11
+            # degrade-to-sync; MultiHostSnapshotError remains the safety
+            # valve for trees the shard layout cannot record.
+            try:
+                return _save_sharded(
+                    path, payload, epoch_cursor, name, post_commit
+                )
+            except committer.MultiHostSnapshotError as e:
+                if not _state.get("snapshot_warned"):
+                    _state["snapshot_warned"] = True
+                    from distribuuuu_tpu.utils.logger import get_logger
+
+                    get_logger().warning(
+                        "CHECKPOINT.ASYNC: the sharded save protocol "
+                        "cannot record this tree (%s) — committing "
+                        "synchronously (collective)", e,
+                    )
+                # the synchronous collective save, verbatim
+                with telemetry_spans.span(
+                    "ckpt_save", track="ckpt", ckpt=name,
+                    epoch=int(epoch_cursor),
+                ):
+                    _commit(path, payload, epoch_cursor, post_commit)
+                return path
         try:
             if not multihost or jax.process_index() == 0:
                 t0 = _time.perf_counter()
@@ -355,8 +387,7 @@ def _save_full(
                     payload = committer.snapshot_tree(payload)
                 snapshot_s = _time.perf_counter() - t0
         except committer.MultiHostSnapshotError as e:
-            # cross-host-sharded state (e.g. ZeRO over a cross-host
-            # axis): a host-local snapshot cannot represent it — the
+            # a host-local snapshot cannot represent this tree — the
             # save stays on the synchronous collective protocol
             if not _state.get("snapshot_warned"):
                 _state["snapshot_warned"] = True
@@ -431,6 +462,85 @@ def _save_full(
     return path
 
 
+def _save_sharded(path: str, payload: dict, epoch_cursor: int, name: str,
+                  post_commit=None) -> str:
+    """The cross-host SHARDED async commit (ISSUE 18): generalizes the
+    solo-checkpointer trick so every host's committer thread writes its
+    OWN addressable shards under the existing barrier.
+
+    On-path (this call): each host snapshots only the shards it owns
+    (``replica_id == 0`` — donation-safe host copies; the union over
+    hosts covers every element exactly once) and computes the manifest's
+    tree/topology eagerly (metadata-only reads, safe on partially-
+    addressed arrays — the committer thread never holds a full payload).
+    Off-path: peers write ``shards_host<r>.npz`` + ``SHARDS_host<r>.json``
+    between the barrier's OPEN wait and their arrival, the primary writes
+    its own as the payload and commits MANIFEST.json strictly last — its
+    digest walk covers every host's shard files, so a lost shard file
+    fails verification and quarantines + walks back like any torn save.
+    Bounded by ``ASYNC.BARRIER_TIMEOUT_S``; failures surface as
+    ``AsyncCommitError`` at the next join, never silently."""
+    import time as _time
+
+    from distribuuuu_tpu.asyncplane import committer
+
+    rank, world = jax.process_index(), jax.process_count()
+    t0 = _time.perf_counter()
+    with telemetry_spans.span(
+        "ckpt_snapshot", track="ckpt", ckpt=name, epoch=int(epoch_cursor),
+    ):
+        owned, layout = committer.snapshot_host_shards(payload, rank)
+        tree = manifest_lib.tree_spec(payload)
+        topology = manifest_lib.world_topology(payload)
+    snapshot_s = _time.perf_counter() - t0
+    sharded_rec = {
+        "hosts": world,
+        "files": [f"shards_host{r}.npz" for r in range(world)],
+    }
+
+    def _write_mine():
+        w0 = _time.perf_counter()
+        nbytes = committer.write_host_shards(path, rank, world, owned,
+                                             layout)
+        committer.emit_shard_record(
+            name, rank, world, len(owned), nbytes,
+            _time.perf_counter() - w0,
+        )
+
+    def _post(p):
+        # the sharded commit holds no full payload: post-commit work
+        # (preempt pruning, fault hooks) runs with None; the best
+        # side-write was handled up front (save_checkpoint)
+        from distribuuuu_tpu.utils import faults
+
+        faults.maybe_drop_shard_file(path, epoch_cursor, world)
+        if post_commit is not None:
+            post_commit(None)
+
+    def _bg_sharded():
+        c0 = _time.perf_counter()
+        with telemetry_spans.span(
+            "ckpt_commit", track="ckpt", ckpt=name, epoch=int(epoch_cursor),
+        ):
+            committer.multihost_commit(
+                path, None, epoch_cursor,
+                write_payload=_write_mine,
+                write_manifest=lambda: manifest_lib.write_manifest(
+                    path, None, kind="full", epoch=epoch_cursor,
+                    tree=tree, topology=topology, sharded=sharded_rec,
+                ),
+                post_commit=_post,
+                write_local=_write_mine,
+                sharded=True,
+            )
+        committer.emit_commit_record(
+            name, snapshot_s, _time.perf_counter() - c0
+        )
+
+    committer.submit_commit(name, _bg_sharded)
+    return path
+
+
 def prune_preempts(upto: int):
     """Delete preempt checkpoints with number ≤ ``upto`` — full
     params+optimizer snapshots would otherwise accumulate across
@@ -489,12 +599,27 @@ def save_checkpoint(state_tree: dict, epoch: int, best_acc1: float, is_best: boo
     The best side-write, preempt pruning, and the corrupt-checkpoint
     fault hook all run post-commit — after the manifest is durable, on
     the committer thread when ``CHECKPOINT.ASYNC`` (the payload handed
-    to the closure is then the host snapshot, safe to re-save)."""
+    to the closure is then the host snapshot, safe to re-save). Under
+    CROSS-HOST sharding (the sharded async protocol) the commit holds no
+    full payload: the weights-only best side-write then stays on the
+    synchronous collective path, written up front — small and rare; the
+    FULL state commit is what moved off-path (ISSUE 18)."""
     path = get_checkpoint(epoch)
     from distribuuuu_tpu.utils import faults
 
+    best_up_front = False
+    if is_best and jax.process_count() > 1 and async_enabled():
+        from distribuuuu_tpu.asyncplane import committer
+
+        if committer.tree_is_cross_host_sharded(state_tree):
+            # collective on every host (is_best and the predicate are
+            # host-invariant, so all hosts reach this together)
+            best_up_front = True
+            _write_best(state_tree["params"], state_tree["batch_stats"],
+                        epoch)
+
     def _post(payload):
-        if is_best:
+        if is_best and not best_up_front:
             _write_best(payload["params"], payload["batch_stats"], epoch)
         prune_preempts(epoch)
         faults.maybe_corrupt_checkpoint(path, epoch)  # no-op unless injected
@@ -581,14 +706,25 @@ def load_checkpoint(path: str):
 
     A failed restore raises ``CheckpointLoadError`` naming the path, the
     quarantine action taken, and how to resume from the previous intact
-    save — instead of a raw tensorstore traceback."""
+    save — instead of a raw tensorstore traceback.
+
+    Sharded saves (the cross-host async protocol, ISSUE 18) restore
+    through their recorded layout: every ``shards_host<r>.npz`` the
+    ``SHARDS_host0.json`` manifest names reassembles into the full tree
+    — elastically, since the result is plain host arrays the trainer
+    re-places onto whatever mesh is live. A shard-count mismatch REFUSES
+    (the error names the recorded sharding) rather than restoring a
+    partial tree."""
     path = os.path.abspath(path)
-    ckptr = ocp.PyTreeCheckpointer()
+    from distribuuuu_tpu.asyncplane import committer
+
     try:
         with telemetry_spans.span(
             "ckpt_restore", track="ckpt", ckpt=os.path.basename(path)
         ):
-            return ckptr.restore(path)
+            if committer.sharded_layout_present(path):
+                return committer.read_sharded_checkpoint(path)
+            return ocp.PyTreeCheckpointer().restore(path)
     except Exception as e:  # orbax/tensorstore raise many concrete types
         if _is_managed_checkpoint(path):
             dest = quarantine_checkpoint(path, f"restore failed: {e}")

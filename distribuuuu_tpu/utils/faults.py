@@ -43,6 +43,21 @@ survive into a reproducible, config-driven event, so tests and
                          barrier (all payloads durable) and the manifest
                          commit (multi-host CHECKPOINT.ASYNC): the
                          restart walks back over the manifest-less dir;
+  wedged ring slot       ``FAULTS.WEDGE_RING/WEDGE_RING_S`` — hold the
+                         LEADER's cross-host ring slot before its order
+                         publishes (asyncplane/ring.py): followers must
+                         flag ``dispatch.wedge`` past their deadline and
+                         the trainer must degrade that epoch's eval to
+                         sync, never hang;
+  killed at shard barrier ``FAULTS.KILL_AT_SHARD_BARRIER`` — SIGKILL the
+                         primary inside the SHARDED commit window (every
+                         host's shard file durable, manifest not): the
+                         restart quarantines shards and all, walks back;
+  dropped shard file     ``FAULTS.DROP_SHARD_FILE/DROP_SHARD_HOST`` —
+                         delete one host's shards_host<r>.npz from a
+                         COMMITTED sharded save: the restart's digest
+                         walk must fail it, a direct load must refuse
+                         naming the recorded sharding;
   recompile storm        ``FAULTS.RECOMPILE_AT_BATCH/RECOMPILE_N`` —
                          N real backend compiles mid-run (trivial jits
                          at distinct shapes; the shape-leak signature
@@ -68,8 +83,10 @@ __all__ = [
     "InjectedFault", "enabled", "nan_injection_step", "maybe_decode_error",
     "maybe_kill", "maybe_stall", "maybe_corrupt_checkpoint",
     "maybe_kill_mid_async_save", "maybe_kill_at_commit_barrier",
+    "maybe_kill_at_shard_barrier", "maybe_drop_shard_file",
     "maybe_preempt", "maybe_truncate_shard",
-    "maybe_recompile", "maybe_slowdown", "maybe_wedge_dispatch", "reset",
+    "maybe_recompile", "maybe_slowdown", "maybe_wedge_dispatch",
+    "maybe_wedge_ring", "validate_cfg", "reset",
 ]
 
 
@@ -79,7 +96,8 @@ class InjectedFault(RuntimeError):
 
 _state: dict = {"decode_raised": set(), "preempted": False,
                 "truncated_shards": set(), "recompiled": False,
-                "wedged": False}
+                "wedged": False, "ring_wedged": False,
+                "dropped_shard": False}
 
 
 def reset() -> None:
@@ -89,10 +107,44 @@ def reset() -> None:
     _state["truncated_shards"] = set()
     _state["recompiled"] = False
     _state["wedged"] = False
+    _state["ring_wedged"] = False
+    _state["dropped_shard"] = False
 
 
 def enabled() -> bool:
     return bool(cfg.FAULTS.ENABLED)
+
+
+def validate_cfg() -> None:
+    """Arithmetic sanity for ARMED fault knobs, at startup rather than at
+    the (possibly hours-later) injection point. Refusals name the knobs
+    and the units so the fix is mechanical. No-op unless FAULTS.ENABLED."""
+    if not enabled():
+        return
+    if cfg.FAULTS.WEDGE_RING >= 0:
+        wedge_s = float(cfg.FAULTS.WEDGE_RING_S)
+        deadline = float(cfg.ASYNC.RING_DEADLINE_S)
+        if wedge_s <= 0:
+            raise ValueError(
+                "FAULTS.WEDGE_RING is armed but FAULTS.WEDGE_RING_S is "
+                f"{wedge_s} — the ring hold must be a positive number of "
+                "seconds for the wedge to exist at all"
+            )
+        if wedge_s <= deadline:
+            raise ValueError(
+                f"FAULTS.WEDGE_RING_S ({wedge_s} s) must exceed "
+                f"ASYNC.RING_DEADLINE_S ({deadline} s): followers flag a "
+                "ring wedge only after waiting a full deadline, so a hold "
+                "shorter than the deadline is unobservable — the drill "
+                "would 'pass' without exercising the degrade path"
+            )
+    if cfg.FAULTS.DROP_SHARD_FILE >= 0 and int(cfg.FAULTS.DROP_SHARD_HOST) < 0:
+        raise ValueError(
+            f"FAULTS.DROP_SHARD_HOST ({int(cfg.FAULTS.DROP_SHARD_HOST)}) "
+            "must be a host rank >= 0 (it indexes shards_host<r>.npz; the "
+            "upper bound is checked against the live world at the "
+            "injection site)"
+        )
 
 
 def nan_injection_step() -> int | None:
@@ -244,6 +296,22 @@ def maybe_wedge_dispatch(token: int) -> None:
         time.sleep(float(cfg.FAULTS.WEDGE_S))
 
 
+def maybe_wedge_ring(token: int) -> None:
+    """Hold the LEADER's ring slot #``FAULTS.WEDGE_RING`` for
+    ``WEDGE_RING_S`` seconds BEFORE the grant order publishes to the ring
+    (sequencer.py calls this from the leader's acquire path, between
+    taking the local token and ``ring.publish``). Followers waiting on
+    the unpublished slot must flag ``dispatch.wedge`` once past
+    ``ASYNC.RING_DEADLINE_S`` (hence ``validate_cfg``'s requirement that
+    WEDGE_RING_S exceed the deadline) and the trainer must degrade that
+    epoch's eval to synchronous — never hang. One-shot per process."""
+    if not enabled() or cfg.FAULTS.WEDGE_RING < 0 or _state["ring_wedged"]:
+        return
+    if int(token) >= int(cfg.FAULTS.WEDGE_RING) and cfg.FAULTS.WEDGE_RING_S > 0:
+        _state["ring_wedged"] = True
+        time.sleep(float(cfg.FAULTS.WEDGE_RING_S))
+
+
 def maybe_kill_at_commit_barrier(path: str, epoch: int) -> None:
     """SIGKILL the PRIMARY host inside the multi-host async-commit crash
     window: every host has arrived at the cross-host commit barrier (all
@@ -263,6 +331,58 @@ def maybe_kill_at_commit_barrier(path: str, epoch: int) -> None:
         return
     if epoch == int(cfg.FAULTS.KILL_AT_COMMIT_BARRIER):
         os.kill(os.getpid(), signal.SIGKILL)
+
+
+def maybe_kill_at_shard_barrier(path: str, epoch: int) -> None:
+    """SIGKILL the PRIMARY host inside the SHARDED commit crash window:
+    every host's ``shards_host<r>.npz`` + layout are durable and the
+    cross-host barrier has completed, but ``MANIFEST.json`` has NOT been
+    written (asyncplane/committer.py places this hook there when
+    ``sharded=True``). The restart must treat the manifest-less dir as
+    never-committed — quarantine every shard file with it and walk back
+    (tools/resilience_drill.py ``sharded_save_kill_at_barrier``). Epoch
+    checkpoints only, primary only."""
+    if not enabled() or cfg.FAULTS.KILL_AT_SHARD_BARRIER < 0:
+        return
+    if not os.path.basename(path).startswith("ckpt_ep_"):
+        return
+    import jax
+
+    if jax.process_index() != 0:
+        return
+    if epoch == int(cfg.FAULTS.KILL_AT_SHARD_BARRIER):
+        os.kill(os.getpid(), signal.SIGKILL)
+
+
+def maybe_drop_shard_file(path: str, epoch: int, world: int) -> None:
+    """Delete host ``FAULTS.DROP_SHARD_HOST``'s ``shards_host<r>.npz``
+    from a just-COMMITTED sharded checkpoint of the configured epoch —
+    the lost-a-file restore case (a host's disk died between save and
+    restart). The manifest's digest walk must fail the dir on the next
+    start (quarantine + walk-back), and a direct ``load_checkpoint`` must
+    refuse, naming the recorded sharding. Primary process only, one-shot;
+    the host index is validated against the LIVE world here because the
+    config layer cannot know it."""
+    if not enabled() or cfg.FAULTS.DROP_SHARD_FILE < 0:
+        return
+    if _state["dropped_shard"] or epoch != int(cfg.FAULTS.DROP_SHARD_FILE):
+        return
+    import jax
+
+    if jax.process_index() != 0:
+        return
+    victim = int(cfg.FAULTS.DROP_SHARD_HOST)
+    if not 0 <= victim < int(world):
+        raise ValueError(
+            f"FAULTS.DROP_SHARD_HOST ({victim}) must satisfy "
+            f"0 <= host < world ({int(world)}): the sharded save wrote "
+            f"shards_host0.npz .. shards_host{int(world) - 1}.npz, so "
+            "there is no such shard file to drop"
+        )
+    _state["dropped_shard"] = True
+    shard = os.path.join(path, f"shards_host{victim}.npz")
+    if os.path.isfile(shard):
+        os.unlink(shard)
 
 
 def maybe_kill_mid_async_save(path: str, epoch: int) -> None:

@@ -678,6 +678,8 @@ def test_new_kinds_declared_and_static_check_clean():
     assert "compile.cache" in schema.KINDS
     for kind in ("dispatch.token", "dispatch.wedge", "ckpt.barrier"):
         assert kind in schema.KINDS  # ISSUE 11 sequencer/barrier kinds
+    for kind in ("dispatch.ring", "ckpt.shard"):
+        assert kind in schema.KINDS  # ISSUE 18 pod-scale async kinds
     import check_telemetry_schema as chk
 
     violations, seen = chk.check_tree(
@@ -686,6 +688,7 @@ def test_new_kinds_declared_and_static_check_clean():
     assert violations == [], violations
     assert "ckpt.async" in seen and "compile.cache" in seen
     assert {"dispatch.token", "dispatch.wedge", "ckpt.barrier"} <= seen
+    assert {"dispatch.ring", "ckpt.shard"} <= seen
 
 
 def test_run_report_splits_on_vs_off_path(tmp_path):
@@ -806,6 +809,490 @@ def test_bench_index_carries_asyncplane_series():
     )
     r5 = json.load(open(os.path.join(REPO, "BENCH_r05.json")))
     assert mapped["img_per_sec"] == r5["parsed"]["value"]
+
+
+# ---------------------------------------------- cross-host dispatch ring
+def _ring_pair(tmp_path, deadline=5.0, detach=600.0):
+    """A leader+follower CrossHostRing over one tmp root (both 'hosts'
+    in this process — the protocol is pure filesystem, so the ring's
+    correctness properties are testable without a second process)."""
+    from distribuuuu_tpu.asyncplane import ring as ring_mod
+
+    root = str(tmp_path / "ring")
+    lead = ring_mod.CrossHostRing(root, 0, 2, deadline,
+                                  detach_after_s=detach)
+    lead.open(timeout=1.0)
+    follow = ring_mod.CrossHostRing(root, 1, 2, deadline,
+                                    detach_after_s=detach)
+    follow.open(timeout=1.0)
+    return lead, follow
+
+
+def test_ring_follower_reproduces_leader_order(tmp_path):
+    """THE agreement property (tentpole (a)): whatever interleaving the
+    leader's two dispatch threads produce, the follower's granted
+    (slot, stream) sequence is IDENTICAL — even with adversarial timing
+    on the follower's threads. Two SPMD programs from two host threads
+    enqueue in ONE per-device order on every host."""
+    import threading
+
+    from distribuuuu_tpu.asyncplane import sequencer
+
+    lead_ring, follow_ring = _ring_pair(tmp_path)
+    seq_l = sequencer.DispatchSequencer()
+    seq_l.attach_ring(lead_ring)
+    seq_f = sequencer.DispatchSequencer()
+    seq_f.attach_ring(follow_ring)
+    n_train, n_eval = 24, 9
+    lead_order, follow_order = [], []
+
+    def drive(seq, order, stream, n, delay):
+        def run():
+            for i in range(n):
+                seq.dispatch(stream, lambda: order.append(stream))
+                time.sleep(delay)
+        return run
+
+    threads = [
+        # leader: its local FIFO decides the global order
+        threading.Thread(target=drive(seq_l, lead_order, "train",
+                                      n_train, 0.001)),
+        threading.Thread(target=drive(seq_l, lead_order, "eval",
+                                      n_eval, 0.004)),
+        # follower: adversarial thread timing — eval hammers early and
+        # fast, train lags; the published order must still win
+        threading.Thread(target=drive(seq_f, follow_order, "eval",
+                                      n_eval, 0.0)),
+        threading.Thread(target=drive(seq_f, follow_order, "train",
+                                      n_train, 0.002)),
+    ]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=120)
+    assert not any(t.is_alive() for t in threads)
+    assert len(lead_order) == len(follow_order) == n_train + n_eval
+    assert follow_order == lead_order  # ONE order on every host
+    assert not follow_ring.wedged and not follow_ring.detached
+    assert follow_ring.stats["slots"] == n_train + n_eval
+    assert lead_ring.stats["slots"] == n_train + n_eval
+    assert lead_ring.stats["switches"] >= 2  # the streams interleaved
+
+
+def test_ring_deadline_miss_flags_wedge_then_completes(tmp_path):
+    """A follower blocked past ASYNC.RING_DEADLINE_S flags
+    dispatch.wedge (record + counter + sticky ring-wedged state for the
+    trainer's epoch boundary) but keeps waiting — when the leader's
+    order finally lands, the run COMPLETES. Degraded, never hung."""
+    import threading
+
+    from distribuuuu_tpu.asyncplane import sequencer
+
+    path = spans.setup_telemetry(str(tmp_path / "telemetry"), rank=1)
+    reg = registry_lib.get_registry()
+    reg.reset()
+    lead_ring, follow_ring = _ring_pair(tmp_path, deadline=0.15)
+    seq_f = sequencer.DispatchSequencer()
+    seq_f.attach_ring(follow_ring)
+    out = []
+
+    def late_leader():
+        time.sleep(0.5)  # well past the follower's 0.15s deadline
+        lead_ring.publish(0, "eval")
+
+    t = threading.Thread(target=late_leader, daemon=True)
+    t.start()
+    seq_f.dispatch("eval", lambda: out.append("ran"))
+    t.join(timeout=30)
+    assert out == ["ran"]  # completed once the order landed
+    assert follow_ring.wedged and not follow_ring.detached
+    assert follow_ring.stats["deadline_misses"] == 1
+    assert seq_f._ring_wedged  # the trainer's epoch-boundary signal
+    assert reg.snapshot()["counters"].get("dispatch.wedges", 0) >= 1
+    spans.close_telemetry()
+    recs = [json.loads(ln) for ln in open(path).read().splitlines()]
+    wedge = [r for r in recs if r.get("kind") == "dispatch.wedge"]
+    assert wedge and "ring slot 0" in wedge[0]["phase"]
+    for r in wedge:
+        schema.validate_record(r)
+
+
+def test_ring_detaches_after_leader_silence(tmp_path):
+    """Past detach_after_s (the ASYNC.BARRIER_TIMEOUT_S contract) of
+    zero leader progress the follower DETACHES to its local FIFO — a
+    dead leader costs cross-host agreement, never a hang."""
+    from distribuuuu_tpu.asyncplane import sequencer
+
+    lead_ring, follow_ring = _ring_pair(tmp_path, deadline=0.1,
+                                        detach=0.3)
+    del lead_ring  # the leader never publishes anything
+    seq_f = sequencer.DispatchSequencer()
+    seq_f.attach_ring(follow_ring)
+    t0 = time.perf_counter()
+    assert seq_f.dispatch("train", lambda: 42) == 42
+    assert time.perf_counter() - t0 < 30  # bounded, not a hang
+    assert follow_ring.detached and follow_ring.wedged
+    # detached mode: subsequent dispatches grant locally, immediately
+    assert seq_f.dispatch("eval", lambda: 7) == 7
+    st = follow_ring.snapshot_stats()
+    assert st["role"] == "follower" and st["detached"] is True
+    assert st["slots"] == 2
+
+
+def test_ring_validation_and_open_timeout(tmp_path):
+    from distribuuuu_tpu.asyncplane import ring as ring_mod
+    from distribuuuu_tpu.asyncplane import sequencer
+
+    with pytest.raises(ValueError, match="RING_DEADLINE_S"):
+        ring_mod.CrossHostRing(str(tmp_path / "r"), 0, 2, 0.0)
+    # follower with no leader: bounded OPEN wait names the knob
+    orphan = ring_mod.CrossHostRing(str(tmp_path / "never"), 1, 2, 1.0)
+    with pytest.raises(TimeoutError, match="BARRIER_TIMEOUT"):
+        orphan.open(timeout=0.2)
+    # install_ring requires an installed sequencer
+    sequencer.shutdown()
+    with pytest.raises(RuntimeError, match="install"):
+        sequencer.install_ring(str(tmp_path / "r2"), 0, 2, 1.0)
+
+
+def test_ring_open_clears_stale_attempt_and_module_api(tmp_path):
+    """The leader's open() fresh-clears the ring root — a watermark or
+    switch record from a previous (killed) attempt can never leak into
+    this run's order. Module API: install_ring attaches to the active
+    sequencer, emit_stats rides a schema-valid dispatch.ring record."""
+    from distribuuuu_tpu.asyncplane import sequencer
+
+    root = tmp_path / "ring"
+    root.mkdir()
+    (root / "watermark").write_text('{"seq": 99, "sw": 1}')
+    (root / "sw_000000").write_text('{"seq": 0, "stream": "eval"}')
+    (root / "OPEN").write_text("stale")
+    sink = spans.setup_telemetry(str(tmp_path / "telemetry"), rank=0)
+    sequencer.shutdown()
+    sequencer.install(wedge_timeout=0.0)
+    r = sequencer.install_ring(str(root), 0, 2, 5.0, detach_after_s=1.0)
+    assert sequencer.ring_installed()
+    assert sorted(os.listdir(root)) == ["OPEN"]  # stale order gone
+    assert r.agreed_stream(99) is None
+    # idempotent: a re-install keeps the attached ring
+    assert sequencer.install_ring(str(root), 0, 2, 5.0) is r
+    sequencer.dispatch("train", lambda: 1)
+    sequencer.dispatch("eval", lambda: 2)
+    # the wedge signal round-trip the trainer boundary uses
+    assert not sequencer.ring_wedged()
+    _active = sequencer._active
+    _active._ring_wedged = True
+    assert sequencer.ring_wedged()
+    sequencer.clear_ring_wedge()
+    assert not sequencer.ring_wedged()
+    sequencer.emit_stats(final=True)
+    spans.close_telemetry()
+    recs = [json.loads(ln) for ln in open(sink).read().splitlines()]
+    ring_recs = [r for r in recs if r.get("kind") == "dispatch.ring"]
+    assert len(ring_recs) == 1
+    assert ring_recs[0]["role"] == "leader"
+    assert ring_recs[0]["slots"] == 2
+    schema.validate_record(ring_recs[0])
+    sequencer.shutdown()
+
+
+def test_faults_validate_cfg_names_ring_arithmetic():
+    """Armed FAULTS knobs with impossible arithmetic refuse at startup,
+    naming the knobs AND the units (the satellite-3 contract)."""
+    from distribuuuu_tpu.utils import faults
+
+    config.reset_cfg()
+    cfg.FAULTS.ENABLED = True
+    cfg.FAULTS.WEDGE_RING = 3
+    cfg.FAULTS.WEDGE_RING_S = 0.0
+    with pytest.raises(ValueError, match="positive number of\\s+seconds"):
+        faults.validate_cfg()
+    cfg.FAULTS.WEDGE_RING_S = 10.0  # below the 30s default deadline
+    with pytest.raises(ValueError) as ei:
+        faults.validate_cfg()
+    msg = str(ei.value)
+    assert "WEDGE_RING_S" in msg and "RING_DEADLINE_S" in msg
+    assert "10.0 s" in msg and "30.0 s" in msg  # the arithmetic, named
+    cfg.FAULTS.WEDGE_RING_S = 31.0
+    faults.validate_cfg()  # now observable: passes
+    cfg.FAULTS.WEDGE_RING = -1
+    cfg.FAULTS.DROP_SHARD_FILE = 0
+    cfg.FAULTS.DROP_SHARD_HOST = -2
+    with pytest.raises(ValueError, match="host rank"):
+        faults.validate_cfg()
+    config.reset_cfg()
+    faults.validate_cfg()  # disarmed: no-op
+    faults.reset()
+
+
+def test_wedge_ring_injection_one_shot():
+    from distribuuuu_tpu.utils import faults
+
+    config.reset_cfg()
+    cfg.FAULTS.ENABLED = True
+    cfg.FAULTS.WEDGE_RING = 5
+    cfg.FAULTS.WEDGE_RING_S = 0.05
+    faults.reset()
+    t0 = time.perf_counter()
+    faults.maybe_wedge_ring(3)  # below the slot index: no-op
+    assert time.perf_counter() - t0 < 0.04
+    t0 = time.perf_counter()
+    faults.maybe_wedge_ring(5)  # wedges once
+    assert time.perf_counter() - t0 >= 0.05
+    t0 = time.perf_counter()
+    faults.maybe_wedge_ring(6)  # one-shot: never again
+    assert time.perf_counter() - t0 < 0.04
+    config.reset_cfg()
+    faults.reset()
+
+
+# ------------------------------------------------ sharded multi-host save
+def _sharded_fixture(tmp_path, name="ckpt_ep_003"):
+    """A hand-built 2-host sharded checkpoint: a float leaf split across
+    hosts, a bfloat16 leaf split across hosts, a host-side scalar and
+    the optax string format marker (both owned by host 0) — the exact
+    leaf species a ZeRO-3 TrainState produces."""
+    import jax.numpy as jnp
+
+    w = np.arange(32, dtype=np.float32).reshape(8, 4)
+    mu = np.asarray(jnp.arange(6, dtype=jnp.bfloat16))
+    marker = "optax_leaves_v1"
+    cursor = np.int64(3)
+    leaves = [
+        {"path": ["params", "w"], "shape": [8, 4], "dtype": "float32"},
+        {"path": ["opt", "format"], "shape": [], "dtype": "utf8"},
+        {"path": ["opt", "mu", "w"], "shape": [6], "dtype": "bfloat16"},
+        {"path": ["cursor"], "shape": [], "dtype": "int64"},
+    ]
+    raw_marker = np.frombuffer(marker.encode("utf-8"), np.uint8)
+    owned0 = {"00000.0": w[:4], "00001.0": raw_marker,
+              "00002.0": mu[:3], "00003.0": np.asarray(cursor)}
+    shards0 = [
+        {"leaf": 0, "key": "00000.0", "index": [[0, 4], [0, 4]],
+         "shape": [4, 4], "dtype": "float32"},
+        {"leaf": 1, "key": "00001.0", "index": [],
+         "shape": [int(raw_marker.size)], "dtype": "utf8"},
+        {"leaf": 2, "key": "00002.0", "index": [[0, 3]],
+         "shape": [3], "dtype": "bfloat16"},
+        {"leaf": 3, "key": "00003.0", "index": [],
+         "shape": [], "dtype": "int64"},
+    ]
+    owned1 = {"00000.1": w[4:], "00002.1": mu[3:]}
+    shards1 = [
+        {"leaf": 0, "key": "00000.1", "index": [[4, 8], [0, 4]],
+         "shape": [4, 4], "dtype": "float32"},
+        {"leaf": 2, "key": "00002.1", "index": [[3, 6]],
+         "shape": [3], "dtype": "bfloat16"},
+    ]
+    path = str(tmp_path / "checkpoints" / name)
+    os.makedirs(path, exist_ok=True)
+    committer.write_host_shards(
+        path, 0, 2, owned0,
+        {"format": committer.SHARD_FORMAT, "leaves": leaves,
+         "shards": shards0},
+    )
+    committer.write_host_shards(
+        path, 1, 2, owned1,
+        {"format": committer.SHARD_FORMAT, "leaves": leaves,
+         "shards": shards1},
+    )
+    expect = {"params": {"w": w}, "opt": {"format": marker,
+                                          "mu": {"w": mu}},
+              "cursor": cursor}
+    return path, expect
+
+
+def test_sharded_roundtrip_bit_identical(tmp_path):
+    """Reassembly from per-host shard files is bit-identical for every
+    leaf species a ZeRO-3 state holds: split float blocks, split
+    bfloat16 (raw-byte round-trip — numpy's npz header cannot carry the
+    dtype), host scalars, and the utf8 string format marker."""
+    path, expect = _sharded_fixture(tmp_path)
+    assert committer.sharded_layout_present(path)
+    got = committer.read_sharded_checkpoint(path)
+    np.testing.assert_array_equal(got["params"]["w"],
+                                  expect["params"]["w"])
+    assert got["params"]["w"].dtype == np.float32
+    mu = got["opt"]["mu"]["w"]
+    assert str(mu.dtype) == "bfloat16"
+    assert mu.tobytes() == expect["opt"]["mu"]["w"].tobytes()
+    assert got["opt"]["format"] == "optax_leaves_v1"
+    assert int(got["cursor"]) == 3
+    # load_checkpoint dispatches on the layout, same reassembly
+    via_ckpt = ckpt.load_checkpoint(path)
+    np.testing.assert_array_equal(via_ckpt["params"]["w"],
+                                  expect["params"]["w"])
+
+
+def test_sharded_restore_refuses_missing_shard(tmp_path):
+    """A shard-count mismatch REFUSES, naming the manifest's recorded
+    sharding (hosts + the expected file names + which are missing) —
+    silently restoring a partial tree is never an option."""
+    path, _ = _sharded_fixture(tmp_path)
+    os.unlink(os.path.join(path, "shards_host1.npz"))
+    with pytest.raises(committer.ShardLayoutError) as ei:
+        committer.read_sharded_checkpoint(path)
+    msg = str(ei.value)
+    assert "hosts=2" in msg and "SHARDS_host0.json" in msg
+    assert "shards_host1.npz" in msg and "refusing" in msg
+
+
+def test_sharded_restore_refuses_layout_drift_and_bad_coverage(tmp_path):
+    """Mixed-save shard files (layout drift across hosts) and a layout
+    whose shards do not cover a leaf both refuse with the reason."""
+    path, _ = _sharded_fixture(tmp_path)
+    lay1 = json.load(open(os.path.join(path, "SHARDS_host1.json")))
+    drift = dict(lay1)
+    drift["leaves"] = list(lay1["leaves"][:-1])  # a different tree spec
+    with open(os.path.join(path, "SHARDS_host1.json"), "w") as f:
+        json.dump(drift, f)
+    with pytest.raises(committer.ShardLayoutError,
+                       match="different tree spec"):
+        committer.read_sharded_checkpoint(path)
+    # coverage hole: host1 stops recording its half of params/w
+    cover = dict(lay1)
+    cover["shards"] = [m for m in lay1["shards"] if m["leaf"] != 0]
+    with open(os.path.join(path, "SHARDS_host1.json"), "w") as f:
+        json.dump(cover, f)
+    with pytest.raises(committer.ShardLayoutError,
+                       match="params/w.*16/32"):
+        committer.read_sharded_checkpoint(path)
+
+
+def test_snapshot_host_shards_ownership_and_refusals(tmp_path):
+    """snapshot_host_shards on a host tree: rank 0 owns host-side leaves
+    (identical on every host by construction), rank 1 owns none; string
+    scalars ride the utf8 tag; object leaves and non-dict containers
+    refuse with MultiHostSnapshotError (the sync-collective valve)."""
+    tree = {"params": {"w": np.arange(4.0, dtype=np.float32)},
+            "opt": {"format": "optax_leaves_v1"},
+            "cursor": np.int64(7)}
+    owned0, layout0 = committer.snapshot_host_shards(tree, 0)
+    owned1, layout1 = committer.snapshot_host_shards(tree, 1)
+    assert layout0["leaves"] == layout1["leaves"]  # identical spec
+    assert len(owned0) == 3 and owned1 == {}
+    path = str(tmp_path / "checkpoints" / "ckpt_ep_000")
+    committer.write_host_shards(path, 0, 2, owned0, layout0)
+    committer.write_host_shards(path, 1, 2, owned1, layout1)
+    got = committer.read_sharded_checkpoint(path)
+    np.testing.assert_array_equal(got["params"]["w"],
+                                  tree["params"]["w"])
+    assert got["opt"]["format"] == "optax_leaves_v1"
+    assert int(got["cursor"]) == 7
+    with pytest.raises(committer.MultiHostSnapshotError,
+                       match="object-dtype"):
+        committer.snapshot_host_shards({"bad": np.array(None)}, 0)
+    with pytest.raises(committer.MultiHostSnapshotError,
+                       match="non-dict"):
+        committer.snapshot_host_shards({"t": (np.zeros(2),)}, 0)
+
+
+def test_manifest_digest_walk_covers_shard_files(tmp_path):
+    """The existing MANIFEST digest walk automatically covers the shard
+    files: a committed sharded save verifies ok, and a dropped shard
+    file FAILS verification — the restart's quarantine + walk-back
+    trigger, with no new verification machinery."""
+    from distribuuuu_tpu.resilience import manifest as manifest_lib
+
+    path, expect = _sharded_fixture(tmp_path)
+    tree = manifest_lib.tree_spec(expect)
+    topo = manifest_lib.world_topology(expect)
+    manifest_lib.write_manifest(
+        path, None, kind="full", epoch=3, tree=tree, topology=topo,
+        sharded={"hosts": 2, "files": ["shards_host0.npz",
+                                       "shards_host1.npz"]},
+    )
+    ok, reason = manifest_lib.verify_checkpoint(path)
+    assert ok, reason
+    man = json.load(open(os.path.join(path, "MANIFEST.json")))
+    assert man["sharded"]["hosts"] == 2  # the recorded sharding
+    assert set(man["files"]) >= {"shards_host0.npz", "shards_host1.npz",
+                                 "SHARDS_host0.json", "SHARDS_host1.json"}
+    os.unlink(os.path.join(path, "shards_host1.npz"))
+    ok, reason = manifest_lib.verify_checkpoint(path)
+    assert not ok and "shards_host1.npz" in reason
+
+
+def test_drop_shard_file_injection_validates_and_drops(tmp_path):
+    """The drop-one-shard-file fault: host index validated against the
+    LIVE world (refusal names the range arithmetic), then the victim's
+    npz is deleted exactly once."""
+    from distribuuuu_tpu.utils import faults
+
+    path, _ = _sharded_fixture(tmp_path)
+    config.reset_cfg()
+    cfg.FAULTS.ENABLED = True
+    cfg.FAULTS.DROP_SHARD_FILE = 3
+    cfg.FAULTS.DROP_SHARD_HOST = 5
+    faults.reset()
+    with pytest.raises(ValueError) as ei:
+        faults.maybe_drop_shard_file(path, 3, world=2)
+    msg = str(ei.value)
+    assert "0 <= host < world (2)" in msg and "shards_host1.npz" in msg
+    cfg.FAULTS.DROP_SHARD_HOST = 1
+    faults.reset()
+    faults.maybe_drop_shard_file(path, 2, world=2)  # wrong epoch: no-op
+    assert os.path.isfile(os.path.join(path, "shards_host1.npz"))
+    faults.maybe_drop_shard_file(path, 3, world=2)
+    assert not os.path.isfile(os.path.join(path, "shards_host1.npz"))
+    config.reset_cfg()
+    faults.reset()
+
+
+def test_cross_host_predicate_is_metadata_only():
+    """tree_is_cross_host_sharded: False for host trees and
+    fully-addressable device arrays (the single-host fast path keeps
+    the orbax protocol), no communication, never raises on strings."""
+    import jax.numpy as jnp
+
+    tree = {"w": jnp.ones((4, 4)), "s": "optax_leaves_v1",
+            "n": np.int64(2)}
+    assert committer.tree_is_cross_host_sharded(tree) is False
+
+
+def test_run_report_ring_and_shard_sections(tmp_path):
+    """run_report surfaces the per-host ring waits (dispatch.ring, last
+    record per host wins) and the per-host shard-commit durations
+    (ckpt.shard) — the satellite-2 sections."""
+    tdir = tmp_path / "telemetry"
+    path = spans.setup_telemetry(str(tdir), rank=0)
+    spans.emit_span("step", 1.0, 1.1, track="pipeline", phase="train",
+                    epoch=1, batch=0, n=8)
+    spans.emit_event("dispatch.token", tokens=12, streams={"train": 12},
+                     max_wait_s=0.01, total_wait_s=0.02, fence_waits=0,
+                     fence_wait_s=0.0, max_fence_wait_s=0.0,
+                     switches=1, wedges=0)
+    spans.emit_event("dispatch.ring", host=0, hosts=2, role="leader",
+                     slots=12, switches=3, total_wait_s=0.0,
+                     max_wait_s=0.0, deadline_misses=0, wedged=False,
+                     detached=False)
+    spans.emit_event("dispatch.ring", host=1, hosts=2, role="follower",
+                     slots=12, switches=3, total_wait_s=0.8,
+                     max_wait_s=0.3, deadline_misses=1, wedged=True,
+                     detached=False)
+    spans.emit_event("ckpt.shard", ckpt="ckpt_ep_000", host=0, hosts=2,
+                     shards=210, bytes=44823923, write_s=0.42)
+    spans.emit_event("ckpt.shard", ckpt="ckpt_ep_001", host=0, hosts=2,
+                     shards=210, bytes=44823923, write_s=0.38)
+    spans.emit_event("ckpt.shard", ckpt="ckpt_ep_000", host=1, hosts=2,
+                     shards=80, bytes=44667648, write_s=0.41)
+    spans.close_telemetry()
+    for r in [json.loads(ln) for ln in open(path).read().splitlines()]:
+        schema.validate_record(r)
+    rep = run_report.build_report(str(tmp_path))
+    ring = rep["sequencer"]["ring"]
+    assert ring["hosts"] == 2
+    assert ring["per_host"]["0"]["role"] == "leader"
+    f = ring["per_host"]["1"]
+    assert f["role"] == "follower" and f["wedged"] is True
+    assert f["max_wait_s"] == pytest.approx(0.3)
+    assert f["deadline_misses"] == 1
+    shards = rep["checkpoint"]["shards"]
+    assert shards["hosts"] == 2
+    h0 = shards["per_host"]["0"]
+    assert h0["saves"] == 2 and h0["shards"] == 210
+    assert h0["mean_write_s"] == pytest.approx(0.4)
+    assert shards["per_host"]["1"]["max_write_s"] == pytest.approx(0.41)
 
 
 # ------------------------------------------------------- trajectory pin

@@ -597,6 +597,7 @@ def test_monitor_cli_once_over_finished_run(tmp_path):
 
 
 # --------------------------------------------------- trajectory neutrality
+@pytest.mark.slow  # 37s: two full toy train runs; tier-1 budget (ISSUE 18)
 def test_monitor_attached_changes_no_training_bits(tmp_path):
     """The ISSUE 7 hard contract, fast tier: a Monitor actively tailing
     the run directory (and writing its own sink) while training steps

@@ -203,6 +203,7 @@ def test_zero_sharded_update_equals_unsharded_then_shard():
         assert bool((st_ == full_t[sl]).all())
 
 
+@pytest.mark.slow  # 39s: real ZeRO-1+3 lowerings; tier-1 budget (ISSUE 18)
 def test_fused_update_under_real_zero_lowering():
     """KERNELS.OPT_UPDATE=pallas composed with the partition layer's
     ZeRO-1 layout on the 8-device mesh: the trajectory must match the
@@ -262,6 +263,7 @@ def test_fused_update_under_real_zero_lowering():
                       rtol=1e-5)
 
 
+@pytest.mark.slow  # 29s: two full toy train runs; tier-1 budget (ISSUE 18)
 def test_trajectory_pin_pallas_vs_xla_training():
     """The tier's headline contract: a KERNELS.OPT_UPDATE=pallas training
     run tracks the xla reference within the pinned tolerance (the only

@@ -160,6 +160,7 @@ def test_three_axis_ep_with_zero1_trains_from_stanza_alone():
 # ------------------------------------------- equivalence vs the legacy path
 
 
+@pytest.mark.slow  # 38s: legacy-vs-lowering A/B train; tier-1 budget (ISSUE 18)
 def test_lowering_reproduces_legacy_dp_zero1():
     """dp8 + ZeRO-1 (resnet18): the declarative path and the hand
     assembly build the same program — trajectories agree to float-drift

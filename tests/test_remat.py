@@ -33,6 +33,7 @@ def _run_steps(remat: bool, hb, n_steps: int = 2):
     return jax.device_get(state.params), float(m["loss"])
 
 
+@pytest.mark.slow  # 35s: remat on/off A/B steps; tier-1 budget (ISSUE 18)
 def test_remat_step_equivalence():
     """Same init, same batches ⇒ same loss and same updated params with
     and without stage 1-2 rematerialization."""

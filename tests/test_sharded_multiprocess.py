@@ -1,0 +1,224 @@
+"""Sharded multi-host async save across real OS processes (slow tier,
+ISSUE 18).
+
+Two ranks × 2 virtual devices with MESH.ZERO=3 give genuinely
+cross-host-sharded train state — the configuration whose async save PR 11
+degraded to a synchronous collective (MultiHostSnapshotError). The
+sharded protocol (asyncplane/committer.py) has each host's committer
+thread write its OWN addressable shards under the existing commit
+barrier. The pins here are the acceptance contract:
+
+- the async run on the pod writes per-host shard files + layouts, the
+  MANIFEST records the sharding, and verify_checkpoint covers the shard
+  files through the ordinary digest walk;
+- a 2-process verifier restores the sharded checkpoint onto the SAME
+  topology and compares it leaf-by-leaf BIT-IDENTICAL to the synchronous
+  collective save it replaces (same seed, same stream, concurrent eval
+  in both runs — only CHECKPOINT.ASYNC differs);
+- a full-group restart resumes from the sharded checkpoint through the
+  normal trainer path and finishes — elastic restore, no orbax topology
+  pin.
+
+The async run also runs TRAIN.CONCURRENT_EVAL, so the cross-host
+dispatch ring (asyncplane/ring.py) carries real traffic here: the ring
+record lands in telemetry with zero deadline misses.
+"""
+
+import json
+import os
+import re
+import sys
+
+import pytest
+
+import test_multiprocess_e2e as mp
+
+REPO = mp.REPO
+sys.path.insert(0, os.path.join(REPO, "tools"))
+
+WORKER = """
+import os, sys
+os.environ["JAX_PLATFORMS"] = "cpu"
+os.environ["XLA_FLAGS"] = (
+    os.environ.get("XLA_FLAGS", "")
+    + " --xla_force_host_platform_device_count="
+    + os.environ.get("DTPU_TEST_NDEV", "2")
+).strip()
+import jax
+jax.config.update("jax_platforms", "cpu")
+
+import distribuuuu_tpu.config as config
+from distribuuuu_tpu.config import cfg
+from distribuuuu_tpu import trainer
+
+out_dir, mode, max_epoch = sys.argv[1], sys.argv[2], int(sys.argv[3])
+config.reset_cfg()
+cfg.MODEL.ARCH = "resnet18"
+cfg.MODEL.NUM_CLASSES = 10
+cfg.MODEL.DUMMY_INPUT = True
+cfg.DEVICE.COMPUTE_DTYPE = "float32"
+cfg.TRAIN.BATCH_SIZE = 2
+cfg.TRAIN.IM_SIZE = 16
+cfg.TRAIN.PRINT_FREQ = 32
+cfg.TEST.BATCH_SIZE = 16
+cfg.TEST.IM_SIZE = 16
+cfg.OPTIM.MAX_EPOCH = max_epoch
+cfg.RNG_SEED = 0
+cfg.MESH.ZERO = 3
+cfg.OUT_DIR = out_dir
+cfg.CHECKPOINT.ASYNC = mode == "async"
+# concurrent eval in BOTH modes: the async/sync comparison isolates the
+# save protocol (sharded vs collective), and best/epoch bookkeeping —
+# which conc eval shifts by one boundary — stays identical across runs
+cfg.TRAIN.CONCURRENT_EVAL = True
+if len(sys.argv) > 4:
+    cfg.merge_from_list(sys.argv[4:])
+best = trainer.train_model()
+print(f"WORKER_DONE rank={jax.process_index()} best={best:.3f}", flush=True)
+"""
+
+# Restores both checkpoints on the live 2-process topology and compares
+# leaf-for-leaf: the sharded reassembly (host numpy) vs the synchronous
+# collective restore (cross-host jax.Arrays, allgathered). Bitwise, via
+# tobytes() — bfloat16 and float32 alike.
+VERIFIER = """
+import os, sys
+os.environ["JAX_PLATFORMS"] = "cpu"
+os.environ["XLA_FLAGS"] = (
+    os.environ.get("XLA_FLAGS", "")
+    + " --xla_force_host_platform_device_count="
+    + os.environ.get("DTPU_TEST_NDEV", "2")
+).strip()
+import jax, numpy as np
+jax.config.update("jax_platforms", "cpu")
+from jax.experimental import multihost_utils
+
+import distribuuuu_tpu.config as config
+from distribuuuu_tpu.parallel import mesh as mesh_lib
+mesh_lib.setup_distributed()
+from distribuuuu_tpu.utils import checkpoint as ckpt
+
+sharded_path, sync_path = sys.argv[1], sys.argv[2]
+a = ckpt.load_checkpoint(sharded_path)
+s = ckpt.load_checkpoint(sync_path)
+s = jax.tree.map(
+    lambda x: multihost_utils.process_allgather(x, tiled=True)
+    if isinstance(x, jax.Array) and not x.is_fully_addressable
+    else np.asarray(x),
+    s,
+)
+la = jax.tree_util.tree_flatten_with_path(a)[0]
+ls = jax.tree_util.tree_flatten_with_path(s)[0]
+assert [k for k, _ in la] == [k for k, _ in ls], "leaf paths differ"
+bad = 0
+for (k, va), (_, vs) in zip(la, ls):
+    if isinstance(va, str) or isinstance(vs, str):
+        if str(va) != str(vs):
+            bad += 1
+        continue
+    va, vs = np.asarray(va), np.asarray(vs)
+    if va.dtype != vs.dtype and va.ndim == 0:
+        # orbax's legacy restore WIDENS host scalars (float32->float64,
+        # int32->int64); the sharded reassembly preserves the
+        # manifest-recorded dtype. Accept only a lossless widening of
+        # the identical value.
+        down = vs.astype(va.dtype)
+        if down.astype(vs.dtype).tobytes() == vs.tobytes():
+            vs = down
+    if va.shape != vs.shape or va.dtype != vs.dtype \
+            or va.tobytes() != vs.tobytes():
+        print("MISMATCH", jax.tree_util.keystr(k), va.dtype, vs.dtype,
+              va.shape, vs.shape, flush=True)
+        bad += 1
+print(f"VERIFY rank={jax.process_index()} leaves={len(la)} "
+      f"mismatches={bad}", flush=True)
+assert bad == 0
+"""
+
+
+def _run_group(tmp_path, script, args, tag):
+    procs, logs = mp._launch_group(
+        tmp_path, script, args, nprocs=2, ndev=2,
+        log_name=lambda rank, port: f"{tag}{rank}_{port}.log",
+    )
+    outs = []
+    for p, log in zip(procs, logs):
+        p.wait(timeout=900)
+        log.seek(0)
+        outs.append(log.read())
+        log.close()
+    for rank, (p, out) in enumerate(zip(procs, outs)):
+        assert p.returncode == 0, f"{tag} rank {rank} failed:\n{out[-3000:]}"
+    return outs
+
+
+@pytest.mark.slow
+def test_sharded_async_save_matches_sync_collective_and_restores(tmp_path):
+    worker = tmp_path / "worker.py"
+    worker.write_text(WORKER)
+    verifier = tmp_path / "verifier.py"
+    verifier.write_text(VERIFIER)
+    out_async = str(tmp_path / "out_async")
+    out_sync = str(tmp_path / "out_sync")
+
+    # ---- the async sharded run (ring + concurrent eval + async save) ----
+    outs = _run_group(tmp_path, worker, (out_async, "async", "1"), "async")
+    assert all("WORKER_DONE" in o for o in outs)
+    ep0 = os.path.join(out_async, "checkpoints", "ckpt_ep_000")
+    files = sorted(os.listdir(ep0))
+    assert {"MANIFEST.json", "SHARDS_host0.json", "SHARDS_host1.json",
+            "shards_host0.npz", "shards_host1.npz"} <= set(files), files
+    man = json.load(open(os.path.join(ep0, "MANIFEST.json")))
+    assert man["sharded"]["hosts"] == 2, man.get("sharded")
+    assert man["sharded"]["files"] == ["shards_host0.npz",
+                                       "shards_host1.npz"]
+    from distribuuuu_tpu.resilience import manifest as manifest_lib
+
+    ok, reason = manifest_lib.verify_checkpoint(ep0)
+    assert ok, reason
+    # the ring carried this run's dispatches: records on both hosts,
+    # nobody wedged or detached
+    ring_recs = []
+    for rank in (0, 1):
+        tpath = os.path.join(out_async, "telemetry",
+                             f"rank{rank:05d}.jsonl")
+        recs = [json.loads(ln) for ln in open(tpath).read().splitlines()]
+        ring_recs.append(
+            [r for r in recs if r.get("kind") == "dispatch.ring"]
+        )
+        assert any(r.get("kind") == "ckpt.shard" for r in recs), tpath
+    assert ring_recs[0] and ring_recs[0][-1]["role"] == "leader"
+    assert ring_recs[1] and ring_recs[1][-1]["role"] == "follower"
+    for recs in ring_recs:
+        assert recs[-1]["wedged"] is False
+        assert recs[-1]["detached"] is False
+        assert recs[-1]["deadline_misses"] == 0
+
+    # ---- the synchronous collective baseline it replaces ----
+    _run_group(tmp_path, worker, (out_sync, "sync", "1"), "sync")
+    sync_ep0 = os.path.join(out_sync, "checkpoints", "ckpt_ep_000")
+    assert not os.path.exists(os.path.join(sync_ep0, "SHARDS_host0.json"))
+
+    # ---- bit-identity on the SAME topology ----
+    outs = _run_group(tmp_path, verifier, (ep0, sync_ep0), "verify")
+    for out in outs:
+        m = re.search(r"VERIFY rank=\d leaves=(\d+) mismatches=(\d+)", out)
+        assert m, out[-2000:]
+        assert int(m.group(1)) > 100, out[-500:]  # a real ZeRO-3 tree
+        assert int(m.group(2)) == 0, out[-2000:]
+
+    # ---- elastic restart: resume from the sharded save, finish ----
+    # (NONFINITE=skip: this toy config NaNs mid-epoch-1 after ANY resume
+    # — sharded or sync collective alike, a pre-existing trainer-config
+    # behavior — and the pin here is the restore path, not the loss)
+    outs = _run_group(
+        tmp_path, worker,
+        (out_async, "async", "2", "TRAIN.NONFINITE", "skip"), "restart",
+    )
+    assert re.search(r"resumed from .*ckpt_ep_000", outs[0]), outs[0][-2000:]
+    assert all("WORKER_DONE" in o for o in outs)
+    names = sorted(os.listdir(os.path.join(out_async, "checkpoints")))
+    assert any(n.startswith("ckpt_ep_001") and ".corrupt" not in n
+               for n in names), names
+    assert os.path.isfile(os.path.join(
+        out_async, "checkpoints", "ckpt_ep_001", "shards_host1.npz"))

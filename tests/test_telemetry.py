@@ -528,6 +528,7 @@ def test_trajectory_neutral_end_to_end(tmp_path):
         np.testing.assert_allclose(a, b, rtol=0.0, atol=1e-7)
 
 
+@pytest.mark.slow  # 45s: two full toy train runs; tier-1 budget (ISSUE 18)
 def test_trajectory_neutral_step_level(tmp_path):
     """Fast tier-1 half of the neutrality contract: the train_epoch hot
     path with spans enabled produces the identical state as with

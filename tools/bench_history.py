@@ -5,9 +5,11 @@ Rounds of ``BENCH_r*.json`` (single-chip training throughput; r06 adds
 the ``asyncplane`` section — checkpoint stall seconds + warm-restart
 compile counts — and r07 its ``sequencer`` overhead numbers,
 tools/asyncplane_bench.py), ``BENCH_serve.json``
-(serving latency/throughput frontier + fleet scaling), and
+(serving latency/throughput frontier + fleet scaling),
 ``COSTMODEL_r*.json`` (the XLA cost-model ledger: measured MFU + HBM
-headroom, tools/costmodel_report.py) each have their own ad-hoc shape;
+headroom, tools/costmodel_report.py), and ``RESILIENCE_r*.json`` (the
+fault-drill matrix, tools/resilience_drill.py — pass counts, never a
+throughput reference) each have their own ad-hoc shape;
 answering "how has img/s moved across PRs" meant opening five files.
 This tool scans them all and emits one index:
 
@@ -294,6 +296,24 @@ def index_campaigns(path: str, series: dict) -> None:
                row.get("rel_logits_delta"))
 
 
+def index_resilience(path: str, series: dict) -> None:
+    """RESILIENCE_r*.json (tools/resilience_drill.py): the fault-matrix
+    coverage per round — drills passed / drills run / all_ok — so a
+    shrinking matrix or a newly-failing drill shows up in the history.
+    Series names are ``resilience_*``, deliberately outside the img/s
+    throughput-gate patterns (the PR 8 clobbering lesson)."""
+    with open(path) as f:
+        doc = json.load(f)
+    rnd, src = _round_of(path), os.path.basename(path)
+    drills = doc.get("drills") or []
+    _point(series, "resilience_drills_total", rnd, src,
+           len(drills), "drills")
+    _point(series, "resilience_drills_ok", rnd, src,
+           sum(1 for d in drills if d.get("ok")), "drills")
+    _point(series, "resilience_all_ok", rnd, src,
+           1.0 if doc.get("all_ok") else 0.0)
+
+
 def build_index(root: str) -> dict:
     series: dict[str, list] = {}
     train_files = sorted(glob.glob(os.path.join(root, "BENCH_r*.json")))
@@ -310,6 +330,11 @@ def build_index(root: str) -> dict:
     )
     for path in campaign_files:
         index_campaigns(path, series)
+    resilience_files = sorted(
+        glob.glob(os.path.join(root, "RESILIENCE_r*.json"))
+    )
+    for path in resilience_files:
+        index_resilience(path, series)
     for pts in series.values():
         pts.sort(key=lambda p: p["round"])
     return {
@@ -317,7 +342,8 @@ def build_index(root: str) -> dict:
         "generated_by": "tools/bench_history.py",
         "sources": [os.path.basename(p) for p in train_files + cost_files]
         + (["BENCH_serve.json"] if os.path.exists(serve_path) else [])
-        + [os.path.basename(p) for p in campaign_files],
+        + [os.path.basename(p) for p in campaign_files]
+        + [os.path.basename(p) for p in resilience_files],
         "series": series,
     }
 

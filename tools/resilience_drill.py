@@ -63,10 +63,37 @@ way an operator would verify a production incident:
                         pool replaces the dead replica — ZERO failed
                         client requests across both
 
-Writes ``RESILIENCE_r01.json`` (``--out``) with per-drill ok/detail and
+Pod-scale matrix (ISSUE 18 — 2 hosts × 4 virtual devices = 8, ZeRO-3,
+sharded async save + cross-host dispatch ring):
+
+  sharded_save_kill_at_barrier  the PRIMARY is SIGKILLed after every
+                        host's shard files are durable (the shard
+                        barrier has completed) but BEFORE the manifest
+                        commits → the group restart quarantines the
+                        manifest-less dir and walks back to the intact
+                        sharded ckpt_ep_000
+  ring_wedge_degrade    FAULTS.WEDGE_RING holds the leader's grant
+                        order past ASYNC.RING_DEADLINE_S → the follower
+                        flags dispatch.wedge and the NEXT epoch boundary
+                        collectively degrades that epoch's eval to
+                        synchronous — the run completes, never hangs
+  eval_during_sharded_save  concurrent eval overlaps the sharded async
+                        commit, no faults: every checkpoint is sharded,
+                        committed, digest-verified; zero wedges
+  sharded_restore_fewer_shards  one shard file deleted AFTER commit
+                        (FAULTS.DROP_SHARD_FILE — the lost-disk case) →
+                        a direct restore refuses naming the recorded
+                        sharding, and the restart's digest walk
+                        quarantines + walks back to ckpt_ep_000
+  multihost_soak        a 3-epoch 2-host soak of the full async plane
+                        (ring + conc eval + sharded save): all epochs
+                        sharded + verified, zero wedges, zero corrupt
+
+Writes ``RESILIENCE_r02.json`` (``--out``) with per-drill ok/detail and
 ``all_ok``. A fast subset of the same recovery paths gates tier-1 in
-``tests/test_resilience.py``; the multi-process kill drill also runs as
-``tests/test_resilience_multiprocess.py`` (slow tier).
+``tests/test_resilience.py``; the multi-process kill drills also run as
+``tests/test_resilience_multiprocess.py`` and
+``tests/test_sharded_multiprocess.py`` (slow tier).
 
     JAX_PLATFORMS=cpu python tools/resilience_drill.py
     python tools/resilience_drill.py --skip-multiprocess   # single-host only
@@ -567,6 +594,305 @@ def drill_multihost_async_save_kill(work):
     return all(checks.values()), checks
 
 
+# ---------------------------------------------------- pod-scale (ISSUE 18)
+# 2 hosts × 4 virtual devices, MESH.ZERO=3: train state is genuinely
+# cross-host-sharded, so the async save runs the per-host shard protocol
+# and concurrent eval runs under the cross-host dispatch ring.
+
+POD_OVERRIDES = ("MESH.ZERO", 3, "CHECKPOINT.ASYNC", "True",
+                 "TRAIN.CONCURRENT_EVAL", "True",
+                 "ASYNC.BARRIER_TIMEOUT_S", 60)
+
+
+def _free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+def _spawn_pod(work, out, overrides, tag, port, ndev="4"):
+    """Two ranks of the drill WORKER as a JAX multi-process pod."""
+    script = os.path.join(work, "worker.py")
+    with open(script, "w") as f:
+        f.write(WORKER)
+    procs, logs = [], []
+    for rank in range(2):
+        env = dict(os.environ)
+        env.pop("JAX_PLATFORMS", None)
+        env.update(
+            MASTER_ADDR="127.0.0.1", COORDINATOR_PORT=str(port),
+            WORLD_SIZE="2", RANK=str(rank), DTPU_DRILL_NDEV=ndev,
+            PYTHONPATH=ROOT + os.pathsep + os.environ.get("PYTHONPATH", ""),
+        )
+        log = open(os.path.join(work, f"{tag}{rank}.log"), "w+")
+        logs.append(log)
+        procs.append(subprocess.Popen(
+            [sys.executable, script, out, *map(str, overrides)],
+            env=env, cwd=ROOT, stdout=log, stderr=subprocess.STDOUT,
+            text=True,
+        ))
+    return procs, logs
+
+
+def _join_pod(procs, logs, timeout=1800):
+    outs = []
+    for p, log in zip(procs, logs):
+        try:
+            p.wait(timeout=timeout)
+        except subprocess.TimeoutExpired:
+            p.kill()
+            p.wait()
+        log.seek(0)
+        outs.append(log.read())
+        log.close()
+    return outs
+
+
+def _telemetry_records(out: str, kind: str) -> list[dict]:
+    recs = []
+    tdir = os.path.join(out, "telemetry")
+    if os.path.isdir(tdir):
+        for name in sorted(os.listdir(tdir)):
+            if not name.endswith(".jsonl"):
+                continue
+            for line in open(os.path.join(tdir, name)):
+                try:
+                    r = json.loads(line)
+                except json.JSONDecodeError:
+                    continue
+                if r.get("kind") == kind:
+                    recs.append(r)
+    return recs
+
+
+def _sharded_committed(out: str, name: str) -> bool:
+    d = os.path.join(out, "checkpoints", name)
+    return all(os.path.isfile(os.path.join(d, f)) for f in (
+        "MANIFEST.json", "SHARDS_host0.json", "SHARDS_host1.json",
+        "shards_host0.npz", "shards_host1.npz",
+    ))
+
+
+@_drill("sharded_save_kill_at_barrier")
+def drill_sharded_save_kill_at_barrier(work):
+    """The sharded-commit crash window: every host's shard files are
+    durable (the commit barrier completed) when FAULTS.KILL_AT_SHARD_BARRIER
+    SIGKILLs the PRIMARY before the manifest commit. The group restart
+    must quarantine the manifest-less ckpt_ep_001 ("no committed
+    manifest"), walk back to the intact SHARDED ckpt_ep_000, restore it
+    across both hosts, re-train epoch 1, and complete — sharded async
+    commit on, again."""
+    out = os.path.join(work, "out")
+    port = _free_port()
+    kill_over = POD_OVERRIDES + (
+        "OPTIM.MAX_EPOCH", 2, "ASYNC.BARRIER_TIMEOUT_S", 20,
+        "FAULTS.ENABLED", "True", "FAULTS.KILL_AT_SHARD_BARRIER", 1,
+    )
+    procs, logs = _spawn_pod(work, out, kill_over, "kill", port)
+    try:
+        procs[0].wait(timeout=1800)  # the primary SIGKILLs itself
+    except subprocess.TimeoutExpired:
+        procs[0].kill()
+    deadline = time.time() + 120
+    while time.time() < deadline and procs[1].poll() is None:
+        time.sleep(1.0)
+    if procs[1].poll() is None:  # wedged with a dead peer: reap it
+        procs[1].kill()
+        procs[1].wait(timeout=60)
+    for log in logs:
+        log.close()
+    ep1 = os.path.join(out, "checkpoints", "ckpt_ep_001")
+    checks = {
+        "primary_sigkilled": procs[0].returncode == -signal.SIGKILL,
+        "epoch0_sharded_committed": _sharded_committed(out, "ckpt_ep_000"),
+        # the crash window: BOTH hosts' shard files durable, manifest NOT
+        "shards_durable_no_manifest": os.path.isfile(
+            os.path.join(ep1, "shards_host0.npz"))
+        and os.path.isfile(os.path.join(ep1, "shards_host1.npz"))
+        and not os.path.isfile(os.path.join(ep1, "MANIFEST.json")),
+    }
+    if not all(checks.values()):
+        return False, checks
+
+    recover_over = POD_OVERRIDES + ("OPTIM.MAX_EPOCH", 2)
+    procs, logs = _spawn_pod(work, out, recover_over, "recover", port)
+    outs = _join_pod(procs, logs)
+    names = _ckpts(out)
+    checks.update({
+        "recover_rc==0": all(p.returncode == 0 for p in procs),
+        "quarantined_as_uncommitted": "no committed manifest" in outs[0]
+        and any(n.startswith("ckpt_ep_001.corrupt") for n in names),
+        "walked_back": "resumed from" in outs[0] and "ckpt_ep_000" in outs[0],
+        "epoch1_retrained_sharded": _sharded_committed(out, "ckpt_ep_001"),
+        "completed": all("DRILL_DONE" in o for o in outs),
+    })
+    return all(checks.values()), checks
+
+
+@_drill("ring_wedge_degrade")
+def drill_ring_wedge_degrade(work):
+    """Wedge-on-ring: FAULTS.WEDGE_RING holds the leader's grant order
+    for slot ~20 (just past the epoch-0→1 boundary, where train and the
+    concurrent eval contend) for 2 s — past ASYNC.RING_DEADLINE_S=0.5.
+    The follower must flag ``dispatch.wedge`` (naming the ring slot), the
+    next epoch boundary must COLLECTIVELY degrade that epoch's eval to
+    synchronous (the logged warning), and the run must complete — a
+    degraded epoch, never a hang."""
+    out = os.path.join(work, "out")
+    over = POD_OVERRIDES + (
+        "OPTIM.MAX_EPOCH", 2, "ASYNC.RING_DEADLINE_S", 0.5,
+        "FAULTS.ENABLED", "True", "FAULTS.WEDGE_RING", 20,
+        "FAULTS.WEDGE_RING_S", 2.0,
+    )
+    procs, logs = _spawn_pod(work, out, over, "wedge", _free_port())
+    outs = _join_pod(procs, logs)
+    wedges = _telemetry_records(out, "dispatch.wedge")
+    ring = _telemetry_records(out, "dispatch.ring")
+    checks = {
+        "rc==0": all(p.returncode == 0 for p in procs),
+        "ring_active": all("cross-host dispatch ring active" in o
+                           for o in outs),
+        "wedge_flagged_on_ring_slot": any(
+            "ring slot" in r.get("phase", "") for r in wedges
+        ),
+        "boundary_degraded": any(
+            "dispatch ring wedged during epoch" in o for o in outs
+        ),
+        "ring_stats_emitted": {r.get("host") for r in ring} == {0, 1}
+        and any(r["deadline_misses"] >= 1 for r in ring),
+        "completed": all("DRILL_DONE" in o for o in outs),
+        "both_epochs_sharded": _sharded_committed(out, "ckpt_ep_000")
+        and _sharded_committed(out, "ckpt_ep_001"),
+    }
+    return all(checks.values()), checks
+
+
+@_drill("eval_during_sharded_save")
+def drill_eval_during_sharded_save(work):
+    """The overlap itself, no faults: concurrent eval dispatches through
+    the ring while the sharded commit runs off-path on both hosts. Every
+    checkpoint must be sharded + committed + digest-verified; the ring
+    must finish with zero deadline misses."""
+    out = os.path.join(work, "out")
+    over = POD_OVERRIDES + ("OPTIM.MAX_EPOCH", 2)
+    procs, logs = _spawn_pod(work, out, over, "run", _free_port())
+    outs = _join_pod(procs, logs)
+    from distribuuuu_tpu.resilience import manifest as manifest_lib
+
+    verified = {}
+    for name in ("ckpt_ep_000", "ckpt_ep_001"):
+        d = os.path.join(out, "checkpoints", name)
+        ok, reason = (manifest_lib.verify_checkpoint(d)
+                      if os.path.isdir(d) else (False, "missing"))
+        verified[name] = ok
+    ring = _telemetry_records(out, "dispatch.ring")
+    shard_recs = _telemetry_records(out, "ckpt.shard")
+    checks = {
+        "rc==0": all(p.returncode == 0 for p in procs),
+        "conc_eval_ran": all("concurrent eval" in o for o in outs),
+        "both_epochs_sharded": _sharded_committed(out, "ckpt_ep_000")
+        and _sharded_committed(out, "ckpt_ep_001"),
+        "digest_verified": all(verified.values()),
+        "shard_records_both_hosts": {r.get("host") for r in shard_recs}
+        == {0, 1},
+        "ring_clean": bool(ring) and all(
+            r["deadline_misses"] == 0 and not r["wedged"] for r in ring
+        ),
+        "no_wedge_records": not _telemetry_records(out, "dispatch.wedge"),
+        "completed": all("DRILL_DONE" in o for o in outs),
+    }
+    return all(checks.values()), checks
+
+
+@_drill("sharded_restore_fewer_shards")
+def drill_sharded_restore_fewer_shards(work):
+    """Restart with FEWER shard files than the manifest records (a host's
+    disk died between save and restart, injected by
+    FAULTS.DROP_SHARD_FILE after ckpt_ep_001's commit): a direct restore
+    must REFUSE naming the recorded sharding, and the group restart's
+    digest walk must quarantine the dir and walk back to the intact
+    sharded ckpt_ep_000."""
+    out = os.path.join(work, "out")
+    port = _free_port()
+    drop_over = POD_OVERRIDES + (
+        "OPTIM.MAX_EPOCH", 2, "FAULTS.ENABLED", "True",
+        "FAULTS.DROP_SHARD_FILE", 1, "FAULTS.DROP_SHARD_HOST", 1,
+    )
+    procs, logs = _spawn_pod(work, out, drop_over, "drop", port)
+    outs = _join_pod(procs, logs)
+    ep1 = os.path.join(out, "checkpoints", "ckpt_ep_001")
+    checks = {
+        "drop_run_rc==0": all(p.returncode == 0 for p in procs),
+        "manifest_committed_shard_missing": os.path.isfile(
+            os.path.join(ep1, "MANIFEST.json"))
+        and not os.path.isfile(os.path.join(ep1, "shards_host1.npz")),
+    }
+    if not all(checks.values()):
+        return False, checks
+    # a direct restore refuses, naming the recorded sharding
+    from distribuuuu_tpu.asyncplane import committer
+
+    try:
+        committer.read_sharded_checkpoint(ep1)
+        checks["direct_restore_refuses"] = False
+    except committer.ShardLayoutError as e:
+        msg = str(e)
+        checks["direct_restore_refuses"] = (
+            "hosts=2" in msg and "shards_host1.npz" in msg
+            and "refusing" in msg
+        )
+
+    restart_over = POD_OVERRIDES + ("OPTIM.MAX_EPOCH", 2)
+    procs, logs = _spawn_pod(work, out, restart_over, "restart", port)
+    outs = _join_pod(procs, logs)
+    names = _ckpts(out)
+    checks.update({
+        "restart_rc==0": all(p.returncode == 0 for p in procs),
+        "quarantined": "quarantined corrupt checkpoint" in outs[0]
+        and any(n.startswith("ckpt_ep_001.corrupt") for n in names),
+        "walked_back": "resumed from" in outs[0] and "ckpt_ep_000" in outs[0],
+        "epoch1_retrained_sharded": _sharded_committed(out, "ckpt_ep_001"),
+        "completed": all("DRILL_DONE" in o for o in outs),
+    })
+    return all(checks.values()), checks
+
+
+@_drill("multihost_soak")
+def drill_multihost_soak(work):
+    """The pod soak interval: 3 epochs of the full async plane — ring +
+    concurrent eval + sharded async save — with no faults. Every epoch's
+    checkpoint sharded, committed and digest-verified; zero wedges, zero
+    deadline misses, nothing quarantined."""
+    out = os.path.join(work, "out")
+    over = POD_OVERRIDES + ("OPTIM.MAX_EPOCH", 3)
+    procs, logs = _spawn_pod(work, out, over, "soak", _free_port())
+    outs = _join_pod(procs, logs)
+    from distribuuuu_tpu.resilience import manifest as manifest_lib
+
+    epochs = ("ckpt_ep_000", "ckpt_ep_001", "ckpt_ep_002")
+    verified = all(
+        os.path.isdir(os.path.join(out, "checkpoints", n))
+        and manifest_lib.verify_checkpoint(
+            os.path.join(out, "checkpoints", n))[0]
+        for n in epochs
+    )
+    ring = _telemetry_records(out, "dispatch.ring")
+    checks = {
+        "rc==0": all(p.returncode == 0 for p in procs),
+        "all_epochs_sharded": all(_sharded_committed(out, n)
+                                  for n in epochs),
+        "all_digest_verified": verified,
+        "ring_clean": bool(ring) and all(
+            r["deadline_misses"] == 0 and not r["wedged"]
+            and not r["detached"] for r in ring
+        ),
+        "no_wedge_records": not _telemetry_records(out, "dispatch.wedge"),
+        "nothing_quarantined": not any(".corrupt" in n for n in _ckpts(out)),
+        "completed": all("DRILL_DONE" in o for o in outs),
+    }
+    return all(checks.values()), checks
+
+
 @_drill("stall_watchdog")
 def drill_stall_watchdog(work):
     out = os.path.join(work, "out")
@@ -902,7 +1228,7 @@ def drill_fleet_replica_kill(work):
 
 def main():
     ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
-    ap.add_argument("--out", default="RESILIENCE_r01.json")
+    ap.add_argument("--out", default="RESILIENCE_r02.json")
     ap.add_argument("--work-dir", default=None,
                     help="scratch dir for drill runs (default: a tempdir)")
     ap.add_argument("--skip-multiprocess", action="store_true",
@@ -923,7 +1249,12 @@ def main():
         drill_fleet_replica_kill,
     ]
     if not args.skip_multiprocess:
-        drills += [drill_killed_rank, drill_multihost_async_save_kill]
+        drills += [
+            drill_killed_rank, drill_multihost_async_save_kill,
+            drill_sharded_save_kill_at_barrier, drill_ring_wedge_degrade,
+            drill_eval_during_sharded_save,
+            drill_sharded_restore_fewer_shards, drill_multihost_soak,
+        ]
     if args.only:
         keep = set(args.only.split(","))
         drills = [d for d in drills if d._drill_name in keep]

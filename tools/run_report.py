@@ -433,19 +433,27 @@ def build_report(run_dir: str, phase: str = "train") -> dict:
     # running aggregates: the LAST dispatch.token record per rank wins;
     # dispatch.wedge flags are counted outright
     seq_last: dict[int, dict] = {}
+    ring_last: dict[str, dict] = {}
     wedges = 0
     barrier_waits: dict[str, list[float]] = {}
+    shard_recs: dict[str, list[dict]] = {}
     for rank, recs in sorted(ranks.items()):
         for r in recs:
             kind = r.get("kind")
             if kind == "dispatch.token":
                 seq_last[rank] = r
+            elif kind == "dispatch.ring":
+                ring_last[str(r.get("host", rank))] = r
             elif kind == "dispatch.wedge":
                 wedges += 1
             elif kind == "ckpt.barrier":
                 barrier_waits.setdefault(
                     str(r.get("host", rank)), []
                 ).append(float(r.get("wait_s", 0.0)))
+            elif kind == "ckpt.shard":
+                shard_recs.setdefault(
+                    str(r.get("host", rank)), []
+                ).append(r)
     sequencer = None
     if seq_last:
         sequencer = {
@@ -468,6 +476,29 @@ def build_report(run_dir: str, phase: str = "train") -> dict:
             ), 6),
             "wedges": wedges,
         }
+        # cross-host dispatch ring (asyncplane/ring.py, multi-host runs):
+        # the LAST dispatch.ring record per host — per-host slot counts
+        # and ring waits, plus the wedge/detach degradation flags
+        if ring_last:
+            sequencer["ring"] = {
+                "hosts": len(ring_last),
+                "per_host": {
+                    host: {
+                        "role": r.get("role"),
+                        "slots": int(r.get("slots", 0)),
+                        "total_wait_s": round(
+                            float(r.get("total_wait_s", 0.0)), 6
+                        ),
+                        "max_wait_s": round(
+                            float(r.get("max_wait_s", 0.0)), 6
+                        ),
+                        "deadline_misses": int(r.get("deadline_misses", 0)),
+                        "wedged": bool(r.get("wedged", False)),
+                        "detached": bool(r.get("detached", False)),
+                    }
+                    for host, r in sorted(ring_last.items())
+                },
+            }
 
     # -- recompiles / checkpoints / resilience events --------------------
     compiles = {"count": 0, "wall_s": 0.0}
@@ -525,6 +556,27 @@ def build_report(run_dir: str, phase: str = "train") -> dict:
                     "max_wait_s": round(max(ws), 6),
                 }
                 for host, ws in sorted(barrier_waits.items())
+            },
+        }
+    # sharded multi-host saves (ckpt.shard records — utils/checkpoint.py
+    # _save_sharded): each host writes its OWN shards; per-host commit cost
+    if shard_recs:
+        ckpt["shards"] = {
+            "hosts": len(shard_recs),
+            "per_host": {
+                host: {
+                    "saves": len(rs),
+                    "shards": int(rs[-1].get("shards", 0)),
+                    "bytes": int(rs[-1].get("bytes", 0)),
+                    "mean_write_s": round(
+                        sum(float(r.get("write_s", 0.0)) for r in rs)
+                        / len(rs), 6,
+                    ),
+                    "max_write_s": round(
+                        max(float(r.get("write_s", 0.0)) for r in rs), 6
+                    ),
+                }
+                for host, rs in sorted(shard_recs.items())
             },
         }
 
@@ -731,6 +783,14 @@ def _print_report(rep: dict) -> None:
         for host, row in barrier["per_host"].items():
             print(f"    host {host}: {row['saves']} save(s), barrier "
                   f"wait mean {row['mean_wait_s']}s max {row['max_wait_s']}s")
+    shards = ck.get("shards")
+    if shards:
+        print(f"  sharded saves ({shards['hosts']} host(s), each writing "
+              f"its own shards):")
+        for host, row in shards["per_host"].items():
+            print(f"    host {host}: {row['saves']} save(s), "
+                  f"{row['shards']} shard(s) ({row['bytes']} B), write "
+                  f"mean {row['mean_write_s']}s max {row['max_write_s']}s")
     lm = rep.get("lm")
     if lm:
         tps = lm["tokens_per_s"]
@@ -778,6 +838,19 @@ def _print_report(rep: dict) -> None:
               f"wait(s) ({seq['fence_wait_s']}s)"
               + (f", {seq['wedges']} WEDGE flag(s)" if seq["wedges"]
                  else ""))
+        ring = seq.get("ring")
+        if ring:
+            print(f"  cross-host dispatch ring ({ring['hosts']} host(s)):")
+            for host, row in ring["per_host"].items():
+                flags = "".join(
+                    f" {f.upper()}" for f in ("wedged", "detached")
+                    if row.get(f)
+                )
+                print(f"    host {host} [{row['role']}]: {row['slots']} "
+                      f"slot(s), ring wait total {row['total_wait_s']}s "
+                      f"max {row['max_wait_s']}s, "
+                      f"{row['deadline_misses']} deadline miss(es)"
+                      + flags)
     camp = rep.get("campaign")
     if camp:
         verdict = {True: "PASS", False: "FAIL", None: "n/a"}[camp["ok"]]
