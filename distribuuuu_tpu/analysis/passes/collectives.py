@@ -96,6 +96,51 @@ def run(bundle) -> list:
             waiver_key=finding_key(PASS_ID, bundle.name, kind, axes_s),
         ))
 
+    # --- ring-attention permute census band over the seq axis
+    ring = exp.get("ring")
+    if ring:
+        seq_permutes = [
+            op for op in census
+            if op["kind"] == "collective-permute"
+            and op["axes"] == (ring["axis"],)
+        ]
+        n = len(seq_permutes)
+        if n < ring["min_permutes"]:
+            findings.append(Finding(
+                pass_id=PASS_ID, severity="error",
+                location=f"{bundle.name}::collective-permute@{ring['axis']}",
+                message=(
+                    f"missing ring hop: {n} collective-permute op(s) over "
+                    f"the {ring['axis']} axis vs >= {ring['min_permutes']} "
+                    f"expected ({ring['attn_layers']} seq-sharded attention "
+                    "layers, each a ppermute ring over K/V blocks — "
+                    "ops/ring_attention.py): an attention layer stopped "
+                    "rotating K/V and each seq shard attends only its "
+                    "local block — wrong math, not just a slow schedule"
+                ),
+                waiver_key=finding_key(
+                    PASS_ID, bundle.name, "ring-missing", ring["axis"]
+                ),
+            ))
+        elif n > ring["max_permutes"]:
+            pbytes = sum(op["bytes"] for op in seq_permutes)
+            findings.append(Finding(
+                pass_id=PASS_ID, severity="warning",
+                location=f"{bundle.name}::collective-permute@{ring['axis']}",
+                message=(
+                    f"extra ring traffic: {n} collective-permute op(s) over "
+                    f"the {ring['axis']} axis ({pbytes} B) vs <= "
+                    f"{ring['max_permutes']} expected (= 8 x "
+                    f"{ring['attn_layers']} attention layers + 4 slack — "
+                    "fwd k/v hops + their autodiff transposes, doubled "
+                    "for XLA splitting): something beyond the attention "
+                    "rings is bouncing over the seq axis"
+                ),
+                waiver_key=finding_key(
+                    PASS_ID, bundle.name, "ring-extra", ring["axis"]
+                ),
+            ))
+
     # --- gather-storm bound over the data axis
     bound = exp["gather_bound"]
     if bound is not None:

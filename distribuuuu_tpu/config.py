@@ -377,6 +377,15 @@ _C.GENERATE.BATCH_TILES = []
 _C.GENERATE.CACHE_TILES = []
 # Longest admissible prompt (tokens). Prefill pads to this length.
 _C.GENERATE.PROMPT_LEN = 64
+# Chunked paged prefill (lm/generate.py, ISSUE 19): > 0 streams each
+# prompt into its KV-cache page in fixed CHUNK_PREFILL-token
+# prefill-shaped calls — a long prompt needs no wide prefill bucket, and
+# the admissible prompt length grows from PROMPT_LEN to whatever the
+# largest cache tile can hold next to the request's max_new (+ SPECULATE.K).
+# Every cache tile >= the chunk must be a chunk multiple (the final padded
+# chunk writes ceil(plen/chunk)*chunk page positions — validated with the
+# arithmetic at engine build). 0 = classic whole-prompt prefill.
+_C.GENERATE.CHUNK_PREFILL = 0
 # Token id that terminates a sequence early (the byte tokenizer's EOS
 # document-boundary token). -1 = generate exactly max_new_tokens.
 _C.GENERATE.EOS_ID = 256
@@ -819,6 +828,22 @@ _C.SERVE.BUCKET_SIZES = []
 # Bounded-queue backpressure: submissions beyond this depth are rejected
 # with a retry-after hint instead of growing latency without bound.
 _C.SERVE.MAX_QUEUE = 64
+# Length-aware serving (the long-context plane): prompts of at least
+# LONG_PROMPT_THRESHOLD tokens form the "long" admission/routing class;
+# 0 disables classification (every request is "short").
+_C.SERVE.LONG_PROMPT_THRESHOLD = 0
+# At most this many of the MAX_QUEUE slots may hold long-class requests
+# at once, so a burst of long prompts backpressures while short decode
+# traffic keeps admitting — one chunked 4k prefill cannot starve the
+# decode batch. Must stay below MAX_QUEUE (the short-class headroom IS
+# the reservation); 0 = no reservation.
+_C.SERVE.LONG_MAX_QUEUE = 0
+# Optional per-length-class windowed p99 SLO targets (ms; 0 = no
+# target). The fleet router surfaces `length:short` / `length:long`
+# rows next to its per-model SLO rows, so the slo-breach alert rule
+# referees them unchanged (telemetry/live.py).
+_C.SERVE.SHORT_P99_SLO_MS = 0.0
+_C.SERVE.LONG_P99_SLO_MS = 0.0
 # Local device index the serving replica pins to (latency-optimal
 # small-batch serving is one single-chip replica per chip; run one
 # serve_net process per chip for throughput).

@@ -96,6 +96,20 @@ def write_token_shards(
             )
         writer.add(seq.tobytes(), int(n_docs), f"seq-{n:08d}")
         n += 1
+    if n == 0:
+        # the long-context footgun (ISSUE 19): repacking a small corpus at
+        # --pack-len 4096 silently drops the trailing partial window — the
+        # ONLY window — and commits an empty split the loader then refuses
+        # hours later. Refuse here, at pack time, with the arithmetic (no
+        # shard was opened — zero adds — so there is nothing to clean up,
+        # and no MANIFEST.json is committed).
+        raise ValueError(
+            f"{out_dir}: 0 complete records at pack_len={pack_len} — every "
+            f"record needs pack_len+1={pack_len + 1} tokens and the "
+            "EOS-joined corpus stream is shorter than one record (the "
+            "trailing partial window is dropped by contract); lower "
+            "--pack-len or grow the corpus"
+        )
     shards = writer.close()
     return write_shard_manifest(
         out_dir, shards, classes=[], target_bytes=target_bytes, source=source,

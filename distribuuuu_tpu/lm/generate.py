@@ -61,7 +61,10 @@ from jax.sharding import NamedSharding, PartitionSpec as P
 from distribuuuu_tpu.config import cfg
 from distribuuuu_tpu.models.layers import Dense, head_dtype
 from distribuuuu_tpu.models.vit import Mlp, MoeMlp
-from distribuuuu_tpu.serve.admission import AdmissionController
+from distribuuuu_tpu.serve.admission import (
+    AdmissionController,
+    QueueFullError,
+)
 from distribuuuu_tpu.telemetry import registry as telemetry_registry
 
 
@@ -309,6 +312,40 @@ def validate_generate_cfg(seq_len: int, prompt_len: int, max_new: int,
             "lower MAX_NEW_TOKENS/PROMPT_LEN"
         )
     return batch_tiles, cache_tiles
+
+
+def validate_chunk_prefill_cfg(chunk: int, cache_tiles: list[int]):
+    """The GENERATE.CHUNK_PREFILL refusals, exact arithmetic in-message
+    (ISSUE 19): chunked prefill streams a prompt into its KV page in
+    fixed ``chunk``-token appends, and the final chunk is PADDED — it
+    writes ``ceil(plen/chunk)*chunk`` page positions — so every cache
+    tile wide enough to be a page must be a chunk multiple, or a ragged
+    prompt near the tile edge would write past it (dynamic_update_slice
+    clamps the start: silent page corruption, not an error)."""
+    if chunk < 1:
+        raise ValueError(
+            f"GENERATE.CHUNK_PREFILL={chunk} must be >= 1 (0 disables "
+            "chunked prefill)"
+        )
+    if chunk > cache_tiles[-1]:
+        raise ValueError(
+            f"GENERATE.CHUNK_PREFILL={chunk} exceeds the largest "
+            f"GENERATE.CACHE_TILES entry {cache_tiles[-1]} — no page "
+            f"could hold even one chunk; lower CHUNK_PREFILL to "
+            f"<= {cache_tiles[-1]} or raise CACHE_TILES"
+        )
+    for c in cache_tiles:
+        if c >= chunk and c % chunk:
+            raise ValueError(
+                f"GENERATE.CHUNK_PREFILL={chunk} does not divide "
+                f"GENERATE.CACHE_TILES entry {c} ({c} % {chunk} = "
+                f"{c % chunk}) — the final padded chunk writes "
+                f"ceil(plen/{chunk})*{chunk} positions into its page, "
+                f"which can spill past a {c}-wide tile; use cache tiles "
+                f"that are multiples of {chunk} (e.g. {c - c % chunk} or "
+                f"{c + chunk - c % chunk}) or a CHUNK_PREFILL that "
+                f"divides every tile"
+            )
 
 
 # --------------------------------------------------------------- sampling
@@ -589,6 +626,8 @@ class GenerateEngine:
         cache_tiles: list[int] | None = None,
         eos_id: int | None = None,
         max_queue: int | None = None,
+        long_prompt_threshold: int | None = None,
+        long_max_queue: int | None = None,
         poll_s: float | None = None,
         emit_interval_s: float = 10.0,
         mesh=None,
@@ -596,6 +635,7 @@ class GenerateEngine:
         draft_variables: dict | None = None,
         spec_k: int | None = None,
         sample: SampleParams | dict | None = None,
+        chunk_prefill: int | None = None,
     ):
         self.model = model
         self.decoder = decoder_for(model)
@@ -646,6 +686,17 @@ class GenerateEngine:
         self.prompt_tiles = [
             t for t in default_tiles(self.prompt_len)
         ]
+        # chunked paged prefill (ISSUE 19): > 0 replaces the whole-prompt
+        # prefill buckets with ONE fixed-width chunk executable per cache
+        # tile — the prompt streams into its page chunk by chunk, so a 4k
+        # prompt needs no 4k bucket and may exceed PROMPT_LEN up to what
+        # the largest cache tile holds next to max_new (+ spec K)
+        self.chunk_prefill = int(
+            chunk_prefill if chunk_prefill is not None
+            else cfg.GENERATE.CHUNK_PREFILL
+        )
+        if self.chunk_prefill:
+            validate_chunk_prefill_cfg(self.chunk_prefill, self.cache_tiles)
         self._default_sample = sample_params(sample)
 
         # -- tensor-parallel decode (ISSUE 17a) ---------------------------
@@ -692,9 +743,29 @@ class GenerateEngine:
             self._draft_variables = {"params": draft_variables["params"]}
 
         self.n_slots = self.batch_tiles[-1]
-        self._admission = AdmissionController(
-            max_queue if max_queue is not None else cfg.SERVE.MAX_QUEUE
+        # length-aware admission (the long-context plane): prompts of
+        # >= long_threshold tokens are the "long" class, capped at
+        # long_max_queue of the max_queue slots so a burst of chunked
+        # long prefills cannot starve short decode traffic
+        self.long_threshold = int(
+            long_prompt_threshold if long_prompt_threshold is not None
+            else cfg.SERVE.LONG_PROMPT_THRESHOLD
         )
+        self._admission = AdmissionController(
+            max_queue if max_queue is not None else cfg.SERVE.MAX_QUEUE,
+            long_max_queue=int(
+                long_max_queue if long_max_queue is not None
+                else cfg.SERVE.LONG_MAX_QUEUE
+            ),
+        )
+        if self._admission.long_max_queue and not self.long_threshold:
+            raise ValueError(
+                f"SERVE.LONG_MAX_QUEUE={self._admission.long_max_queue} "
+                "without SERVE.LONG_PROMPT_THRESHOLD — the long-class "
+                "reservation needs the prompt-token threshold that "
+                "defines the long class (set SERVE.LONG_PROMPT_THRESHOLD "
+                ">= 1)"
+            )
         self._emit_interval_s = emit_interval_s
         self._dtype = model.dtype
         self._heads = model.num_heads
@@ -737,6 +808,8 @@ class GenerateEngine:
         self.n_compiles = 0
         self._decode_exec: dict[tuple[int, int], Any] = {}
         self._prefill_exec: dict[int, Any] = {}
+        self._chunk_exec: dict[int, Any] = {}
+        self._draft_chunk_exec: dict[int, Any] = {}
         self._insert_exec: dict[tuple[int, int, int], Any] = {}
         self._grow_exec: dict[tuple, Any] = {}
         self._verify_exec: dict[tuple[int, int], Any] = {}
@@ -773,6 +846,10 @@ class GenerateEngine:
                 spec_rounds=0, spec_proposed=0, spec_accepted=0,
                 spec_bonus=0,
             )
+        if self.chunk_prefill:
+            self._counters.update(chunk_prefills=0, chunk_calls=0)
+        if self.long_threshold:
+            self._counters.update(long_admitted=0, long_rejected=0)
         self._decode_ms: deque = deque(maxlen=4096)
         self._prefill_ms: deque = deque(maxlen=1024)
         self._thread = threading.Thread(
@@ -863,6 +940,15 @@ class GenerateEngine:
             lengths = jnp.zeros((B,), jnp.int32)
             return self.decoder.apply(variables, tokens, lengths, zero)
 
+        def chunk_fn(variables, tokens, lengths, cache):
+            # one fixed-width prompt chunk appended into the B=1 page at
+            # the chunk's start offset — prefill re-expressed as
+            # verify-shaped calls against a page-sized cache, so the page
+            # builds in ceil(plen/W) precompiled steps of ONE width
+            return self.decoder.apply(variables, tokens, lengths, cache)
+
+        chunk_fn.__name__ = "verify_fn"  # TP out contract: (logits, cache)
+
         def insert_fn(cache, kv, slot):
             return jax.tree.map(
                 lambda c, n: jax.lax.dynamic_update_slice(
@@ -902,14 +988,28 @@ class GenerateEngine:
                     )
                     self.n_compiles += 1
                     COMPILE_EVENTS.append(b)
-        for p in self.prompt_tiles:
-            self._prefill_exec[p] = (
-                self._jit(prefill_fn)
-                .lower(vars_sds, tok1((1, p)))
-                .compile()
-            )
-            self.n_compiles += 1
-        for p in self.prompt_tiles:
+        if self.chunk_prefill:
+            W = self.chunk_prefill
+            page_tiles = [c for c in self.cache_tiles if c >= W]
+            for c in page_tiles:
+                self._chunk_exec[c] = (
+                    self._jit(chunk_fn, donate=(3,))
+                    .lower(vars_sds, tok1((1, W)), tok1((1,)),
+                           self._cache_sds(1, c))
+                    .compile()
+                )
+                self.n_compiles += 1
+                COMPILE_EVENTS.append(1)
+        else:
+            page_tiles = self.prompt_tiles
+            for p in self.prompt_tiles:
+                self._prefill_exec[p] = (
+                    self._jit(prefill_fn)
+                    .lower(vars_sds, tok1((1, p)))
+                    .compile()
+                )
+                self.n_compiles += 1
+        for p in page_tiles:
             for b in self.batch_tiles:
                 for c in self.cache_tiles:
                     if p > c:
@@ -950,6 +1050,12 @@ class GenerateEngine:
                 costmodel.capture_compiled(
                     ex, label=f"gen_prefill_p{p}", phase="generate",
                     images=1, arch=cfg.MODEL.ARCH,
+                )
+            for c, ex in self._chunk_exec.items():
+                costmodel.capture_compiled(
+                    ex,
+                    label=f"gen_chunk_prefill_w{self.chunk_prefill}_c{c}",
+                    phase="generate", images=1, arch=cfg.MODEL.ARCH,
                 )
 
     def _compile_draft_tiles(self) -> None:
@@ -993,6 +1099,14 @@ class GenerateEngine:
                 step, (cache, jnp.zeros_like(lens0)), xs
             )
             return outs, cache  # [S, b] per-step argmaxes
+
+        def draft_chunk_fn(variables, tokens, lengths, cache):
+            # the draft's page builds through the same chunk stream, so a
+            # chunk-admitted request speculates with a fully-mirrored
+            # prompt (logits discarded — only the K/V matter here)
+            return self.draft_decoder.apply(variables, tokens, lengths, cache)
+
+        draft_chunk_fn.__name__ = "verify_fn"
 
         def draft_prefill_fn(variables, tokens):
             B, Pt = tokens.shape
@@ -1060,13 +1174,27 @@ class GenerateEngine:
                     )
                     self.n_compiles += 1
                     COMPILE_EVENTS.append(b)
-        for p in self.prompt_tiles:
-            self._draft_prefill_exec[p] = (
-                self._jit(draft_prefill_fn)
-                .lower(vars_sds, tok1((1, p)))
-                .compile()
-            )
-            self.n_compiles += 1
+        if self.chunk_prefill:
+            W = self.chunk_prefill
+            page_tiles = [c for c in self.cache_tiles if c >= W]
+            for c in page_tiles:
+                self._draft_chunk_exec[c] = (
+                    self._jit(draft_chunk_fn, donate=(3,))
+                    .lower(vars_sds, tok1((1, W)), tok1((1,)),
+                           self._cache_sds(1, c, draft=True))
+                    .compile()
+                )
+                self.n_compiles += 1
+        else:
+            page_tiles = self.prompt_tiles
+            for p in self.prompt_tiles:
+                self._draft_prefill_exec[p] = (
+                    self._jit(draft_prefill_fn)
+                    .lower(vars_sds, tok1((1, p)))
+                    .compile()
+                )
+                self.n_compiles += 1
+        for p in page_tiles:
             for b in self.batch_tiles:
                 for c in self.cache_tiles:
                     if p > c:
@@ -1130,7 +1258,27 @@ class GenerateEngine:
         ids = np.asarray(list(prompt), np.int32)
         if ids.ndim != 1 or len(ids) < 1:
             raise ValueError("prompt must be a non-empty 1-D token list")
-        if len(ids) > self.prompt_len:
+        max_new = min(
+            self.max_new,
+            int(max_new_tokens) if max_new_tokens else self.max_new,
+        )
+        if self.chunk_prefill:
+            # chunked prefill unpins the prompt bound from PROMPT_LEN:
+            # any prompt the cache can hold next to its decode budget
+            bound = self.cache_tiles[-1] - max_new - self.spec_k
+            if len(ids) > bound:
+                spec = (
+                    f" + SPECULATE.K={self.spec_k}" if self.spec_k else ""
+                )
+                raise ValueError(
+                    f"prompt of {len(ids)} tokens cannot fit the cache: "
+                    f"{len(ids)} + max_new={max_new}{spec} > largest "
+                    f"GENERATE.CACHE_TILES entry {self.cache_tiles[-1]} — "
+                    "chunked prefill admits any prompt the cache holds; "
+                    "shorten the prompt, lower max_new_tokens, or raise "
+                    "CACHE_TILES"
+                )
+        elif len(ids) > self.prompt_len:
             raise ValueError(
                 f"prompt of {len(ids)} tokens exceeds "
                 f"GENERATE.PROMPT_LEN={self.prompt_len}"
@@ -1139,18 +1287,38 @@ class GenerateEngine:
             raise ValueError(
                 f"prompt token ids must lie in [0, {self.model.vocab_size})"
             )
-        max_new = min(
-            self.max_new,
-            int(max_new_tokens) if max_new_tokens else self.max_new,
-        )
+        lc = self._length_class(len(ids))
         with self._lock:
-            self._admission.admit(len(self._waiting), self._retry_after_ms())
+            try:
+                self._admission.admit(
+                    len(self._waiting), self._retry_after_ms(),
+                    length_class=lc,
+                    class_depth=sum(
+                        1 for (_s, w, _m, _p) in self._waiting
+                        if self._length_class(len(w)) == "long"
+                    ),
+                )
+            except QueueFullError:
+                if self.long_threshold and lc == "long":
+                    self._counters["long_rejected"] += 1
+                raise
             stream = GenStream(self._next_id, len(ids))
             self._next_id += 1
             self._waiting.append((stream, ids, max_new, sp))
             self._counters["requests"] += 1
+            if self.long_threshold and lc == "long":
+                self._counters["long_admitted"] += 1
             self._lock.notify_all()
         return stream
+
+    def _length_class(self, prompt_tokens: int) -> str:
+        """"long" when classification is on and the prompt reaches
+        SERVE.LONG_PROMPT_THRESHOLD tokens; "short" otherwise."""
+        return (
+            "long"
+            if self.long_threshold and prompt_tokens >= self.long_threshold
+            else "short"
+        )
 
     def drain(self, timeout: float | None = 60.0) -> None:
         """Stop admitting, finish every queued and in-flight request,
@@ -1184,6 +1352,10 @@ class GenerateEngine:
         the generation-plane view."""
         with self._lock:
             waiting = len(self._waiting)
+            waiting_long = sum(
+                1 for (_s, w, _m, _p) in self._waiting
+                if self._length_class(len(w)) == "long"
+            )
             active = sum(1 for s in self._slots if s is not None)
         dm = sorted(self._decode_ms)
         pm = sorted(self._prefill_ms)
@@ -1194,8 +1366,12 @@ class GenerateEngine:
         el = max(time.perf_counter() - self._t0, 1e-9)
         return {
             "queue_depth": waiting,
+            "queue_depth_long": waiting_long,
+            "long_threshold": self.long_threshold,
+            "long_max_queue": self._admission.long_max_queue,
             "active": active,
             "slots": self.n_slots,
+            "chunk_prefill": self.chunk_prefill,
             "n_compiles": self.n_compiles,
             "buckets": [list(t) for t in sorted(self._decode_exec)],
             "max_batch": self.n_slots,
@@ -1228,12 +1404,93 @@ class GenerateEngine:
             self._draft_cache = self._draft_grow_exec[key](self._draft_cache)
         self._b_tile, self._c_tile = b, c
 
+    def _admit_chunked(self, slot: int, stream: GenStream, ids: np.ndarray,
+                       max_new: int, sp: SampleParams) -> None:
+        """Chunked paged prefill (ISSUE 19): the prompt streams into a
+        fresh B=1 page in fixed CHUNK_PREFILL-token appends — every call
+        a precompiled chunk executable — then the page inserts into the
+        slot exactly like whole-prompt prefill. The final chunk is padded;
+        its pad K/V land past ``plen`` where the ragged mask never looks
+        and the decode writes overwrite position by position. The first
+        generated token comes off the last chunk's logit row at the
+        prompt's final position — pinned logit-identical (float tol) to
+        whole-prompt prefill by tests/test_lm_chunk_prefill.py."""
+        from distribuuuu_tpu.telemetry import spans
+
+        t0 = time.perf_counter()
+        W = self.chunk_prefill
+        plen = len(ids)
+        n_chunks = -(-plen // W)
+        ct = tile_for(self.cache_tiles, n_chunks * W)
+        self._ensure_tile(slot + 1, max(plen + max_new + self.spec_k, ct))
+        page = self._zero_cache(1, ct)
+        logits = None
+        for k in range(n_chunks):
+            seg = ids[k * W:(k + 1) * W]
+            chunk = np.zeros((1, W), np.int32)
+            chunk[0, :len(seg)] = seg
+            logits, page = self._chunk_exec[ct](
+                self._variables, jnp.asarray(chunk),
+                jnp.full((1,), k * W, jnp.int32), page,
+            )
+        self._cache = self._insert_exec[(ct, self._b_tile, self._c_tile)](
+            self._cache, page, jnp.int32(slot)
+        )
+        s = _Slot(stream, plen, 0, max_new, sp)
+        first = self._select(
+            s, np.asarray(logits[0, (plen - 1) - (n_chunks - 1) * W])
+        )
+        s.last_token = first
+        s.history = list(int(t) for t in ids) + [first]
+        self._slots[slot] = s
+        if self.spec_k:
+            dpage = self._zero_cache(1, ct, draft=True)
+            for k in range(n_chunks):
+                seg = ids[k * W:(k + 1) * W]
+                chunk = np.zeros((1, W), np.int32)
+                chunk[0, :len(seg)] = seg
+                _, dpage = self._draft_chunk_exec[ct](
+                    self._draft_variables, jnp.asarray(chunk),
+                    jnp.full((1,), k * W, jnp.int32), dpage,
+                )
+            self._draft_cache = self._draft_insert_exec[
+                (ct, self._b_tile, self._c_tile)
+            ](self._draft_cache, dpage, jnp.int32(slot))
+            s.draft_len = plen
+        self._counters["prompt_tokens"] += plen
+        self._counters["chunk_prefills"] += 1
+        self._counters["chunk_calls"] += n_chunks * (2 if self.spec_k else 1)
+        ms = (time.perf_counter() - t0) * 1e3
+        self._prefill_ms.append(ms)
+        stream._emit(first)
+        s.new_tokens = 1
+        self._counters["new_tokens"] += 1
+        if spans.enabled():
+            spans.emit_event(
+                "gen.admit", slot=slot, prompt_tokens=plen,
+                request=stream.request_id,
+                length_class=self._length_class(plen),
+            )
+            spans.emit_event(
+                "gen.chunk_prefill", tokens=plen, chunk=W,
+                chunks=n_chunks, tile=ct, ms=round(ms, 3),
+            )
+            if not sp.greedy:
+                spans.emit_event(
+                    "gen.sample", request=stream.request_id,
+                    temperature=sp.temperature, top_k=sp.top_k,
+                    top_p=sp.top_p, seed=sp.seed,
+                )
+        self._maybe_finish(slot, first)
+
     def _admit(self, stream: GenStream, ids: np.ndarray, max_new: int,
                sp: SampleParams) -> None:
         from distribuuuu_tpu.telemetry import spans
 
         slot = self._free_slot()
         assert slot is not None
+        if self.chunk_prefill:
+            return self._admit_chunked(slot, stream, ids, max_new, sp)
         t0 = time.perf_counter()
         plen = len(ids)
         ptile = tile_for(self.prompt_tiles, plen)
@@ -1270,6 +1527,7 @@ class GenerateEngine:
             spans.emit_event(
                 "gen.admit", slot=slot, prompt_tokens=plen,
                 request=stream.request_id,
+                length_class=self._length_class(plen),
             )
             spans.emit_event(
                 "gen.prefill", tokens=plen, tile=ptile, ms=round(ms, 3),

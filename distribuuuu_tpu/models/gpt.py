@@ -112,8 +112,18 @@ class GPT(nn.Module):
     def dummy_input(self):
         """Shape/annotation source for ``specs.abstract_state`` — token
         models can't eat the image dummy. Short (8 tokens): init slices
-        the position table, so param SHAPES don't depend on the dummy."""
-        return jnp.zeros((2, min(8, self.seq_len)), jnp.int32)
+        the position table, so param SHAPES don't depend on the dummy.
+        Under a populated seq axis the dummy's token dim is rounded to a
+        multiple of the axis size — the ring shard_map splits it evenly
+        at trace time, and an 8-token dummy on a seq=16 axis would refuse
+        before the real refusal (LM.SEQ_LEN divisibility) could speak."""
+        S = min(8, self.seq_len)
+        if self.mesh is not None:
+            n = int(dict(self.mesh.shape).get("seq", 1))
+            if n > 1:
+                S = max(S, n)
+                S -= S % n
+        return jnp.zeros((2, S), jnp.int32)
 
     def param_spec_table(self):
         """The LM leaf rules (parallel/partition/specs.lm_spec_table):
@@ -122,6 +132,16 @@ class GPT(nn.Module):
         from distribuuuu_tpu.parallel.partition import specs
 
         return specs.lm_spec_table(moe_axis=self.moe_axis)
+
+    def batch_spec_table(self):
+        """Token batch placement (parallel/partition/specs): ``[B, S]``
+        input/target leaves shard the token dim over ``seq`` on top of the
+        batch dim over ``data`` — the dp×sp layout ring attention consumes
+        — while the per-sequence ``mask`` stays on ``data``. Collapses to
+        the image-model layout on seq=1 meshes."""
+        from distribuuuu_tpu.parallel.partition import specs
+
+        return specs.TOKEN_BATCH_TABLE
 
 
 def _gpt(num_classes, kw, **defaults):

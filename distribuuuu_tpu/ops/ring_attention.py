@@ -42,24 +42,30 @@ def _block_update(q, k, v, m, l, o, scale, mask):
 
     q: [B,H,Sq,D]; k,v: [B,H,Sk,D]; m,l: [B,H,Sq] running max / normalizer;
     o: [B,H,Sq,Dv] unnormalized accumulator; mask: [Sq,Sk] bool or None.
+
+    The whole update is a deliberate f32 region (the ``_fp32`` scope is
+    the dtype lint's self-declaration convention): the running
+    (m, l, o) logsumexp state must accumulate in f32 across up to n
+    rotations — bf16 would round the correction products once per hop.
     """
-    s = jnp.einsum(
-        "bhqd,bhkd->bhqk", q, k, preferred_element_type=jnp.float32
-    ) * scale
-    if mask is not None:
-        s = jnp.where(mask[None, None], s, _NEG_BIG)
-    m_new = jnp.maximum(m, s.max(axis=-1))
-    # exp of masked-out logits underflows to 0 via the _NEG_BIG shift
-    p = jnp.exp(s - m_new[..., None])
-    if mask is not None:
-        p = jnp.where(mask[None, None], p, 0.0)
-    corr = jnp.exp(m - m_new)
-    l_new = corr * l + p.sum(axis=-1)
-    o_new = o * corr[..., None] + jnp.einsum(
-        "bhqk,bhkd->bhqd", p, v.astype(jnp.float32),
-        preferred_element_type=jnp.float32,
-    )
-    return m_new, l_new, o_new
+    with jax.named_scope("ring_softmax_fp32"):
+        s = jnp.einsum(
+            "bhqd,bhkd->bhqk", q, k, preferred_element_type=jnp.float32
+        ) * scale
+        if mask is not None:
+            s = jnp.where(mask[None, None], s, _NEG_BIG)
+        m_new = jnp.maximum(m, s.max(axis=-1))
+        # exp of masked-out logits underflows to 0 via the _NEG_BIG shift
+        p = jnp.exp(s - m_new[..., None])
+        if mask is not None:
+            p = jnp.where(mask[None, None], p, 0.0)
+        corr = jnp.exp(m - m_new)
+        l_new = corr * l + p.sum(axis=-1)
+        o_new = o * corr[..., None] + jnp.einsum(
+            "bhqk,bhkd->bhqd", p, v.astype(jnp.float32),
+            preferred_element_type=jnp.float32,
+        )
+        return m_new, l_new, o_new
 
 
 def _flash_state_update(q, kb, vb, m, l, o, scale, causal, interpret):
@@ -124,7 +130,8 @@ def ring_self_attention(
     b, h, sq, d = q.shape
     sk = k.shape[2]
     scale = d ** -0.5 if scale is None else scale
-    qf = q.astype(jnp.float32)
+    with jax.named_scope("ring_softmax_fp32"):
+        qf = q.astype(jnp.float32)
 
     if impl not in ("auto", "einsum", "flash"):
         raise ValueError(f"ring impl must be auto|einsum|flash, got {impl!r}")
@@ -160,8 +167,9 @@ def ring_self_attention(
             q, k, v, m0, l0, o0, scale, causal, None
         )
     else:
-        m, l, o = _block_update(qf, k.astype(jnp.float32), v, m0, l0, o0,
-                                scale, block_mask(my_idx))
+        with jax.named_scope("ring_softmax_fp32"):
+            m, l, o = _block_update(qf, k.astype(jnp.float32), v, m0, l0,
+                                    o0, scale, block_mask(my_idx))
 
     def step(carry, step_idx):
         m, l, o, kb, vb = carry
@@ -188,16 +196,18 @@ def ring_self_attention(
             else:
                 m, l, o = upd((m, l, o))
         else:
-            m, l, o = _block_update(qf, kb.astype(jnp.float32), vb, m, l, o,
-                                    scale, block_mask(src))
+            with jax.named_scope("ring_softmax_fp32"):
+                m, l, o = _block_update(qf, kb.astype(jnp.float32), vb, m,
+                                        l, o, scale, block_mask(src))
         return (m, l, o, kb, vb), None
 
     if n > 1:
         (m, l, o, _, _), _ = jax.lax.scan(
             step, (m, l, o, k, v), jnp.arange(1, n)
         )
-    out = o / jnp.maximum(l, 1e-30)[..., None]
-    return out.astype(v.dtype)
+    with jax.named_scope("ring_softmax_fp32"):
+        out = o / jnp.maximum(l, 1e-30)[..., None]
+        return out.astype(v.dtype)
 
 
 def ulysses_self_attention(

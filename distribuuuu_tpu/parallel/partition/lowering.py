@@ -586,9 +586,11 @@ class Lowered:
         }
         if with_mask:
             batch["mask"] = jax.ShapeDtypeStruct((B,), np.float32)
+        # token models (a batch_spec_table hook) shard [B, S] leaves over
+        # (data, seq); image models keep the blanket data-only layout
+        table = specs_lib.batch_table_for(self.model)
         batch = {
-            k: sds(v, NamedSharding(
-                self.mesh, specs_lib.BATCH_TABLE.spec_for(k)))
+            k: sds(v, NamedSharding(self.mesh, table.spec_for(k)))
             for k, v in batch.items()
         }
         return state, batch

@@ -203,6 +203,40 @@ BATCH_TABLE = SpecTable(
     strict=True,
 )
 
+# Token batches (the LM's ``[B, S]`` input/target leaves) additionally
+# shard the TOKEN dim over ``seq`` — the declaration that makes a dp×sp
+# stanza's batch arrive pre-split for the ring-attention shard_map instead
+# of resting replicated over the seq axis (which this jax line would do
+# silently). The per-sequence ``mask`` has no token dim and stays on
+# ``data`` alone. On a seq=1 mesh the extra axis collapses to replication
+# (collapse_unit_axes), so ONE declaration serves every LM topology.
+TOKEN_BATCH_TABLE = SpecTable(
+    rules=(
+        SpecRule(r"(^|[/'\[\.])image", P("data", "seq")),
+        SpecRule(r"(^|[/'\[\.])label", P("data", "seq")),
+        SpecRule(r"(^|[/'\[\.])mask", P("data")),
+    ),
+    default=None,  # unknown batch keys are refused in strict mode
+    strict=True,
+)
+
+
+def batch_table_for(model=None, arch: str | None = None) -> SpecTable:
+    """The batch spec table for a model (or a config arch name): token
+    models declare their own via a ``batch_spec_table`` hook (models/gpt.py
+    → :data:`TOKEN_BATCH_TABLE`); every other arch rides
+    :data:`BATCH_TABLE`. The single selector the lowering, the trainer and
+    the host-placement layer (parallel/sharding.py) share."""
+    if model is not None:
+        fn = getattr(model, "batch_spec_table", None)
+        if fn is not None:
+            return fn()
+        return BATCH_TABLE
+    if arch is not None and arch.startswith("gpt"):
+        return TOKEN_BATCH_TABLE
+    return BATCH_TABLE
+
+
 # Activations between layers: batch dim over ``data`` (GSPMD propagates it
 # through the whole program from the batch placement; this constant is the
 # declaration tools and docs reference).
@@ -516,7 +550,7 @@ def collective_expectations(layout: dict, topology,
     ZeRO-overlap work (ROADMAP #1) scores itself with.
 
     Returns ``{"leaves", "zero_sharded", "tp_sharded", "ep_sharded",
-    "allowed", "gather_bound"}``:
+    "allowed", "gather_bound", "ring"}``:
 
       * ``allowed`` maps each collective kind to the mesh-axis sets it
         may legitimately run over. Reductions (``all-reduce``) are
@@ -538,6 +572,19 @@ def collective_expectations(layout: dict, topology,
         (10×/leaf) applies — the escape hatch is priced, not flagged.
         Exceeding the bound is a gather storm even when gathers are
         expected at all.
+      * ``ring`` (sp topologies only, else ``None``) is the ring-attention
+        collective-permute census band: every attention layer routed over
+        the seq axis contributes one ``lax.scan`` ring (2 ppermutes per
+        body — the k and v hops, ops/ring_attention.py), the body appears
+        ONCE in HLO text regardless of trip count, and autodiff transposes
+        each ppermute to another ppermute in the backward scan. So a
+        program with N attention layers must census at least N seq-axis
+        permutes (a lower count means a ring lost its hops — the attention
+        silently stopped rotating K/V and each shard attends only its
+        local block) and at most ~8N + slack (an overshoot means extra
+        seq-axis traffic the declaration does not predict — e.g. an
+        activation bouncing between seq layouts). The analyzer's
+        collective lint referees the band (analysis/passes/collectives.py).
 
     ``gather_ahead`` defaults to the live ``cfg.ZERO.GATHER_AHEAD`` (the
     knob the analyzed program was lowered under).
@@ -581,6 +628,29 @@ def collective_expectations(layout: dict, topology,
         else:
             gather_bound = 10 * zero_sharded
 
+    ring = None
+    if "sp" in feats:
+        n_attn = sum(
+            1
+            for path, _ in jax.tree_util.tree_flatten_with_path(
+                layout["params"]
+            )[0]
+            if re.search(
+                r"Attention_\d+/Dense_0/Dense_0/kernel$", leaf_path(path)
+            )
+        )
+        if n_attn:
+            ring = {
+                "axis": "seq",
+                "attn_layers": n_attn,
+                # >= 1 permute per ring layer must survive compilation
+                # (fwd k+v hops may fuse but cannot vanish); <= fwd+bwd
+                # k/v pairs per layer doubled for XLA splitting, + slack
+                # for layout moves at the shard_map boundary
+                "min_permutes": n_attn,
+                "max_permutes": 8 * n_attn + 4,
+            }
+
     a2a_axes = set()
     if ep_sharded or "ep" in feats or "tp" in feats:
         a2a_axes |= {"expert", "model"}
@@ -607,6 +677,7 @@ def collective_expectations(layout: dict, topology,
         "ep_sharded": ep_sharded,
         "allowed": allowed,
         "gather_bound": gather_bound,
+        "ring": ring,
     }
 
 

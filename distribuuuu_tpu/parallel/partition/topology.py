@@ -152,6 +152,10 @@ def _is_vit(arch: str) -> bool:
     return arch.startswith("vit")
 
 
+def _is_gpt(arch: str) -> bool:
+    return arch.startswith("gpt")
+
+
 def _is_moe(arch: str) -> bool:
     return arch.endswith("_moe")
 
@@ -216,11 +220,12 @@ def _rule_pipe_seq(t, arch, moe):
 
 
 def _rule_seq_arch(t, arch, moe):
-    if t.seq > 1 and not _is_vit(arch):
+    if t.seq > 1 and not (_is_vit(arch) or _is_gpt(arch)):
         return (
-            f"MESH.SEQ={t.seq}: only the ViT archs route attention over "
-            "the seq axis; CNN archs have no sequence dimension to shard "
-            "(the axis would be silently replicated)"
+            f"MESH.SEQ={t.seq}: only the ViT and GPT archs route "
+            "attention over the seq axis (ring/ulysses, "
+            "ops/ring_attention.py); CNN archs have no sequence dimension "
+            "to shard (the axis would be silently replicated)"
         )
     return None
 

@@ -24,17 +24,47 @@ def replicate(mesh: Mesh) -> NamedSharding:
     return NamedSharding(mesh, P())
 
 
+def _batch_leaf_specs(tree, batch_dim: int):
+    """Per-leaf batch specs as a spec tree.
+
+    Image/CNN batches keep the historical blanket layout — dim
+    ``batch_dim`` over ``data``, everything else replicated. Token archs
+    (``MODEL.ARCH`` gpt*) read ``specs.TOKEN_BATCH_TABLE`` instead, so
+    ``[B, S]`` token leaves additionally shard the token dim over ``seq``
+    (the dp×sp layout; the table collapses to the blanket form on seq=1
+    meshes) while the per-sequence ``mask`` stays on ``data`` alone —
+    which is why the spec must be PER LEAF: one shared spec cannot serve
+    a rank-2 token leaf and the rank-1 mask at once.
+    """
+    from distribuuuu_tpu.config import cfg
+    from distribuuuu_tpu.parallel.partition import specs as specs_lib
+
+    blanket = P(*([None] * batch_dim + ["data"]))
+    flat, treedef = jax.tree_util.tree_flatten_with_path(tree)
+    if not str(cfg.MODEL.ARCH).startswith("gpt"):
+        return jax.tree.unflatten(treedef, [blanket] * len(flat))
+    table = specs_lib.batch_table_for(arch=str(cfg.MODEL.ARCH))
+    out = []
+    for path, _ in flat:
+        try:
+            base = table.spec_for(jax.tree_util.keystr(path))
+        except specs_lib.UnknownLeafError:
+            base = P("data")  # non-loader keys keep the blanket layout
+        out.append(P(*([None] * batch_dim + list(tuple(base)))))
+    return jax.tree.unflatten(treedef, out)
+
+
 def _put_tree(mesh: Mesh, tree, batch_dim: int):
     """Place a host-local pytree with the dim ``batch_dim`` of every leaf
-    sharded over ``data`` (dims before it unsharded).
+    sharded over ``data`` (dims before it unsharded) — plus, for token
+    batches, the token dim over ``seq`` (``_batch_leaf_specs``).
 
     In multi-host runs each process holds its own shard of the batch dim
     (DistributedSampler semantics, ref: utils.py:141-143) and this assembles
     the global array from per-host shards; single-host it is a plain sharded
     device_put.
     """
-    spec = P(*([None] * batch_dim + ["data"]))
-    sharding = NamedSharding(mesh, spec)
+    spec_tree = _batch_leaf_specs(tree, batch_dim)
 
     # the batch's global extent scales with DATA GROUPS, not processes:
     # processes sharing a data row (model/pipe axes spanning hosts) feed
@@ -43,8 +73,9 @@ def _put_tree(mesh: Mesh, tree, batch_dim: int):
 
     _, n_groups = data_process_groups(mesh)
 
-    def _put(x):
+    def _put(x, spec):
         x = np.asarray(x)
+        sharding = NamedSharding(mesh, spec)
         if jax.process_count() == 1:
             return jax.device_put(x, sharding)
         global_shape = tuple(
@@ -53,7 +84,7 @@ def _put_tree(mesh: Mesh, tree, batch_dim: int):
         )
         return jax.make_array_from_process_local_data(sharding, x, global_shape)
 
-    return jax.tree.map(_put, tree)
+    return jax.tree.map(_put, tree, spec_tree)
 
 
 def shard_batch(mesh: Mesh, batch):

@@ -7,7 +7,11 @@ Two serving-specific failure modes the training stack never sees:
   for *every* request. The ``AdmissionController`` bounds the queue at
   ``SERVE.MAX_QUEUE`` and rejects beyond it with a ``retry_after_ms``
   hint (the HTTP-429/Retry-After shape) so clients back off while
-  in-queue requests keep their latency budget.
+  in-queue requests keep their latency budget. Length-aware engines
+  (the LM plane) additionally cap the queue share long prompts may hold
+  (``SERVE.LONG_MAX_QUEUE``): one burst of chunked 4k prefills
+  backpressures the long class while short decode traffic keeps
+  admitting.
 
 * **Preemption.** TPU serving replicas are preempted exactly like
   training slices — SIGTERM plus a grace window. This reuses the
@@ -42,6 +46,28 @@ class QueueFullError(RuntimeError):
         self.retry_after_ms = retry_after_ms
 
 
+class LongQueueFullError(QueueFullError):
+    """Long-class rejection: the long-prompt reservation
+    (``SERVE.LONG_MAX_QUEUE``) is exhausted while short-class capacity
+    may remain — the client-visible half of decode-batch protection.
+    Subclasses :class:`QueueFullError`, so every service layer that
+    catches the base class keeps the queue_full/retry-after frame shape
+    byte-for-byte; only the message (and ``length_class``) differ."""
+
+    def __init__(self, class_depth: int, long_max_queue: int,
+                 max_queue: int, retry_after_ms: float):
+        RuntimeError.__init__(
+            self,
+            f"serve queue full for long prompts ({class_depth}/"
+            f"{long_max_queue} long-class slots; SERVE.MAX_QUEUE="
+            f"{max_queue}); retry after ~{retry_after_ms:.0f} ms"
+        )
+        self.depth = class_depth
+        self.max_queue = long_max_queue
+        self.retry_after_ms = retry_after_ms
+        self.length_class = "long"
+
+
 class EngineClosedError(RuntimeError):
     """Submitted after drain began — the engine no longer accepts work."""
 
@@ -49,24 +75,57 @@ class EngineClosedError(RuntimeError):
 class AdmissionController:
     """Bounded-queue admission: ``admit`` raises rather than letting the
     pending queue grow past ``max_queue``; ``close`` flips to
-    reject-everything (drain mode)."""
+    reject-everything (drain mode).
 
-    def __init__(self, max_queue: int):
+    ``long_max_queue`` (the long-context plane) additionally caps how
+    many queue slots long-class requests may hold: a long request needs
+    BOTH a free slot and a free long-class slot, while short requests
+    see only the total bound — so at least ``max_queue -
+    long_max_queue`` slots always stay reachable for short traffic."""
+
+    def __init__(self, max_queue: int, long_max_queue: int = 0):
         if max_queue < 1:
             raise ValueError(f"SERVE.MAX_QUEUE must be ≥ 1, got {max_queue}")
+        long_max_queue = int(long_max_queue or 0)
+        if long_max_queue < 0:
+            raise ValueError(
+                f"SERVE.LONG_MAX_QUEUE must be ≥ 0, got {long_max_queue}"
+            )
+        if long_max_queue >= max_queue and long_max_queue:
+            raise ValueError(
+                f"SERVE.LONG_MAX_QUEUE={long_max_queue} must leave "
+                f"short-class headroom below SERVE.MAX_QUEUE={max_queue} "
+                f"({long_max_queue} >= {max_queue}) — lower LONG_MAX_QUEUE "
+                "or raise MAX_QUEUE"
+            )
         self.max_queue = int(max_queue)
+        self.long_max_queue = long_max_queue
         self._open = True
 
     @property
     def is_open(self) -> bool:
         return self._open
 
-    def admit(self, depth: int, retry_after_ms: float) -> None:
-        """Raise unless a request may join a queue currently ``depth`` deep."""
+    def admit(self, depth: int, retry_after_ms: float, *,
+              length_class: str = "short", class_depth: int = 0) -> None:
+        """Raise unless a request may join a queue currently ``depth``
+        deep. Long-class callers (``length_class="long"``) also pass
+        ``class_depth`` — how many queued requests are long — checked
+        against the reservation. The two-positional-arg call is the
+        unchanged image-engine contract."""
         if not self._open:
             raise EngineClosedError("engine is draining; not accepting requests")
         if depth >= self.max_queue:
             raise QueueFullError(depth, self.max_queue, retry_after_ms)
+        if (
+            self.long_max_queue
+            and length_class == "long"
+            and class_depth >= self.long_max_queue
+        ):
+            raise LongQueueFullError(
+                class_depth, self.long_max_queue, self.max_queue,
+                retry_after_ms,
+            )
 
     def close(self) -> None:
         self._open = False

@@ -59,7 +59,12 @@ class MultiModelFleet:
         fl = cfg.SERVE.FLEET
         self.cfg = cfg
         self.out_dir = out_dir or cfg.OUT_DIR
-        self.router = Router(request_timeout_s=fl.REQUEST_TIMEOUT_S)
+        self.router = Router(
+            request_timeout_s=fl.REQUEST_TIMEOUT_S,
+            long_prompt_threshold=cfg.SERVE.LONG_PROMPT_THRESHOLD,
+            short_p99_slo_ms=cfg.SERVE.SHORT_P99_SLO_MS,
+            long_p99_slo_ms=cfg.SERVE.LONG_P99_SLO_MS,
+        )
         self.pools: dict[str, PoolManager] = {}
         self._targets: dict[str, int] = {}
         self._cfg_paths: dict[str, str] = {}

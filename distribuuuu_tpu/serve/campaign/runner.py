@@ -296,7 +296,7 @@ class CampaignRunner:
         control_clean = all(
             not p["raised"] for p in phases if not p["expected"]
         )
-        models = self.router.stats().get("models", {})
+        st = self.router.stats()
         verdict = {
             "campaign": spec.name,
             "seed": spec.seed,
@@ -304,11 +304,18 @@ class CampaignRunner:
             "schedule_hash": sched_hash,
             "requests_scheduled": len(schedule),
             "phases": phases,
-            "models": models,
+            "models": st.get("models", {}),
             "alerts_exact": alerts_exact,
             "control_clean": control_clean,
             "ok": alerts_exact and control_clean,
         }
+        if st.get("length_classes"):
+            # length-aware fleet (ISSUE 19c): the per-class admission and
+            # latency ledger is the artifact's starvation evidence
+            verdict["length_classes"] = st["length_classes"]
+            verdict["long_prompt_threshold"] = st.get(
+                "long_prompt_threshold"
+            )
         spans.emit_event(
             "campaign.verdict",
             campaign=spec.name,

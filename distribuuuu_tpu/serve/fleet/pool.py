@@ -448,7 +448,12 @@ class FleetService:
         fl = cfg.SERVE.FLEET
         self.cfg = cfg
         self.n_initial = int(n_replicas)
-        self.router = Router(request_timeout_s=fl.REQUEST_TIMEOUT_S)
+        self.router = Router(
+            request_timeout_s=fl.REQUEST_TIMEOUT_S,
+            long_prompt_threshold=cfg.SERVE.LONG_PROMPT_THRESHOLD,
+            short_p99_slo_ms=cfg.SERVE.SHORT_P99_SLO_MS,
+            long_p99_slo_ms=cfg.SERVE.LONG_P99_SLO_MS,
+        )
         fleet_dir = os.path.join(out_dir or cfg.OUT_DIR, "fleet")
         self.pool = PoolManager(
             self.router,

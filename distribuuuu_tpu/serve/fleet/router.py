@@ -177,7 +177,10 @@ class Router:
     EWMA_ALPHA = 0.2
 
     def __init__(self, *, request_timeout_s: float = 60.0,
-                 recent_window: int = 4096):
+                 recent_window: int = 4096,
+                 long_prompt_threshold: int = 0,
+                 short_p99_slo_ms: float | None = None,
+                 long_p99_slo_ms: float | None = None):
         self._replicas: dict[int, Replica] = {}
         self._lock = threading.Lock()
         self._rr = 0
@@ -194,6 +197,18 @@ class Router:
         # fleets — bare (non-enveloped) payloads never consult either.
         self._models: dict[str, dict] = {}
         self._mstats: dict[str, dict] = {}
+        # length-aware routing stats (the long-context plane): generate
+        # ctrl frames with >= long_prompt_threshold prompt tokens are the
+        # "long" class; per-class windowed latencies surface next to the
+        # per-model SLO rows (window_stats "length:short"/"length:long")
+        # so the slo-breach rule referees short-class p99 against long-
+        # prompt interference unchanged. 0 disables classification.
+        self.long_prompt_threshold = int(long_prompt_threshold)
+        self._lslo = {
+            "short": float(short_p99_slo_ms) if short_p99_slo_ms else None,
+            "long": float(long_p99_slo_ms) if long_p99_slo_ms else None,
+        }
+        self._lstats: dict[str, dict] = {}
 
     # -- model registry (multi-model fleets) -------------------------------
     @staticmethod
@@ -280,6 +295,33 @@ class Router:
                 if r.routable and not r.draining
             )
 
+    # -- length classes (long-context serving) -----------------------------
+    @staticmethod
+    def _fresh_lstat() -> dict:
+        return {"requests": 0, "rejected": 0, "recent": []}
+
+    def _classify_payload(self, payload: bytes) -> str | None:
+        """"short" / "long" for a generate ctrl frame when length
+        classification is on (by prompt token count — "text" prompts
+        count utf-8 bytes, the byte tokenizer's 1:1 identity); None for
+        everything else. The router classifies from the frame alone, so
+        per-class accounting needs no replica cooperation."""
+        if not self.long_prompt_threshold:
+            return None
+        if not payload.startswith(protocol.CTRL_MAGIC[:1]):
+            return None
+        try:
+            ctrl = protocol.parse_ctrl(payload)
+        except (ValueError, UnicodeDecodeError):
+            return None
+        if not ctrl or ctrl.get("op") != "generate":
+            return None
+        if "tokens" in ctrl:
+            n = len(ctrl["tokens"])
+        else:
+            n = len(str(ctrl.get("text", "")).encode("utf-8"))
+        return "long" if n >= self.long_prompt_threshold else "short"
+
     # -- dispatch ----------------------------------------------------------
     def _pick(self, exclude: set[int],
               model: str | None = None) -> Replica | None:
@@ -306,7 +348,8 @@ class Router:
         self.registry.counter("fleet.replica_failures").inc(1)
 
     def _observe(self, rep: Replica, lat_s: float,
-                 model: str | None = None) -> None:
+                 model: str | None = None,
+                 length_class: str | None = None) -> None:
         now = time.perf_counter()
         with self._lock:
             rep.requests += 1
@@ -324,6 +367,14 @@ class Router:
                 ms["recent"].append((now, lat_s))
                 if len(ms["recent"]) > self._recent_cap:
                     del ms["recent"][: self._recent_cap // 4]
+            if length_class:
+                ls = self._lstats.setdefault(
+                    length_class, self._fresh_lstat()
+                )
+                ls["requests"] += 1
+                ls["recent"].append((now, lat_s))
+                if len(ls["recent"]) > self._recent_cap:
+                    del ls["recent"][: self._recent_cap // 4]
         self._lat.observe(lat_s)
         self.registry.histogram(f"fleet.replica{rep.id}.latency_s").observe(
             lat_s
@@ -370,12 +421,17 @@ class Router:
             self._observe(rep, time.perf_counter() - t0, model=model)
             return resp, last_busy
 
-    def _count_rejected(self, model: str | None) -> None:
+    def _count_rejected(self, model: str | None,
+                        length_class: str | None = None) -> None:
         self.registry.counter("fleet.rejected").inc(1)
-        if model:
-            with self._lock:
+        with self._lock:
+            if model:
                 self._mstats.setdefault(
                     model, self._fresh_mstat()
+                )["rejected"] += 1
+            if length_class:
+                self._lstats.setdefault(
+                    length_class, self._fresh_lstat()
                 )["rejected"] += 1
 
     def dispatch(self, payload: bytes) -> bytes:
@@ -466,6 +522,7 @@ class Router:
                 "models": self.registered_models(),
             }).encode())
             return
+        length_class = self._classify_payload(payload)
         tried: set[int] = set()
         last_busy: bytes | None = None
         while True:
@@ -509,7 +566,8 @@ class Router:
                         # "done", and an after-the-send increment races
                         # anything that checks the counters then
                         self._observe(
-                            rep, time.perf_counter() - t0, model=model
+                            rep, time.perf_counter() - t0, model=model,
+                            length_class=length_class,
                         )
                         self.registry.counter("fleet.streams").inc(1)
                     protocol.send_frame(client, frame)
@@ -542,7 +600,7 @@ class Router:
                 if conn is not None:
                     conn.close()
         if last_busy is not None:
-            self._count_rejected(model)
+            self._count_rejected(model, length_class=length_class)
             protocol.send_frame(client, last_busy)
             return
         self.registry.counter("fleet.unroutable").inc(1)
@@ -614,6 +672,19 @@ class Router:
                     "p99_ms": round(percentile(mlats, 0.99) * 1e3, 3),
                     "target_ms": mrec.get("p99_slo_ms"),
                 }
+            # length classes ride the same models dict as "length:short"
+            # / "length:long" rows (same {samples, p99_ms, target_ms}
+            # shape), so the slo-breach rule — which scans serve.models
+            # for targeted rows — referees per-class p99 unchanged
+            for name, ls in self._lstats.items():
+                llats = sorted(
+                    lat for (t, lat) in ls["recent"] if t >= cut
+                )
+                models[f"length:{name}"] = {
+                    "samples": len(llats),
+                    "p99_ms": round(percentile(llats, 0.99) * 1e3, 3),
+                    "target_ms": self._lslo.get(name),
+                }
         out = {
             "samples": len(lats),
             "p50_ms": round(percentile(lats, 0.50) * 1e3, 3),
@@ -673,6 +744,20 @@ class Router:
                     "degraded_in": ms["degraded_in"],
                     "p99_ms": round(percentile(mlats, 0.99) * 1e3, 3),
                 }
+        with self._lock:
+            length_classes = {
+                name: {
+                    "p99_slo_ms": self._lslo.get(name),
+                    "requests": ls["requests"],
+                    "rejected": ls["rejected"],
+                    "p99_ms": round(
+                        percentile(
+                            [lat for (_t, lat) in ls["recent"]], 0.99
+                        ) * 1e3, 3,
+                    ),
+                }
+                for name, ls in sorted(self._lstats.items())
+            }
         window = max(time.perf_counter() - self._t0, 1e-9)
         out = {
             "replicas": len(reps),
@@ -694,17 +779,24 @@ class Router:
         }
         if models:
             out["models"] = models
+        if length_classes:
+            out["length_classes"] = length_classes
+            out["long_prompt_threshold"] = self.long_prompt_threshold
         return out
 
     def emit_telemetry(self) -> None:
-        """One ``fleet.stats`` + one ``fleet.replica`` per replica (plus one
-        ``fleet.model_route`` per registered model on multi-model fleets)
-        into the per-rank telemetry sink (no-op until setup_telemetry ran)."""
+        """One ``fleet.stats`` + one ``fleet.replica`` per replica (plus
+        one ``fleet.model_route`` per registered model on multi-model
+        fleets, and one ``fleet.length_class`` per observed length class
+        on length-aware fleets) into the per-rank telemetry sink (no-op
+        until setup_telemetry ran)."""
         from distribuuuu_tpu.telemetry import spans
 
         snap = self.stats()
         per_replica = snap.pop("per_replica")
         models = snap.pop("models", {})
+        length_classes = snap.pop("length_classes", {})
+        snap.pop("long_prompt_threshold", None)
         spans.emit_event("fleet.stats", **snap)
         for p in per_replica:
             spans.emit_event("fleet.replica", **p)
@@ -717,6 +809,15 @@ class Router:
                 degraded_in=m["degraded_in"],
                 degraded_out=m["degraded_out"],
                 p99_ms=m["p99_ms"],
+            )
+        for name, lc in length_classes.items():
+            spans.emit_event(
+                "fleet.length_class",
+                length_class=name,
+                threshold=self.long_prompt_threshold,
+                requests=lc["requests"],
+                rejected=lc["rejected"],
+                p99_ms=lc["p99_ms"],
             )
 
     # -- the client-facing accept loop ------------------------------------

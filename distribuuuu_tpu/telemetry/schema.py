@@ -114,6 +114,13 @@ KINDS: dict[str, frozenset] = {
     "gen.admit": frozenset({"slot", "prompt_tokens", "request"}),
     # one per prompt prefill (the compute-bound half)
     "gen.prefill": frozenset({"tokens", "tile", "ms"}),
+    # one per CHUNKED prompt prefill (ISSUE 19): the prompt streamed into
+    # the paged cache in `chunks` fixed `chunk`-token appends against a
+    # `tile`-wide page — the long-context admission path (run_report's
+    # chunked-prefill ms source)
+    "gen.chunk_prefill": frozenset(
+        {"tokens", "chunk", "chunks", "tile", "ms"}
+    ),
     # one per decode step over the live (batch, cache-len) tile (the
     # memory-bound half — run_report's decode p50/p99 source)
     "gen.decode": frozenset({"active", "tile_b", "tile_c", "ms"}),
@@ -170,6 +177,13 @@ KINDS: dict[str, frozenset] = {
     "fleet.model_route": frozenset(
         {"model", "requests", "rejected", "degraded_in", "degraded_out",
          "p99_ms"}
+    ),
+    # per-length-class routing stats on a length-aware fleet (ISSUE 19):
+    # one row per observed class ("short" / "long" by the router's
+    # SERVE.LONG_PROMPT_THRESHOLD token split) — run_report's evidence
+    # that long-prompt admission backpressured while short-class p99 held
+    "fleet.length_class": frozenset(
+        {"length_class", "threshold", "requests", "rejected", "p99_ms"}
     ),
     # one per quantized engine start: the weight repack's footprint
     "serve.quantized": frozenset(

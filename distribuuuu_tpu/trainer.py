@@ -161,14 +161,43 @@ def build_model_from_cfg(topology=None):
         # Same MoE knob plumbing as the ViT family; the partition layer
         # places everything from the LM spec-table rules + annotations.
         kwargs["seq_len"] = int(cfg.LM.SEQ_LEN)
-        if cfg.DEVICE.ATTN_IMPL in ("flash", "blockwise"):
+        if topology.seq > 1:
+            # sequence-sharded causal LM (ISSUE 19): causal ring attention
+            # over the seq axis — the exact ViT wiring (the blocks are
+            # shared modules), with the token dim of every batch leaf
+            # declared over ``seq`` (specs.TOKEN_BATCH_TABLE). The ring
+            # shard_map splits the token dim into EQUAL blocks; an uneven
+            # dim would silently rest replicated on this jax line, so the
+            # divisibility refusals carry the arithmetic.
+            if int(cfg.LM.SEQ_LEN) % topology.seq:
+                raise ValueError(
+                    f"MESH.SEQ={topology.seq} does not divide LM.SEQ_LEN="
+                    f"{int(cfg.LM.SEQ_LEN)} ({int(cfg.LM.SEQ_LEN)} % "
+                    f"{topology.seq} = "
+                    f"{int(cfg.LM.SEQ_LEN) % topology.seq}) — the causal "
+                    "ring rotates equal K/V blocks per seq rank; use an "
+                    "LM.SEQ_LEN that is a multiple of MESH.SEQ (e.g. "
+                    f"{-(-int(cfg.LM.SEQ_LEN) // topology.seq) * topology.seq}"
+                    ") or a smaller seq axis"
+                )
+            impl = (
+                "ulysses" if cfg.DEVICE.ATTN_IMPL == "ulysses" else "ring"
+            )
+            kwargs["attn_impl"] = impl
+            kwargs["mesh"] = mesh_lib.mesh_from_cfg(cfg)
+        elif cfg.DEVICE.ATTN_IMPL in ("flash", "blockwise"):
             kwargs["attn_impl"] = cfg.DEVICE.ATTN_IMPL
+        elif cfg.DEVICE.ATTN_IMPL in ("ring", "ulysses"):
+            raise ValueError(
+                f"DEVICE.ATTN_IMPL={cfg.DEVICE.ATTN_IMPL!r} needs a "
+                "sequence-sharded mesh: set MESH.SEQ > 1"
+            )
         elif cfg.DEVICE.ATTN_IMPL not in ("auto", "xla"):
             raise ValueError(
                 f"DEVICE.ATTN_IMPL={cfg.DEVICE.ATTN_IMPL!r}: gpt archs "
-                "accept 'auto'/'xla' (dense causal), 'flash', or "
-                "'blockwise' — sequence-sharded ring attention for the LM "
-                "is future work (MESH.SEQ must stay 1)"
+                "accept 'auto'/'xla' (dense causal), 'flash', "
+                "'blockwise', or MESH.SEQ>1 for ring/ulysses "
+                "sequence-sharded attention"
             )
         if cfg.MODEL.ARCH.endswith("_moe"):
             kwargs["moe_experts"] = cfg.MODEL.MOE.NUM_EXPERTS
@@ -223,7 +252,22 @@ def build_model_from_cfg(topology=None):
             kwargs["moe_axis"] = topology.moe_axis()
             if topology.expert > 1 or topology.model > 1:
                 kwargs["mesh"] = mesh_lib.mesh_from_cfg(cfg)
-    return models.build_model(cfg.MODEL.ARCH, **kwargs)
+    model = models.build_model(cfg.MODEL.ARCH, **kwargs)
+    if (
+        topology.seq > 1
+        and kwargs.get("attn_impl") == "ulysses"
+        and int(getattr(model, "num_heads", 0)) % topology.seq
+    ):
+        heads = int(model.num_heads)
+        raise ValueError(
+            f"MESH.SEQ={topology.seq} does not divide num_heads={heads} "
+            f"({heads} % {topology.seq} = {heads % topology.seq}) for "
+            "DEVICE.ATTN_IMPL='ulysses' — the all-to-all re-shards "
+            "sequence to heads, so each seq rank needs an equal head "
+            "slice; use ring attention (the sp default) or an arch whose "
+            "head count MESH.SEQ divides"
+        )
+    return model
 
 
 def create_train_state(model, key, mesh, im_size: int, layout=None) -> TrainState:

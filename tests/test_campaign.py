@@ -178,6 +178,79 @@ def test_committed_artifact_schedule_hashes_reproduce():
         assert c["ok"], f"committed campaign {c['campaign']} is red"
 
 
+def test_long_context_campaign_spec_and_payload_bank():
+    """The long-context scenario (ISSUE 19c) stays coherent end to end:
+    the shipped YAML arms backpressure + slo-breach and expects the
+    surge (and ONLY the surge) to backpressure; the harness cfg keeps
+    the reservation below the queue and the chunk aligned to the paged
+    cache; and every heavy-tail bank prompt fits the chunked admission
+    bound while both length classes stay represented."""
+    import sys
+
+    spec = load_campaign(os.path.join(CAMPAIGN_DIR, "long_context.yaml"))
+    assert spec.name == "long_context"
+    assert {r["kind"] for r in spec.rules} == {"backpressure", "slo-breach"}
+    expects = {p.name: set(p.expect) for p in spec.phases}
+    assert expects == {"control": set(), "long_surge": {"backpressure"},
+                       "drain": set()}
+    # the model row carries NO target: only the router's per-length-class
+    # rows vote in the slo-breach rule (shorts-held-their-SLO evidence)
+    assert spec.models[0]["p99_slo_ms"] is None
+    assert schedule_hash(build_schedule(spec)) == schedule_hash(
+        build_schedule(spec)
+    )
+
+    sys.path.insert(0, os.path.join(ROOT, "tools"))
+    try:
+        import serve_campaign
+
+        import distribuuuu_tpu.config as config
+        try:
+            cfg = serve_campaign.long_context_cfg("/tmp/lc_cfg_probe")
+            threshold = cfg.SERVE.LONG_PROMPT_THRESHOLD
+            assert threshold >= 1
+            assert 0 < cfg.SERVE.LONG_MAX_QUEUE < cfg.SERVE.MAX_QUEUE
+            assert cfg.GENERATE.CACHE_TILES[-1] % cfg.GENERATE.CHUNK_PREFILL == 0
+            cache_cap = cfg.GENERATE.CACHE_TILES[-1]
+            max_new_cap = cfg.GENERATE.MAX_NEW_TOKENS
+        finally:
+            config.reset_cfg()
+        bank = serve_campaign.lm_long_payload_bank()
+        assert bank == serve_campaign.lm_long_payload_bank()  # deterministic
+        classes = set()
+        for frame in bank:
+            ctrl = protocol.parse_ctrl(frame)
+            assert ctrl["op"] == "generate"
+            plen = len(ctrl["tokens"])
+            # the chunked paged-prefill admission bound: the whole
+            # stream (prompt + budget) fits the largest cache tile
+            assert plen + min(ctrl["max_new_tokens"], max_new_cap) <= cache_cap
+            classes.add("long" if plen >= threshold else "short")
+        assert classes == {"short", "long"}  # heavy tail drew both
+    finally:
+        sys.path.remove(os.path.join(ROOT, "tools"))
+
+
+def test_committed_long_context_artifact_has_starvation_evidence():
+    """Against the REAL archived run: the long class bounced off the
+    admission reservation (its rejections are the backpressure evidence)
+    while the short class held its windowed p99 SLO."""
+    artifacts = sorted(glob.glob(os.path.join(ROOT, "SERVE_CAMPAIGN_r*.json")))
+    if not artifacts:
+        pytest.skip("no committed SERVE_CAMPAIGN artifact yet")
+    doc = json.load(open(artifacts[-1]))
+    lc = next((c for c in doc["campaigns"]
+               if c["campaign"] == "long_context"), None)
+    if lc is None:
+        pytest.skip("latest artifact predates the long_context campaign")
+    assert lc["ok"] and lc["control_clean"]
+    classes = lc["length_classes"]
+    assert lc["long_prompt_threshold"] >= 1
+    assert classes["long"]["rejected"] > 0  # longs hit the reservation
+    assert classes["short"]["requests"] > 0
+    assert classes["short"]["p99_ms"] < classes["short"]["p99_slo_ms"]
+
+
 # -- model envelope ----------------------------------------------------------
 
 def test_model_envelope_roundtrip_and_bare_passthrough():

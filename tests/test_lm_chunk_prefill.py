@@ -1,0 +1,238 @@
+"""Chunked paged prefill (ISSUE 19, the long-context serving tentpole):
+a prompt streams into its KV page in fixed CHUNK_PREFILL-token
+prefill-shaped calls — pinned logit-identical (float tol) to
+whole-prompt prefill, greedy-token-identical on the continuation
+(including composed with speculative decoding), admitting prompts past
+GENERATE.PROMPT_LEN with zero steady-state recompiles, and refusing
+mis-sized chunks with the arithmetic in-message."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from distribuuuu_tpu.config import cfg
+from distribuuuu_tpu.lm import generate as G
+
+
+def _tiny_gpt(seq_len=32, vocab=320, dtype=jnp.float32, **kw):
+    from distribuuuu_tpu.models.gpt import GPT
+
+    return GPT(
+        vocab_size=vocab, seq_len=seq_len, dim=32, depth=2, num_heads=2,
+        dtype=dtype, **kw,
+    )
+
+
+def _params(model, key=0):
+    return model.init(
+        jax.random.key(key), model.dummy_input(), train=False
+    )["params"]
+
+
+def _engine(model, params, **kw):
+    kw.setdefault("prompt_len", 8)
+    kw.setdefault("max_new_tokens", 6)
+    kw.setdefault("batch_tiles", [2])
+    kw.setdefault("cache_tiles", [16])
+    return G.GenerateEngine(model, {"params": params}, **kw)
+
+
+@pytest.fixture()
+def f32(monkeypatch):
+    cfg.DEVICE.COMPUTE_DTYPE = "float32"
+    yield
+
+
+def test_chunk_page_logits_match_whole_prompt_prefill(f32):
+    """THE pin: building a page chunk by chunk yields the same per-
+    position logits (float tol) as the one whole-prompt prefill call —
+    the chunk math IS the prefill math, re-windowed."""
+    model = _tiny_gpt(seq_len=32)
+    params = _params(model)
+    whole = _engine(model, params, cache_tiles=[32], prompt_len=8)
+    chunked = _engine(model, params, cache_tiles=[32], prompt_len=8,
+                      chunk_prefill=4)
+    rng = np.random.default_rng(11)
+    prompt = rng.integers(0, 256, (6,)).astype(np.int32)
+    # whole-prompt reference logits over the prompt positions
+    padded = np.zeros((1, 8), np.int32)
+    padded[0, :6] = prompt
+    ref, _ = whole._prefill_exec[8](whole._variables, jnp.asarray(padded))
+    ref = np.asarray(ref)[0, :6]
+    # chunk stream: 2 calls of width 4 into a 32-wide page
+    W, plen = 4, 6
+    page = chunked._zero_cache(1, 32)
+    rows = []
+    for k in range(-(-plen // W)):
+        seg = prompt[k * W:(k + 1) * W]
+        chunk = np.zeros((1, W), np.int32)
+        chunk[0, :len(seg)] = seg
+        logits, page = chunked._chunk_exec[32](
+            chunked._variables, jnp.asarray(chunk),
+            jnp.full((1,), k * W, jnp.int32), page,
+        )
+        rows.append(np.asarray(logits)[0])
+    got = np.concatenate(rows)[:plen]
+    np.testing.assert_allclose(got, ref, atol=1e-4)
+    whole.drain()
+    chunked.drain()
+
+
+def test_chunked_stream_greedy_identical_and_no_recompiles(f32):
+    """Ragged prompt lengths through the chunked engine produce EXACTLY
+    the whole-prompt engine's greedy streams, and n_compiles stays at
+    its startup value — steady state never recompiles."""
+    model = _tiny_gpt(seq_len=32)
+    params = _params(model)
+    whole = _engine(model, params, batch_tiles=[1, 2], cache_tiles=[16, 32],
+                    prompt_len=8, max_new_tokens=6).start()
+    chunked = _engine(model, params, batch_tiles=[1, 2],
+                      cache_tiles=[16, 32], prompt_len=8, max_new_tokens=6,
+                      chunk_prefill=4).start()
+    n0 = chunked.n_compiles
+    rng = np.random.default_rng(12)
+    prompts = [
+        rng.integers(0, 256, (n,)).astype(np.int32)
+        for n in (1, 3, 4, 5, 7, 8)  # ragged, multiple, sub-chunk
+    ]
+    for p in prompts:
+        a = whole.submit(p).result(timeout=120.0)
+        b = chunked.submit(p).result(timeout=120.0)
+        assert a == b, (len(p), a, b)
+    st = chunked.stats()
+    assert chunked.n_compiles == n0
+    assert st["chunk_prefill"] == 4
+    assert st["chunk_prefills"] == len(prompts)
+    whole.drain()
+    chunked.drain()
+
+
+def test_chunked_prefill_admits_past_prompt_len(f32):
+    """The point of chunking: a prompt longer than GENERATE.PROMPT_LEN —
+    which the whole-prompt engine refuses — admits through the chunk
+    stream and continues greedy-identical to the teacher-forced
+    reference."""
+    model = _tiny_gpt(seq_len=32)
+    params = _params(model)
+    whole = _engine(model, params, cache_tiles=[32], prompt_len=8,
+                    max_new_tokens=4)
+    rng = np.random.default_rng(13)
+    long_prompt = rng.integers(0, 256, (20,)).astype(np.int32)
+    with pytest.raises(ValueError, match="exceeds\\s+GENERATE.PROMPT_LEN=8"):
+        whole.submit(long_prompt)
+    whole.drain()
+    chunked = _engine(model, params, cache_tiles=[32], prompt_len=8,
+                      max_new_tokens=4, chunk_prefill=8).start()
+    out = chunked.submit(long_prompt, max_new_tokens=4).result(timeout=120.0)
+    assert len(out) == 4
+    seq = list(long_prompt)
+    for tok in out:
+        lg = model.apply({"params": params},
+                         jnp.asarray(np.asarray(seq)[None]), train=False)
+        assert tok == int(np.asarray(lg)[0, -1].argmax())
+        seq.append(tok)
+    chunked.drain()
+
+
+def test_chunked_prefill_composes_with_speculative_decode(f32):
+    """Chunk-admitted requests speculate off a fully-mirrored draft page:
+    the emitted greedy stream equals plain target-only decode, for short
+    AND past-PROMPT_LEN prompts."""
+    target = _tiny_gpt(seq_len=32)
+    tparams = _params(target, key=0)
+    draft = _tiny_gpt(seq_len=32)
+    dparams = _params(draft, key=1)
+    plain = _engine(target, tparams, batch_tiles=[1], cache_tiles=[32],
+                    prompt_len=24, max_new_tokens=5).start()
+    spec = _engine(target, tparams, batch_tiles=[1], cache_tiles=[32],
+                   prompt_len=8, max_new_tokens=5, chunk_prefill=4,
+                   draft_model=draft,
+                   draft_variables={"params": dparams}, spec_k=2).start()
+    rng = np.random.default_rng(14)
+    for n in (3, 6, 11):
+        p = rng.integers(0, 256, (n,)).astype(np.int32)
+        assert plain.submit(p).result(timeout=120.0) == \
+            spec.submit(p).result(timeout=120.0), n
+    st = spec.stats()
+    assert st["spec_rounds"] > 0 and st["chunk_prefills"] == 3
+    plain.drain()
+    spec.drain()
+
+
+def test_chunk_prefill_validation_arithmetic(f32):
+    """The refusal suite: every mis-configuration names its numbers."""
+    model = _tiny_gpt(seq_len=64)
+    params = _params(model)
+    # chunk does not divide a page-capable tile — quotient in-message
+    with pytest.raises(ValueError, match=r"16 % 5 = 1"):
+        G.validate_chunk_prefill_cfg(5, [16, 32])
+    # chunk larger than every tile
+    with pytest.raises(ValueError, match="exceeds the largest"):
+        G.validate_chunk_prefill_cfg(64, [16, 32])
+    with pytest.raises(ValueError, match=">= 1"):
+        G.validate_chunk_prefill_cfg(0, [16])
+    # engine-level: the same refusal fires at build
+    with pytest.raises(ValueError, match=r"24 % 16 = 8"):
+        _engine(model, params, cache_tiles=[24], prompt_len=8,
+                max_new_tokens=4, chunk_prefill=16)
+    # submit bound carries the sum: plen + max_new > largest tile
+    eng = _engine(model, params, cache_tiles=[16], prompt_len=8,
+                  max_new_tokens=6, chunk_prefill=4)
+    with pytest.raises(ValueError, match=r"11 \+ max_new=6 > largest"):
+        eng.submit(np.arange(11, dtype=np.int32))
+    eng.drain()
+
+
+def test_chunk_prefill_telemetry_kind(f32, tmp_path):
+    """gen.chunk_prefill records land schema-valid in the span sink
+    (satellite: telemetry/schema.py declares the kind)."""
+    import glob
+    import json
+
+    from distribuuuu_tpu import telemetry
+    from distribuuuu_tpu.telemetry import schema
+
+    cfg.OUT_DIR = str(tmp_path)
+    telemetry.setup_from_cfg(cfg, rank=0)
+    try:
+        model = _tiny_gpt(seq_len=32)
+        params = _params(model)
+        eng = _engine(model, params, cache_tiles=[32], prompt_len=8,
+                      max_new_tokens=3, chunk_prefill=4).start()
+        eng.submit(np.arange(10, dtype=np.int32)).result(timeout=120.0)
+        eng.drain()
+    finally:
+        from distribuuuu_tpu.telemetry import spans
+
+        spans.close_telemetry()
+    recs = []
+    for p in glob.glob(str(tmp_path / "telemetry" / "rank*.jsonl")):
+        with open(p) as f:
+            recs.extend(json.loads(line) for line in f)
+    chunk_recs = [r for r in recs if r.get("kind") == "gen.chunk_prefill"]
+    assert len(chunk_recs) == 1
+    assert chunk_recs[0]["tokens"] == 10
+    assert chunk_recs[0]["chunk"] == 4 and chunk_recs[0]["chunks"] == 3
+    assert not any(r.get("kind") == "gen.prefill" for r in recs)
+    for r in recs:
+        schema.validate_record(r)
+    # run_report surfacing (satellite): the lm section carries the
+    # chunked-prefill line and the per-class admission mix
+    import os
+    import sys
+
+    tools = os.path.join(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))), "tools")
+    sys.path.insert(0, tools)
+    try:
+        import run_report
+
+        rep = run_report.build_report(str(tmp_path))
+    finally:
+        sys.path.remove(tools)
+    lm = rep["lm"]
+    assert lm["chunk_prefill"]["prompts"] == 1
+    assert lm["chunk_prefill"]["chunk_calls"] == 3
+    assert lm["chunk_prefill"]["p50_ms"] > 0
+    assert lm["admit_length_classes"] == {"short": 1}

@@ -169,6 +169,110 @@ def test_gpt_yaml_stanza_trains_end_to_end(tmp_path):
                            axis_sizes)
 
 
+def _token_batch(step: int, seq_len: int, n: int = 8):
+    """Deterministic synthetic token batch (loader-shaped: input tokens as
+    ``image``, next tokens as ``label``, per-sequence ``mask``)."""
+    import numpy as np
+
+    rng = np.random.default_rng(7_000 + step)
+    toks = rng.integers(0, 320, (n, seq_len + 1)).astype(np.int32)
+    return {
+        "image": toks[:, :-1],
+        "label": toks[:, 1:],
+        "mask": np.ones((n,), np.float32),
+    }
+
+
+def test_gpt_sp_yaml_stanza_trains_end_to_end():
+    """ISSUE 19 acceptance: the LM trains from config/gpt_nano_sp.yaml's
+    dp2·sp4 MESH stanza — causal ring attention over the seq axis, token
+    batches arriving (data, seq)-sharded per TOKEN_BATCH_TABLE — with
+    zero declared-vs-compiled sharding drift, the loss trajectory in
+    lockstep with the seq-UNSHARDED reference (same data, same init, dp
+    only), and the compiled step's seq-axis collective-permute census
+    inside the declared ring band (a missing hop = local-only attention =
+    wrong math; this asserts the band the analyzer referees)."""
+    import numpy as np
+
+    from distribuuuu_tpu.analysis import hlo
+    from distribuuuu_tpu.parallel.partition import lowering
+    from distribuuuu_tpu.utils.optim import construct_optimizer
+
+    S = 16
+
+    def _train(path, expect_class):
+        config.reset_cfg()
+        cfg.merge_from_file(os.path.join(CONFIG_DIR, path))
+        cfg.LM.SEQ_LEN = S
+        cfg.DEVICE.COMPUTE_DTYPE = "float32"
+        topo = trainer.check_trainer_mesh()
+        assert topo.class_name() == expect_class
+        mesh = mesh_lib.mesh_from_cfg(cfg)
+        model = trainer.build_model_from_cfg(topo)
+        low = lowering.lower(
+            model, construct_optimizer(), topk=5, mesh=mesh, topology=topo,
+            im_size=cfg.TRAIN.IM_SIZE,
+        )
+        state = low.init_state(jax.random.key(0), cfg.TRAIN.IM_SIZE)
+        losses = []
+        gb = None
+        for it in range(3):
+            gb = low.put_batch(_token_batch(it, S))
+            state, m = low.train_step(state, gb)
+            losses.append(float(m["loss"]))
+        return topo, mesh, model, low, state, losses, gb
+
+    topo, mesh, model, low, state, losses, gbatch = _train(
+        "gpt_nano_sp.yaml", "dp2·sp4"
+    )
+    assert model.attn_impl == "ring" and model.mesh is not None
+    assert np.isfinite(losses).all()
+    # declared vs compiled shardings — the gate's teeth, on the sp stanza
+    _assert_no_spec_drift(state, low.layout, mesh)
+    # the token batch really lands (data, seq)-sharded; the rank-1 mask
+    # stays on data alone (one shared spec could not express both)
+    axis_sizes = {k: int(v) for k, v in dict(mesh.shape).items()}
+    P = jax.sharding.PartitionSpec
+    assert specs.canonicalize(gbatch["image"].sharding.spec, axis_sizes) \
+        == specs.canonicalize(P("data", "seq"), axis_sizes)
+    assert specs.canonicalize(gbatch["mask"].sharding.spec, axis_sizes) \
+        == specs.canonicalize(P("data"), axis_sizes)
+
+    # ring census: seq-axis collective-permutes of the COMPILED step stay
+    # inside the declared band (specs.collective_expectations "ring")
+    ring = specs.collective_expectations(low.layout, topo)["ring"]
+    assert ring is not None and ring["attn_layers"] == 4  # gpt_nano depth
+    text = low.train_step.lower(state, gbatch).compile().as_text()
+    n_seq = sum(
+        1 for op in hlo.collective_census(text, mesh)
+        if op["kind"] == "collective-permute" and op["axes"] == ("seq",)
+    )
+    assert ring["min_permutes"] <= n_seq <= ring["max_permutes"], (
+        n_seq, ring
+    )
+
+    # lockstep vs the seq-unsharded reference: same init key, same data,
+    # dp-only mesh — early-window exactness + same family on step 3
+    _, _, _, _, _, ref_losses, _ = _train("gpt_nano.yaml", "dp8")
+    np.testing.assert_allclose(losses[:2], ref_losses[:2], rtol=0, atol=2e-2)
+    assert abs(losses[2] - ref_losses[2]) < 0.5, (losses, ref_losses)
+    config.reset_cfg()
+
+
+def test_gpt_sp_refuses_indivisible_seq_len():
+    """The sp-stanza refusal carries the arithmetic: a SEQ_LEN the seq
+    axis does not divide refuses at build, not as silent replication."""
+    config.reset_cfg()
+    cfg.MODEL.ARCH = "gpt_nano"
+    cfg.MODEL.NUM_CLASSES = 320
+    cfg.MESH.DATA, cfg.MESH.SEQ = 2, 4
+    cfg.LM.SEQ_LEN = 18  # 18 % 4 = 2
+    topo = topology.from_cfg(cfg, n_devices=8)
+    with pytest.raises(ValueError, match=r"18 % 4 = 2"):
+        trainer.build_model_from_cfg(topo)
+    config.reset_cfg()
+
+
 @pytest.mark.parametrize(
     "arch,stanza",
     [
@@ -176,8 +280,10 @@ def test_gpt_yaml_stanza_trains_end_to_end(tmp_path):
         ("resnet18", {"DATA": 4, "MODEL": 2, "ZERO": 1}),
         ("vit_tiny_moe", {"DATA": 2, "MODEL": 2, "EXPERT": 2, "ZERO": 1}),
         ("gpt_nano_moe", {"DATA": 2, "MODEL": 2, "EXPERT": 2, "ZERO": 1}),
+        ("gpt_nano", {"DATA": 2, "SEQ": 4}),
     ],
-    ids=["dp_zero1", "dp_tp_zero1", "dp_tp_ep_zero1", "lm_dp_tp_ep_zero1"],
+    ids=["dp_zero1", "dp_tp_zero1", "dp_tp_ep_zero1", "lm_dp_tp_ep_zero1",
+         "lm_dp_sp"],
 )
 def test_no_drift_between_declared_and_compiled_shardings(arch, stanza):
     """The gate's teeth: place real state through create_train_state and

@@ -1,0 +1,295 @@
+"""Length-aware serving (ISSUE 19): the long-prompt admission
+reservation (one burst of chunked 4k prefills cannot starve the decode
+batch), the verbatim queue_full frame shape, and the router's per-class
+routing stats that let the slo-breach rule referee short-class p99
+against long-prompt interference."""
+
+import json
+import threading
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from distribuuuu_tpu.config import cfg
+from distribuuuu_tpu.lm import generate as G
+from distribuuuu_tpu.serve.admission import (
+    AdmissionController,
+    LongQueueFullError,
+    QueueFullError,
+)
+
+
+def _tiny_gpt(seq_len=32, vocab=320, dtype=jnp.float32):
+    from distribuuuu_tpu.models.gpt import GPT
+
+    return GPT(
+        vocab_size=vocab, seq_len=seq_len, dim=32, depth=2, num_heads=2,
+        dtype=dtype,
+    )
+
+
+def _params(model, key=0):
+    return model.init(
+        jax.random.key(key), model.dummy_input(), train=False
+    )["params"]
+
+
+@pytest.fixture()
+def f32(monkeypatch):
+    cfg.DEVICE.COMPUTE_DTYPE = "float32"
+    yield
+
+
+def _long_engine(model, params, **kw):
+    kw.setdefault("prompt_len", 8)
+    kw.setdefault("max_new_tokens", 3)
+    kw.setdefault("batch_tiles", [2])
+    kw.setdefault("cache_tiles", [32])
+    kw.setdefault("chunk_prefill", 4)
+    return G.GenerateEngine(model, {"params": params}, **kw)
+
+
+# ------------------------------------------------- admission reservation
+
+def test_admission_long_reservation_unit():
+    """Long requests need BOTH a free slot and a free long-class slot;
+    short requests see only the total bound."""
+    ctrl = AdmissionController(4, long_max_queue=2)
+    ctrl.admit(3, 100.0)  # short at depth 3 of 4: fine
+    ctrl.admit(1, 100.0, length_class="long", class_depth=1)
+    with pytest.raises(LongQueueFullError,
+                       match=r"2/2 long-class slots; SERVE\.MAX_QUEUE=4"):
+        ctrl.admit(2, 100.0, length_class="long", class_depth=2)
+    # the long rejection IS a QueueFullError — service layers that catch
+    # the base class keep the retry-after frame shape byte-for-byte
+    try:
+        ctrl.admit(2, 123.0, length_class="long", class_depth=2)
+    except QueueFullError as e:
+        assert e.retry_after_ms == 123.0 and e.length_class == "long"
+    # total bound still wins for every class
+    with pytest.raises(QueueFullError):
+        ctrl.admit(4, 100.0)
+    with pytest.raises(QueueFullError):
+        ctrl.admit(4, 100.0, length_class="long", class_depth=0)
+
+
+def test_admission_reservation_validation_arithmetic():
+    with pytest.raises(ValueError, match=r"4 >= 4"):
+        AdmissionController(4, long_max_queue=4)
+    with pytest.raises(ValueError, match=r"8 >= 4"):
+        AdmissionController(4, long_max_queue=8)
+    with pytest.raises(ValueError, match="≥ 0"):
+        AdmissionController(4, long_max_queue=-1)
+    # no reservation: plain bounded queue, long class never refused early
+    ctrl = AdmissionController(2)
+    ctrl.admit(1, 100.0, length_class="long", class_depth=1)
+
+
+def test_engine_refuses_reservation_without_threshold(f32):
+    model = _tiny_gpt()
+    params = _params(model)
+    with pytest.raises(ValueError,
+                       match="without SERVE.LONG_PROMPT_THRESHOLD"):
+        _long_engine(model, params, max_queue=4, long_max_queue=2)
+
+
+# ------------------------------------------- engine-level starvation pin
+
+def test_long_burst_cannot_starve_short_admission(f32):
+    """THE pin: with the queue already holding its full long-class
+    reservation, further long prompts backpressure while short prompts
+    keep admitting — and every admitted request still completes."""
+    model = _tiny_gpt()
+    params = _params(model)
+    eng = _long_engine(
+        model, params, max_queue=3,
+        long_prompt_threshold=8, long_max_queue=1,
+    )
+    rng = np.random.default_rng(21)
+    long_p = rng.integers(0, 256, (10,)).astype(np.int32)
+    short_p = rng.integers(0, 256, (3,)).astype(np.int32)
+    # engine not started: the queue holds, making depth deterministic
+    s_long = eng.submit(long_p)
+    with pytest.raises(LongQueueFullError,
+                       match=r"1/1 long-class slots; SERVE\.MAX_QUEUE=3"):
+        eng.submit(long_p)
+    s_short1 = eng.submit(short_p)  # short traffic unaffected
+    s_short2 = eng.submit(short_p)
+    st = eng.stats()
+    assert st["queue_depth"] == 3 and st["queue_depth_long"] == 1
+    assert st["long_threshold"] == 8 and st["long_max_queue"] == 1
+    assert st["long_admitted"] == 1 and st["long_rejected"] == 1
+    # the total bound still closes the queue for shorts too
+    with pytest.raises(QueueFullError):
+        eng.submit(short_p)
+    eng.start()
+    for s in (s_long, s_short1, s_short2):
+        assert len(s.result(timeout=120.0)) >= 1
+    eng.drain()
+
+
+def test_queue_full_frame_shape_verbatim(f32):
+    """The service layer's long-class rejection frame is byte-shape
+    identical to the classic queue_full frame: {"error", "retry_after_ms"}
+    and nothing else — clients and the router passthrough never learn a
+    new shape."""
+    from distribuuuu_tpu.lm import service as lm_service
+
+    model = _tiny_gpt()
+    params = _params(model)
+    eng = _long_engine(
+        model, params, max_queue=3,
+        long_prompt_threshold=8, long_max_queue=1,
+    )
+    eng.submit(np.arange(10, dtype=np.int32))  # fill the reservation
+    frames = []
+    lm_service.handle_generate(
+        eng, {"tokens": list(range(12))}, lambda b: frames.append(b)
+    )
+    assert len(frames) == 1
+    rec = json.loads(frames[0])
+    assert set(rec) == {"error", "retry_after_ms"}
+    assert rec["error"] == "queue_full" and rec["retry_after_ms"] > 0
+    eng.drain()
+
+
+# --------------------------------------------------- router length classes
+
+def test_router_classifies_generate_frames():
+    from distribuuuu_tpu.serve import protocol
+    from distribuuuu_tpu.serve.fleet.router import Router
+
+    router = Router(long_prompt_threshold=8, short_p99_slo_ms=50.0,
+                    long_p99_slo_ms=500.0)
+    classify = router._classify_payload
+    assert classify(
+        protocol.ctrl_request("generate", tokens=list(range(10)))
+    ) == "long"
+    assert classify(
+        protocol.ctrl_request("generate", tokens=[1, 2, 3])
+    ) == "short"
+    # text prompts count utf-8 bytes (the byte tokenizer's 1:1 identity)
+    assert classify(
+        protocol.ctrl_request("generate", text="x" * 9)
+    ) == "long"
+    assert classify(protocol.ctrl_request("generate", text="ab")) == "short"
+    # non-generate ctrl frames and image payloads never classify
+    assert classify(protocol.ctrl_request("stats")) is None
+    assert classify(b"\xff\xd8rawjpegbytes") is None
+    # classification off → everything is unclassified
+    assert Router()._classify_payload(
+        protocol.ctrl_request("generate", tokens=list(range(10)))
+    ) is None
+
+
+def test_router_per_class_stats_and_slo_rows():
+    """Observed per-class latencies surface BOTH as a length_classes
+    stats section and as `length:*` rows in the windowed models dict —
+    the exact shape the slo-breach rule scans for targeted rows."""
+    from distribuuuu_tpu.serve.fleet.router import Router
+
+    router = Router(long_prompt_threshold=8, short_p99_slo_ms=50.0,
+                    long_p99_slo_ms=500.0)
+    rep = router.add_replica("127.0.0.1", 1)
+    router.mark_routable(rep.id)
+    for _ in range(5):
+        router._observe(rep, 0.010, length_class="short")
+    router._observe(rep, 0.300, length_class="long")
+    router._count_rejected(None, length_class="long")
+    win = router.window_stats(60.0)
+    assert win["models"]["length:short"]["samples"] == 5
+    assert win["models"]["length:short"]["target_ms"] == 50.0
+    assert win["models"]["length:long"]["target_ms"] == 500.0
+    assert win["models"]["length:long"]["p99_ms"] >= 300.0
+    snap = router.stats()
+    assert snap["long_prompt_threshold"] == 8
+    lc = snap["length_classes"]
+    assert lc["short"]["requests"] == 5 and lc["short"]["rejected"] == 0
+    assert lc["long"]["requests"] == 1 and lc["long"]["rejected"] == 1
+    assert lc["long"]["p99_slo_ms"] == 500.0
+    # an unclassified router surfaces neither section
+    from distribuuuu_tpu.serve.fleet.router import Router as R2
+
+    assert "length_classes" not in R2().stats()
+
+
+def test_router_busy_passthrough_counts_long_rejection(f32):
+    """A long generate stream rejected by every replica passes the
+    replica's queue_full frame through verbatim AND lands in the long
+    class's rejected count — the campaign's backpressure evidence."""
+    import socket
+
+    from distribuuuu_tpu.serve import protocol
+    from distribuuuu_tpu.serve.fleet.router import Router
+
+    rep_listener = protocol.open_listener("127.0.0.1", 0)
+    rep_port = rep_listener.getsockname()[1]
+
+    def busy_replica():
+        conn, _ = rep_listener.accept()
+        with conn:
+            protocol.recv_frame(conn)
+            protocol.send_frame(conn, json.dumps(
+                {"error": "queue_full", "retry_after_ms": 77.0}
+            ).encode())
+
+    rt = threading.Thread(target=busy_replica, daemon=True)
+    rt.start()
+    router = Router(request_timeout_s=10.0, long_prompt_threshold=8)
+    rep = router.add_replica("127.0.0.1", rep_port)
+    router.mark_routable(rep.id)
+    listener = protocol.open_listener("127.0.0.1", 0)
+    port = listener.getsockname()[1]
+    stop = threading.Event()
+    t = threading.Thread(
+        target=router.serve, args=(listener, stop.is_set), daemon=True
+    )
+    t.start()
+    try:
+        with socket.create_connection(("127.0.0.1", port), timeout=10) as c:
+            protocol.send_frame(c, protocol.ctrl_request(
+                "generate", tokens=list(range(20))
+            ))
+            resp = json.loads(protocol.recv_frame(c))
+        assert resp == {"error": "queue_full", "retry_after_ms": 77.0}
+        assert router.stats()["length_classes"]["long"]["rejected"] == 1
+    finally:
+        stop.set()
+        t.join(5)
+        rep_listener.close()
+
+
+def test_length_class_telemetry_schema(tmp_path):
+    """fleet.length_class records land schema-valid in the span sink."""
+    import glob
+
+    from distribuuuu_tpu import telemetry
+    from distribuuuu_tpu.serve.fleet.router import Router
+    from distribuuuu_tpu.telemetry import schema
+
+    cfg.OUT_DIR = str(tmp_path)
+    telemetry.setup_from_cfg(cfg, rank=0)
+    try:
+        router = Router(long_prompt_threshold=8, long_p99_slo_ms=500.0)
+        rep = router.add_replica("127.0.0.1", 1)
+        router.mark_routable(rep.id)
+        router._observe(rep, 0.010, length_class="short")
+        router._observe(rep, 0.200, length_class="long")
+        router.emit_telemetry()
+    finally:
+        from distribuuuu_tpu.telemetry import spans
+
+        spans.close_telemetry()
+    recs = []
+    for p in glob.glob(str(tmp_path / "telemetry" / "rank*.jsonl")):
+        with open(p) as f:
+            recs.extend(json.loads(line) for line in f)
+    lrecs = {r["length_class"]: r for r in recs
+             if r.get("kind") == "fleet.length_class"}
+    assert set(lrecs) == {"short", "long"}
+    assert lrecs["long"]["threshold"] == 8
+    assert lrecs["short"]["requests"] == 1
+    for r in recs:
+        schema.validate_record(r)

@@ -154,6 +154,83 @@ def test_cursor_refused_on_identity_drift(pack_dir, tmp_path):
         fresh.load_state_dict(sd)
 
 
+LONG_PACK = 4096
+
+
+def _long_pack(root, n_records=6, seed=3):
+    """A pack_len=4096 split with a handful of records — pre-tokenized
+    uint16 docs (pack_token_stream takes arrays verbatim), so the 4k
+    geometry is real while the test stays toy-sized."""
+    rng = np.random.default_rng(seed)
+    docs = [
+        rng.integers(0, 256, ((LONG_PACK + 1) * n_records // 3,))
+        .astype(np.uint16)
+        for _ in range(4)  # 4 docs → > n_records complete windows
+    ]
+    split = root / "train"
+    token_shards.write_token_shards(
+        str(split),
+        token_shards.pack_token_stream(docs, LONG_PACK),
+        LONG_PACK,
+    )
+    return root
+
+
+def test_long_pack_roundtrip_and_exact_resume(tmp_path):
+    """ISSUE 19 data plane: the shard container and the Loader's exact
+    mid-epoch cursor hold at long-context pack geometry (pack_len=4096 —
+    8 KiB records) exactly as at pack_len=16: byte-identical read-back,
+    identity riding the cursor, resume producing the uninterrupted
+    tail."""
+    _long_pack(tmp_path)
+    ds = TokenShardDataset(str(tmp_path), "train", seq_len=LONG_PACK)
+    assert len(ds) >= 4
+    assert int(ds.manifest["pack_len"]) == LONG_PACK
+    seq = ds.seq_tokens(1)
+    assert seq.shape == (LONG_PACK + 1,) and seq.dtype == np.uint16
+    x, y = ds[2]
+    np.testing.assert_array_equal(x[1:], y[:-1])  # the next-token shift
+
+    from distribuuuu_tpu.data import construct_train_loader
+
+    cfg.DATA.FORMAT = "tokens"
+    cfg.LM.SEQ_LEN = LONG_PACK
+    cfg.MODEL.NUM_CLASSES = 320
+    cfg.TRAIN.DATASET = str(tmp_path)
+    cfg.TRAIN.BATCH_SIZE = 1
+    loader = construct_train_loader()
+    assert loader.can_save_state()
+    loader.set_epoch(1)
+    full = [b["image"].copy() for b in loader]
+    assert full and full[0].shape[1] == LONG_PACK
+    sd = loader.state_dict(1)
+    assert sd["dataset_identity"]["pack_len"] == LONG_PACK
+    fresh = construct_train_loader()
+    assert fresh.load_state_dict(sd) == 1
+    fresh.set_epoch(1)
+    resumed = [b["image"].copy() for b in fresh]
+    assert len(resumed) == len(full) - 1
+    for a, b in zip(resumed, full[1:]):
+        np.testing.assert_array_equal(a, b)
+
+
+def test_empty_long_pack_refused_at_pack_time(tmp_path):
+    """A corpus shorter than one pack_len+1 window refuses at PACK time
+    with the arithmetic — not as an empty split the loader trips over
+    later. No manifest may be committed."""
+    import os
+
+    split = tmp_path / "train"
+    short = [np.arange(500, dtype=np.uint16)]  # 501 tokens < 4097
+    with pytest.raises(ValueError, match=r"pack_len\+1=4097"):
+        token_shards.write_token_shards(
+            str(split),
+            token_shards.pack_token_stream(short, LONG_PACK),
+            LONG_PACK,
+        )
+    assert not os.path.exists(os.path.join(str(split), "MANIFEST.json"))
+
+
 def test_midepoch_resume_trajectory_pin(pack_dir):
     """The acceptance pin: training k steps, 'preempting', and resuming
     from the cursor reproduces the uninterrupted run's state EXACTLY

@@ -180,12 +180,41 @@ def index_lm_speculative(path: str, doc: dict, series: dict) -> None:
            spec.get("speedup_best"), "x")
 
 
+def index_lm_long_context(path: str, doc: dict, series: dict) -> None:
+    """BENCH_r12+ ``lm_long_context`` section (tools/lm_bench.py
+    --long-context): the dp2·sp4 seq-sharded train step at a long pack
+    length, and the chunked-vs-whole prefill A/B at the same prompt
+    length. Every series name is ``lm_longctx_*`` — deliberately outside
+    the ``images_per_sec``/``img_per_sec`` gate patterns (the PR 8
+    clobbering lesson): single-core CPU token rates are trajectory data,
+    never the throughput regression reference."""
+    lc = doc.get("lm_long_context") or {}
+    rnd, src = _round_of(path), os.path.basename(path)
+    train = lc.get("train") or {}
+    _point(series, "lm_longctx_train_tokens_per_s", rnd, src,
+           train.get("tokens_per_s"), "tok/s")
+    _point(series, "lm_longctx_train_step_ms", rnd, src,
+           train.get("step_ms"), "ms")
+    ab = lc.get("prefill_ab") or {}
+    for mode in ("whole", "chunked"):
+        row = ab.get(mode) or {}
+        _point(series, f"lm_longctx_prefill_{mode}_p50_ms", rnd, src,
+               row.get("prefill_p50_ms"), "ms")
+        _point(series, f"lm_longctx_prefill_{mode}_compile_s", rnd, src,
+               row.get("compile_s"), "s")
+        _point(series, f"lm_longctx_prefill_{mode}_executables", rnd, src,
+               row.get("n_executables"))
+    _point(series, "lm_longctx_prefill_ratio_chunked_vs_whole", rnd, src,
+           ab.get("prefill_ratio_chunked_vs_whole"), "x")
+
+
 def index_train_bench(path: str, series: dict) -> None:
     """BENCH_r*.json: the ``parsed`` block is the metric (r06+ may
     instead carry an ``asyncplane`` section, r08+ an ``lm`` section,
     r09+ a kernel-tier ``kernels``/``step_ab`` matrix, r10+ a
     ``zero_overlap`` schedule A/B, r11+ an ``lm_speculative`` draft-K
-    A/B — indexed separately)."""
+    A/B, r12+ an ``lm_long_context`` dp×sp + chunked-prefill A/B —
+    indexed separately)."""
     with open(path) as f:
         doc = json.load(f)
     if doc.get("asyncplane"):
@@ -194,6 +223,8 @@ def index_train_bench(path: str, series: dict) -> None:
         index_lm(path, doc, series)
     if doc.get("lm_speculative"):
         index_lm_speculative(path, doc, series)
+    if doc.get("lm_long_context"):
+        index_lm_long_context(path, doc, series)
     if doc.get("kernels") or doc.get("step_ab"):
         index_kernels(path, doc, series)
     if doc.get("zero_overlap"):

@@ -383,6 +383,124 @@ def bench_speculative(arch: str, draft_arch: str, seq_len: int,
     }
 
 
+def bench_long_context_train(arch: str, pack_len: int, steps: int,
+                             batch: int) -> dict:
+    """The dp×sp train half of ``--long-context`` (ISSUE 19a): the same
+    partition-lowered train step as ``bench_train``, but on a dp2·sp4
+    mesh (the ``config/gpt_nano_sp.yaml`` stanza shape) at a LONG pack
+    length — token batches sharded (data, seq), every block's attention
+    through the causal ring. Needs the 8-virtual-device CPU mesh
+    (XLA_FLAGS=--xla_force_host_platform_device_count=8)."""
+    import jax
+
+    from distribuuuu_tpu.config import cfg
+
+    if jax.device_count() < 8:
+        raise SystemExit(
+            f"--long-context trains a dp2·sp4 stanza and needs 8 devices "
+            f"(have {jax.device_count()}) — run under "
+            "XLA_FLAGS=--xla_force_host_platform_device_count=8"
+        )
+    cfg.MESH.DATA = 2
+    cfg.MESH.SEQ = 4
+    cfg.MESH.MODEL = 1
+    cfg.MESH.PIPE = 1
+    row = bench_train(arch, pack_len, steps, batch)
+    row["mesh"] = "dp2.sp4"
+    return row
+
+
+def bench_chunked_prefill_ab(arch: str, prompt_tokens: int, chunk: int,
+                             max_new: int = 16, n_prompts: int = 2) -> dict:
+    """Chunked-vs-whole prefill A/B at a long prompt (ISSUE 19c): the
+    SAME weights and prompts through two engines — one with the classic
+    whole-prompt bucket ladder up to ``prompt_tokens`` (the 4k-bucket
+    cost the chunked path exists to avoid), one streaming the prompt
+    into its KV page in ``chunk``-token AOT calls. Greedy continuations
+    are REQUIRED identical; the wall clocks and compile ledgers are the
+    measurement."""
+    import jax
+    import numpy as np
+
+    from distribuuuu_tpu.config import cfg
+    from distribuuuu_tpu import models
+    from distribuuuu_tpu.lm.generate import GenerateEngine
+    from distribuuuu_tpu.models.layers import resolve_dtype
+
+    # f32: at bf16 on the 8-virtual-device CPU mesh the two prefill
+    # paths can argmax-flip a near-tie token under different intra-op
+    # reduction orders — the identity claim is about the math, so the
+    # A/B measures it at the dtype where greedy identity is exact
+    # (tier-1 pins the same at toy sizes: tests/test_lm_chunk_prefill.py)
+    cfg.DEVICE.COMPUTE_DTYPE = "float32"
+    cache = -(-(prompt_tokens + max_new) // chunk) * chunk
+    model = models.build_model(
+        arch, num_classes=320, seq_len=cache,
+        dtype=resolve_dtype(cfg.DEVICE.COMPUTE_DTYPE),
+    )
+    params = model.init(
+        jax.random.key(0), jax.numpy.zeros((1, 8), "int32"), train=False
+    )["params"]
+    rng = np.random.default_rng(13)
+    prompts = [
+        rng.integers(0, 256, (prompt_tokens,)).astype(np.int32)
+        for _ in range(n_prompts)
+    ]
+
+    def run(engine_kwargs: dict) -> dict:
+        t0 = time.perf_counter()
+        eng = GenerateEngine(
+            model, {"params": params}, max_new_tokens=max_new,
+            batch_tiles=[1], cache_tiles=[cache], **engine_kwargs,
+        )
+        compile_s = time.perf_counter() - t0
+        eng.start()
+        walls, toks = [], []
+        for p in prompts:
+            t1 = time.perf_counter()
+            toks.append(eng.submit(p, max_new_tokens=max_new).result(
+                timeout=1800.0
+            ))
+            walls.append(time.perf_counter() - t1)
+        stats = eng.stats()
+        eng.drain()
+        return {
+            "compile_s": round(compile_s, 2),
+            "n_executables": eng.n_compiles,
+            "request_ms": [round(w * 1e3, 1) for w in walls],
+            "prefill_p50_ms": stats["prefill_p50_ms"],
+            "tokens": toks,
+            "stats": stats,
+        }
+
+    whole = run({"prompt_len": prompt_tokens})
+    chunked = run({"prompt_len": chunk, "chunk_prefill": chunk})
+    identical = whole["tokens"] == chunked["tokens"]
+    doc = {
+        "arch": arch,
+        "dtype": "float32",
+        "prompt_tokens": prompt_tokens,
+        "max_new": max_new,
+        "cache_tile": cache,
+        "chunk": chunk,
+        "chunk_calls": chunked["stats"].get("chunk_calls", 0),
+        "identical_tokens": identical,
+        "whole": {k: whole[k] for k in
+                  ("compile_s", "n_executables", "request_ms",
+                   "prefill_p50_ms")},
+        "chunked": {k: chunked[k] for k in
+                    ("compile_s", "n_executables", "request_ms",
+                     "prefill_p50_ms")},
+    }
+    doc["prefill_ratio_chunked_vs_whole"] = round(
+        chunked["prefill_p50_ms"] / max(1e-9, whole["prefill_p50_ms"]), 3
+    )
+    doc["compile_ratio_chunked_vs_whole"] = round(
+        chunked["compile_s"] / max(1e-9, whole["compile_s"]), 3
+    )
+    return doc
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
     ap.add_argument("--json-out", default=None,
@@ -397,6 +515,13 @@ def main(argv=None) -> int:
     ap.add_argument("--draft-arch", default="gpt_nano")
     ap.add_argument("--target-arch", default="gpt_nano_moe")
     ap.add_argument("--train-steps", type=int, default=150)
+    ap.add_argument("--long-context", action="store_true",
+                    help="dp2·sp4 train step + chunked-vs-whole prefill "
+                         "A/B at --pack-len → BENCH_r12.json "
+                         "(lm_longctx_* series; needs XLA_FLAGS="
+                         "--xla_force_host_platform_device_count=8)")
+    ap.add_argument("--pack-len", type=int, default=4096)
+    ap.add_argument("--chunk", type=int, default=256)
     args = ap.parse_args(argv)
 
     import jax
@@ -408,6 +533,49 @@ def main(argv=None) -> int:
 
     cfg.TELEMETRY.ENABLED = False  # bench times raw dispatch
     platform = jax.devices()[0].platform
+    if args.long_context:
+        ab = bench_chunked_prefill_ab(
+            args.arch, args.pack_len, args.chunk,
+        )
+        print(f"# prefill A/B @ {args.pack_len} tokens: whole p50 "
+              f"{ab['whole']['prefill_p50_ms']} ms "
+              f"({ab['whole']['n_executables']} executables, "
+              f"{ab['whole']['compile_s']}s compile) vs chunked p50 "
+              f"{ab['chunked']['prefill_p50_ms']} ms in "
+              f"{ab['chunk_calls'] // len(ab['whole']['request_ms'])} "
+              f"x{args.chunk} chunks "
+              f"({ab['chunked']['n_executables']} executables, "
+              f"{ab['chunked']['compile_s']}s compile); identical="
+              f"{ab['identical_tokens']}", flush=True)
+        config.reset_cfg()
+        cfg.TELEMETRY.ENABLED = False
+        train = bench_long_context_train(
+            args.arch, args.pack_len, args.steps, args.batch
+        )
+        print(f"# dp2.sp4 train @ pack_len {args.pack_len}: "
+              f"{train['tokens_per_s']} tokens/s "
+              f"({train['step_ms']} ms/step x {train['batch_seqs']} seqs)",
+              flush=True)
+        doc = {
+            "schema": 1,
+            "generated_by": "tools/lm_bench.py --long-context",
+            "platform": platform,
+            "cpu_count": os.cpu_count(),
+            "note": (
+                "CPU container numbers — long-context trajectory data "
+                "for the LM plane, never an img/s reference (series "
+                "names avoid the throughput-gate patterns)"
+            ),
+            "lm_long_context": {"train": train, "prefill_ab": ab},
+        }
+        out = args.json_out or os.path.join(
+            os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+            "BENCH_r12.json",
+        )
+        with open(out, "w") as f:
+            json.dump(doc, f, indent=1)
+        print(f"# wrote {out}")
+        return 0
     if args.speculative:
         spec = bench_speculative(
             args.target_arch, args.draft_arch, args.seq_len,

@@ -222,8 +222,11 @@ def _lm_section(ranks: dict[int, list[dict]]) -> dict | None:
     last_tokens: dict[int, dict] = {}
     dec_ms: list[float] = []
     pre_ms: list[float] = []
+    chunk_ms: list[float] = []
+    chunk_calls = 0
     admits = retires = 0
     reasons: dict[str, int] = {}
+    admit_classes: dict[str, int] = {}
     spec_rounds = spec_proposed = spec_accepted = spec_bonus = 0
     for rank, recs in sorted(ranks.items()):
         for r in recs:
@@ -234,8 +237,14 @@ def _lm_section(ranks: dict[int, list[dict]]) -> dict | None:
                 dec_ms.append(float(r["ms"]))
             elif kind == "gen.prefill":
                 pre_ms.append(float(r["ms"]))
+            elif kind == "gen.chunk_prefill":
+                chunk_ms.append(float(r["ms"]))
+                chunk_calls += int(r.get("chunks", 0))
             elif kind == "gen.admit":
                 admits += 1
+                lc = r.get("length_class")
+                if lc:
+                    admit_classes[str(lc)] = admit_classes.get(str(lc), 0) + 1
             elif kind == "gen.retire":
                 retires += 1
                 reason = str(r.get("reason"))
@@ -269,6 +278,16 @@ def _lm_section(ranks: dict[int, list[dict]]) -> dict | None:
         "decode": _summary_ms([v / 1e3 for v in dec_ms]),
         "prefill": _summary_ms([v / 1e3 for v in pre_ms]),
     }
+    if chunk_ms:
+        # chunked paged prefill (ISSUE 19c): per-prompt wall + total
+        # fixed-width chunk appends — the long-context admission path
+        out["chunk_prefill"] = {
+            "prompts": len(chunk_ms),
+            "chunk_calls": chunk_calls,
+            **_summary_ms([v / 1e3 for v in chunk_ms]),
+        }
+    if admit_classes:
+        out["admit_length_classes"] = admit_classes
     if spec_rounds:
         # acceptance ratio = accepted/proposed (draft quality); tokens
         # per round = (accepted+bonus+rejections-resampled)/rounds — the
@@ -292,12 +311,15 @@ def _campaign_section(ranks: dict[int, list[dict]]) -> dict | None:
     """The traffic-campaign plane (serve/campaign/): per-campaign verdicts
     (``campaign.verdict``), per-phase expected-vs-raised alert gates
     (``campaign.phase``), per-model routing totals on multi-model fleets
-    (``fleet.model_route``, last record per model wins), and any quantized
-    engine starts (``serve.quantized``). None when the run carried no
-    campaign records (training and plain serve runs are untouched)."""
+    (``fleet.model_route``, last record per model wins), per-length-class
+    routing totals on length-aware fleets (``fleet.length_class``,
+    ISSUE 19c), and any quantized engine starts (``serve.quantized``).
+    None when the run carried no campaign records (training and plain
+    serve runs are untouched)."""
     phases: list[dict] = []
     verdicts: list[dict] = []
     model_route: dict[str, dict] = {}
+    length_classes: dict[str, dict] = {}
     quantized: list[dict] = []
     for recs in ranks.values():
         for r in recs:
@@ -325,13 +347,23 @@ def _campaign_section(ranks: dict[int, list[dict]]) -> dict | None:
                     "degraded_out": r.get("degraded_out"),
                     "p99_ms": r.get("p99_ms"),
                 }
+            elif kind == "fleet.length_class":
+                # length-aware routing (ISSUE 19c): last record per class
+                # wins — the long-vs-short admission/latency evidence
+                length_classes[str(r.get("length_class"))] = {
+                    "threshold": r.get("threshold"),
+                    "requests": r.get("requests"),
+                    "rejected": r.get("rejected"),
+                    "p99_ms": r.get("p99_ms"),
+                }
             elif kind == "serve.quantized":
                 quantized.append({
                     "arch": r.get("arch"), "mode": r.get("mode"),
                     "bytes_before": r.get("bytes_before"),
                     "bytes_after": r.get("bytes_after"),
                 })
-    if not (phases or verdicts or model_route or quantized):
+    if not (phases or verdicts or model_route or length_classes
+            or quantized):
         return None
     return {
         "campaigns": len(verdicts),
@@ -339,6 +371,7 @@ def _campaign_section(ranks: dict[int, list[dict]]) -> dict | None:
         "verdicts": verdicts,
         "phases": phases,
         "model_route": model_route or None,
+        "length_classes": length_classes or None,
         "quantized": quantized or None,
     }
 
@@ -807,6 +840,16 @@ def _print_report(rep: dict) -> None:
                 print(f"  {name:<8} {row['count']:>6} calls  "
                       f"mean {row['mean_ms']:.3f}  p50 {row['p50_ms']:.3f}  "
                       f"p99 {row['p99_ms']:.3f}  max {row['max_ms']:.3f}  (ms)")
+        ck = lm.get("chunk_prefill")
+        if ck:
+            print(f"  chunked prefill: {ck['prompts']} prompt(s) in "
+                  f"{ck['chunk_calls']} chunk call(s)  "
+                  f"mean {ck['mean_ms']:.3f}  p50 {ck['p50_ms']:.3f}  "
+                  f"p99 {ck['p99_ms']:.3f}  (ms)")
+        if lm.get("admit_length_classes"):
+            mix = ", ".join(f"{k}={v}" for k, v in
+                            sorted(lm["admit_length_classes"].items()))
+            print(f"  admit length classes: {mix}")
     kern = rep.get("kernels")
     if kern:
         chosen = ", ".join(
@@ -873,6 +916,11 @@ def _print_report(rep: dict) -> None:
                       f"spill_out={row['degraded_out']} "
                       f"spill_in={row['degraded_in']} "
                       f"p99={row['p99_ms']}ms")
+        if camp.get("length_classes"):
+            for name, row in sorted(camp["length_classes"].items()):
+                print(f"  length {name:<11} (>= {row['threshold']} tokens "
+                      f"is long): requests={row['requests']} "
+                      f"rejected={row['rejected']} p99={row['p99_ms']}ms")
         for q in camp.get("quantized") or []:
             ratio = (q["bytes_after"] / q["bytes_before"]
                      if q.get("bytes_before") else None)

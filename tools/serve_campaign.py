@@ -49,6 +49,8 @@ TOPOLOGY = {
     "rolling_update": {"resnet18": 2},  # >=2: one replica stays routable
     "degrade_under_pressure": {"resnet50": 1, "resnet18": 1},
     "lm_decode": {"gpt_nano": 1},  # one replica: the burst MUST overflow it
+    "long_context": {"gpt_nano": 1},  # one replica: longs contend for ONE
+    # long-class admission slot while shorts keep flowing (ISSUE 19c)
 }
 
 IM_SIZE = 16
@@ -117,6 +119,23 @@ def lm_base_cfg(work: str):
     return cfg
 
 
+def long_context_cfg(work: str):
+    """The long-context campaign serve config (ISSUE 19c): lm_base_cfg
+    plus chunked prefill into a wider paged cache, the long-class
+    admission reservation (1 of the 4 queue slots), and a short-class
+    p99 SLO target so the slo-breach rule referees short-prompt latency
+    against long-prompt interference (router's `length:short` row)."""
+    cfg = lm_base_cfg(work)
+    cfg.LM.SEQ_LEN = 64
+    cfg.GENERATE.MAX_NEW_TOKENS = 8
+    cfg.GENERATE.CACHE_TILES = [64]
+    cfg.GENERATE.CHUNK_PREFILL = 8
+    cfg.SERVE.LONG_PROMPT_THRESHOLD = 16
+    cfg.SERVE.LONG_MAX_QUEUE = 1
+    cfg.SERVE.SHORT_P99_SLO_MS = 10000.0
+    return cfg
+
+
 def payload_bank(n: int = 8, seed: int = 0) -> list:
     rng = np.random.default_rng(seed)
     out = []
@@ -144,6 +163,28 @@ def lm_payload_bank(n: int = 8, seed: int = 0) -> list:
             "generate",
             tokens=[int(t) for t in rng.integers(0, 256, plen)],
             max_new_tokens=6 + i % 4,
+        ))
+    return out
+
+
+def lm_long_payload_bank(n: int = 12, seed: int = 0,
+                         max_prompt: int = 48) -> list:
+    """Heavy-tailed prompt-length mix for the long-context campaign:
+    Pareto-drawn lengths (mostly short, a fat tail of chunked-prefill
+    long prompts) clamped to the paged-cache admission bound. Seed 0
+    lands 4/12 prompts at or past the 16-token long-class threshold —
+    the deterministic pressure that must bounce off the one reserved
+    long slot while shorts keep admitting."""
+    from distribuuuu_tpu.serve import protocol
+
+    rng = np.random.default_rng(seed)
+    out = []
+    for i in range(n):
+        plen = min(max_prompt, 2 + int(rng.pareto(0.9) * 5))
+        out.append(protocol.ctrl_request(
+            "generate",
+            tokens=[int(t) for t in rng.integers(0, 256, plen)],
+            max_new_tokens=4 + i % 4,
         ))
     return out
 
@@ -178,7 +219,9 @@ def run_campaign(path: str, work: str, log) -> dict:
     # frames through the router's streaming branch instead of image
     # payloads through dispatch (runner._job classifies on done frames)
     is_lm = all(m["name"].startswith("gpt") for m in spec.models)
-    cfg = lm_base_cfg(cdir) if is_lm else base_cfg(cdir)
+    is_long = spec.name == "long_context"
+    cfg = (long_context_cfg(cdir) if is_long
+           else lm_base_cfg(cdir) if is_lm else base_cfg(cdir))
     specs = fleet_specs(spec)
     log(f"campaign {spec.name}: fleet "
         f"{ {s['name']: s['replicas'] for s in specs} } warming up ...")
@@ -187,7 +230,8 @@ def run_campaign(path: str, work: str, log) -> dict:
     fleet.start(wait=True)
     log(f"campaign {spec.name}: fleet routable in "
         f"{time.perf_counter() - t0:.1f}s")
-    payloads = lm_payload_bank() if is_lm else payload_bank()
+    payloads = (lm_long_payload_bank() if is_long
+                else lm_payload_bank() if is_lm else payload_bank())
     counter = {"i": 0}
     lock = threading.Lock()
 
