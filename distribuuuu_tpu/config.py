@@ -860,6 +860,19 @@ _C.SERVE.PORT = 8765
 # `zoo_check.py --quantize` against per-mode tolerances.
 _C.SERVE.QUANTIZE = ""
 
+# Request-scoped distributed tracing (telemetry/tracectx.py): the
+# fraction of requests the client/bench edge opens a trace context for
+# (head-based deterministic sampling — the decision is a pure function
+# of the minted trace id, made once at the edge; downstream hops only
+# honor presence). Traced requests carry the context in every protocol
+# frame and accumulate a `trace.span` tree across router and replica
+# sinks (queue wait, prefill chunks, decode steps, speculation rounds);
+# the router's latency ring keeps trace ids so p99-breach alerts name
+# their worst exemplars. 0.0 (default) keeps every frame byte-identical
+# to the untraced wire format — server math is bit-identical either way
+# (the trajectory-neutrality pin, tests/test_trace.py).
+_C.SERVE.TRACE_SAMPLE = 0.0
+
 # Serving fleet (serve/fleet/, `serve_net.py --fleet N`): a shared-nothing
 # replica pool behind a router process. The router owns SERVE.HOST:PORT;
 # each replica is a full serve_net engine in its own process on an

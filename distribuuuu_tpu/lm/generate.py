@@ -66,6 +66,7 @@ from distribuuuu_tpu.serve.admission import (
     QueueFullError,
 )
 from distribuuuu_tpu.telemetry import registry as telemetry_registry
+from distribuuuu_tpu.telemetry import tracectx
 
 
 # --------------------------------------------------------- decode modules
@@ -511,11 +512,24 @@ def validate_speculate_cfg(k: int, target_model, draft_model,
 
 class GenStream:
     """Per-request streamed result: iterate for tokens as they decode, or
-    ``result()`` for the full list. Closed exactly once at retire."""
+    ``result()`` for the full list. Closed exactly once at retire.
 
-    def __init__(self, request_id: int, prompt_len: int):
+    ``request_id`` is the engine's local counter — or, for a TRACED
+    request (ISSUE 20), the fleet-wide trace id: one identity from the
+    client edge's ctrl frame to the done frame. ``trace``/``span_id``/
+    ``t_submit`` feed the engine's per-request ``trace.span`` tree
+    (queue wait at admit, decode/speculation steps, the
+    ``engine.request`` root at retire)."""
+
+    def __init__(self, request_id, prompt_len: int, trace=None):
         self.request_id = request_id
         self.prompt_len = prompt_len
+        self.trace = trace
+        self.t_submit = time.perf_counter()
+        # the engine-side root span id, minted NOW so every child span
+        # (queue_wait, prefill, decode steps) can parent onto it before
+        # the root itself is emitted at retire
+        self.span_id = "" if trace is None else tracectx.new_span_id()
         self._q: deque = deque()
         self._cond = threading.Condition()
         self._done = False
@@ -1245,12 +1259,21 @@ class GenerateEngine:
         self.drain()
 
     def submit(self, prompt, max_new_tokens: int | None = None,
-               sample: SampleParams | dict | None = None) -> GenStream:
+               sample: SampleParams | dict | None = None,
+               trace=None) -> GenStream:
         """Enqueue one prompt (iterable of token ids). Returns the token
         stream. Raises ``QueueFullError``/``EngineClosedError`` like the
         image engine's admission contract. ``sample`` overrides the
         engine's default :class:`SampleParams` for this request (the
-        ctrl-frame temperature/top_k/top_p/seed fields land here)."""
+        ctrl-frame temperature/top_k/top_p/seed fields land here).
+
+        ``trace`` (a ``tracectx.TraceContext`` or its ctrl-frame dict)
+        unifies the stream's ``request_id`` with the fleet-wide trace id
+        and turns on per-request span emission — purely observational:
+        admission, scheduling, and every token are bit-identical with or
+        without it."""
+        if isinstance(trace, dict):
+            trace = tracectx.from_fields(trace)
         sp = (
             self._default_sample if sample is None
             else sample_params(sample)
@@ -1302,7 +1325,10 @@ class GenerateEngine:
                 if self.long_threshold and lc == "long":
                     self._counters["long_rejected"] += 1
                 raise
-            stream = GenStream(self._next_id, len(ids))
+            stream = GenStream(
+                self._next_id if trace is None else trace.trace_id,
+                len(ids), trace=trace,
+            )
             self._next_id += 1
             self._waiting.append((stream, ids, max_new, sp))
             self._counters["requests"] += 1
@@ -1481,6 +1507,15 @@ class GenerateEngine:
                     temperature=sp.temperature, top_k=sp.top_k,
                     top_p=sp.top_p, seed=sp.seed,
                 )
+            tracectx.emit_trace_span(
+                stream.trace, "queue_wait", stream.t_submit,
+                t0 - stream.t_submit, parent=stream.span_id, slot=slot,
+            )
+            tracectx.emit_trace_span(
+                stream.trace, "chunk_prefill", t0, ms / 1e3,
+                parent=stream.span_id, tokens=plen, chunk=W,
+                chunks=n_chunks, tile=ct,
+            )
         self._maybe_finish(slot, first)
 
     def _admit(self, stream: GenStream, ids: np.ndarray, max_new: int,
@@ -1538,6 +1573,14 @@ class GenerateEngine:
                     temperature=sp.temperature, top_k=sp.top_k,
                     top_p=sp.top_p, seed=sp.seed,
                 )
+            tracectx.emit_trace_span(
+                stream.trace, "queue_wait", stream.t_submit,
+                t0 - stream.t_submit, parent=stream.span_id, slot=slot,
+            )
+            tracectx.emit_trace_span(
+                stream.trace, "prefill", t0, ms / 1e3,
+                parent=stream.span_id, tokens=plen, tile=ptile,
+            )
         self._maybe_finish(slot, first)
 
     def _retire(self, slot: int, reason: str) -> None:
@@ -1551,6 +1594,20 @@ class GenerateEngine:
             spans.emit_event(
                 "gen.retire", slot=slot, new_tokens=s.new_tokens,
                 reason=reason, request=s.stream.request_id,
+            )
+            # the engine-side ROOT of a traced request's span tree:
+            # submit → retire, under the router's dispatch span; its
+            # pre-minted span_id is what queue_wait/prefill/decode
+            # children already parented onto
+            tr = s.stream.trace
+            tracectx.emit_trace_span(
+                tr, "engine.request", s.stream.t_submit,
+                time.perf_counter() - s.stream.t_submit,
+                parent="" if tr is None else tr.parent_span,
+                span_id=s.stream.span_id, reason=reason,
+                new_tokens=s.new_tokens,
+                prompt_tokens=s.stream.prompt_len,
+                length_class=self._length_class(s.stream.prompt_len),
             )
 
     def _maybe_finish(self, slot: int, token: int) -> bool:
@@ -1595,6 +1652,12 @@ class GenerateEngine:
 
         t0 = time.perf_counter()
         live = [i for i, s in enumerate(self._slots) if s is not None]
+        # snapshot the traced residents NOW — _emit_tok may retire a
+        # slot mid-loop, but its wall-clock share of THIS step is real
+        traced = [
+            (i, self._slots[i]) for i in live
+            if self._slots[i].stream.trace is not None
+        ]
         c_need = max(self._slots[i].length for i in live) + 1
         self._ensure_tile(max(live) + 1, c_need)
         b = self._b_tile
@@ -1618,6 +1681,15 @@ class GenerateEngine:
                 "gen.decode", active=len(live), tile_b=b,
                 tile_c=self._c_tile, ms=round(ms, 3),
             )
+            # wall-clock attribution per TRACED resident: the request
+            # was live for the whole batched step, so the full step
+            # duration is its decode share (residency, not cost split)
+            for i, s in traced:
+                tracectx.emit_trace_span(
+                    s.stream.trace, "decode_step", t0, ms / 1e3,
+                    parent=s.stream.span_id, slot=i, tile_b=b,
+                    tile_c=self._c_tile, active=len(live),
+                )
 
     def _spec_propose_steps(self, live, props, qrows, steps, b, c) -> None:
         """Per-step propose path: one draft decode call (and one host
@@ -1680,6 +1752,10 @@ class GenerateEngine:
         t0 = time.perf_counter()
         K = self.spec_k
         live = [i for i, s in enumerate(self._slots) if s is not None]
+        traced = [
+            (i, self._slots[i]) for i in live
+            if self._slots[i].stream.trace is not None
+        ]
         max_len = max(self._slots[i].length for i in live)
         self._ensure_tile(max(live) + 1, max_len + K + 1)
         b, c = self._b_tile, self._c_tile
@@ -1792,6 +1868,12 @@ class GenerateEngine:
                 proposed=K * len(live), accepted=n_acc, bonus=n_bonus,
                 ms=round(ms, 3),
             )
+            for i, s in traced:
+                tracectx.emit_trace_span(
+                    s.stream.trace, "spec_round", t0, ms / 1e3,
+                    parent=s.stream.span_id, slot=i, k=K,
+                    accepted=n_acc, bonus=n_bonus, active=len(live),
+                )
 
     def _emit_token_counters(self) -> None:
         from distribuuuu_tpu.telemetry import spans

@@ -31,6 +31,7 @@ from distribuuuu_tpu.lm.generate import GenerateEngine
 from distribuuuu_tpu.lm.tokenizer import ByteTokenizer
 from distribuuuu_tpu.serve import protocol
 from distribuuuu_tpu.serve.admission import EngineClosedError, QueueFullError
+from distribuuuu_tpu.telemetry import tracectx
 
 
 def engine_from_cfg() -> GenerateEngine:
@@ -169,7 +170,14 @@ def handle_generate(engine: GenerateEngine, ctrl: dict, send) -> None:
     ``top_p``/``seed`` ctrl fields override the replica's
     ``GENERATE.SAMPLE`` defaults per request — a sampled stream is
     replayable from its ctrl frame alone (same seed ⇒ same tokens, on
-    any replica)."""
+    any replica).
+
+    A ``"trace"`` ctrl field (tracectx, ISSUE 20) makes the request's
+    trace id the engine's ``request_id`` — one identity from the client
+    edge to the done frame — and the token/done frames echo it as
+    ``trace_id``. Anything malformed (or absent) degrades to the
+    untraced path: same frames, byte-identical."""
+    trace = tracectx.from_fields(ctrl.get("trace"))
     tok = ByteTokenizer()
     if "tokens" in ctrl:
         ids = [int(t) for t in ctrl["tokens"]]
@@ -184,9 +192,11 @@ def handle_generate(engine: GenerateEngine, ctrl: dict, send) -> None:
         k: ctrl[k] for k in ("temperature", "top_k", "top_p", "seed")
         if k in ctrl
     }
+    echo = {} if trace is None else {"trace_id": trace.trace_id}
     try:
         stream = engine.submit(
-            ids, ctrl.get("max_new_tokens"), sample=sample or None
+            ids, ctrl.get("max_new_tokens"), sample=sample or None,
+            trace=trace,
         )
     except QueueFullError as e:
         send(json.dumps({
@@ -205,12 +215,13 @@ def handle_generate(engine: GenerateEngine, ctrl: dict, send) -> None:
         for token in stream:
             out.append(token)
             send(json.dumps(
-                {"stream": "token", "token": token, "i": len(out) - 1}
+                {"stream": "token", "token": token, "i": len(out) - 1,
+                 **echo}
             ).encode())
     except Exception as e:  # noqa: BLE001 — fail THIS request only
         send(json.dumps(
             {"stream": "done", "error": f"{type(e).__name__}: {e}",
-             "tokens": out, "n": len(out)}
+             "tokens": out, "n": len(out), **echo}
         ).encode())
         return
     send(json.dumps({
@@ -219,6 +230,7 @@ def handle_generate(engine: GenerateEngine, ctrl: dict, send) -> None:
         "n": len(out),
         "text": tok.decode(out),
         "reason": stream.reason,
+        **echo,
     }).encode())
 
 
@@ -226,13 +238,31 @@ def generate_request(host: str, port: int, *, tokens=None, text=None,
                      max_new_tokens: int | None = None,
                      temperature: float | None = None,
                      top_k: int | None = None, top_p: float | None = None,
-                     seed: int | None = None, timeout: float = 60.0):
+                     seed: int | None = None, timeout: float = 60.0,
+                     trace=None, trace_sample: float = 0.0):
     """Client helper (tests/bench/RUNBOOK): send one generate request to a
     replica OR the fleet router and yield the decoded frames — token
     frames as they stream, the done frame last. Raises on error frames.
     The sampling kwargs ride the ctrl frame; a request that sets them is
-    replayable verbatim (same frame ⇒ same stream on any replica)."""
+    replayable verbatim (same frame ⇒ same stream on any replica).
+
+    This is the tracing plane's CLIENT EDGE (ISSUE 20): pass a
+    ``tracectx.TraceContext`` as ``trace`` (or a ``trace_sample`` rate
+    to let head-based sampling open one here) and the context rides the
+    ctrl frame through router and replica; the edge lands the root
+    ``client.request`` span in this process's sink (if telemetry is up)
+    once the done frame arrives. Both off (the default) sends the exact
+    pre-tracing bytes."""
+    import time
+
+    if trace is None and trace_sample > 0.0:
+        trace = tracectx.open_trace(trace_sample)
+    # the edge's own span id is minted BEFORE sending so the downstream
+    # hops parent onto it — the root of the request's span tree
+    edge_sid = "" if trace is None else tracectx.new_span_id()
     fields = {}
+    if trace is not None:
+        fields.update(tracectx.to_fields(trace.child(edge_sid)))
     if tokens is not None:
         fields["tokens"] = [int(t) for t in tokens]
     if text is not None:
@@ -247,6 +277,8 @@ def generate_request(host: str, port: int, *, tokens=None, text=None,
         fields["top_p"] = float(top_p)
     if seed is not None:
         fields["seed"] = int(seed)
+    t0 = time.perf_counter()
+    n_frames = 0
     with socket.create_connection((host, port), timeout=timeout) as conn:
         conn.settimeout(timeout)
         protocol.send_frame(conn, protocol.ctrl_request("generate", **fields))
@@ -259,6 +291,13 @@ def generate_request(host: str, port: int, *, tokens=None, text=None,
             frame = json.loads(payload)
             if "error" in frame and "stream" not in frame:
                 raise RuntimeError(f"generate failed: {frame}")
+            n_frames += 1
             yield frame
             if frame.get("stream") == "done":
+                tracectx.emit_trace_span(
+                    trace, "client.request", t0,
+                    time.perf_counter() - t0, parent="",
+                    span_id=edge_sid, frames=n_frames,
+                    ok=("error" not in frame),
+                )
                 return

@@ -34,6 +34,7 @@ from concurrent.futures import ThreadPoolExecutor
 
 from distribuuuu_tpu.serve import protocol
 from distribuuuu_tpu.serve.campaign import dsl
+from distribuuuu_tpu.telemetry import tracectx
 from distribuuuu_tpu.telemetry.live import SNAPSHOT_SCHEMA, AlertRule, RuleEngine
 from distribuuuu_tpu.utils.logger import get_logger
 
@@ -50,11 +51,16 @@ class CampaignRunner:
     """
 
     def __init__(self, spec: dsl.CampaignSpec, router, *, payload_for,
-                 fleet=None, max_workers: int = 32):
+                 fleet=None, max_workers: int = 32,
+                 trace_sample: float = 0.0):
         self.spec = spec
         self.router = router
         self.fleet = fleet
         self._payload_for = payload_for
+        # ISSUE 20: fraction of generate requests that open a trace at
+        # the campaign edge (head-based deterministic sampling); 0.0
+        # keeps every frame byte-identical to an untraced campaign
+        self._trace_sample = float(trace_sample)
         self._pool = ThreadPoolExecutor(
             max_workers=max_workers, thread_name_prefix="campaign"
         )
@@ -92,11 +98,29 @@ class CampaignRunner:
             cls = "failed"
             try:
                 if generate:
+                    req, ctx, esid = frame, None, ""
+                    if self._trace_sample > 0.0:
+                        # open a per-request trace at the campaign edge
+                        # (ISSUE 20): the edge span is the tree's root;
+                        # the router re-points the parent at its own
+                        # dispatch span, so exemplar-named traces render
+                        # as connected waterfalls
+                        ctx = tracectx.open_trace(self._trace_sample)
+                        if ctx is not None:
+                            esid = tracectx.new_span_id()
+                            ctrl = protocol.parse_ctrl(frame) or {}
+                            ctrl.update(
+                                tracectx.to_fields(ctx.child(esid))
+                            )
+                            req = protocol.CTRL_MAGIC + json.dumps(
+                                ctrl
+                            ).encode("utf-8")
                     # final frame of the stream: a clean done frame has
                     # no "error" key; a mid-stream failure rides the done
                     # frame itself, so classify on the parsed record
+                    t_req = time.perf_counter()
                     rec = json.loads(self.router.dispatch_generate(
-                        frame, model=model
+                        req, model=model
                     ))
                     err = rec.get("error")
                     if err is None and rec.get("stream") == "done":
@@ -105,6 +129,11 @@ class CampaignRunner:
                         cls = "busy"
                     elif err == "unknown_model":
                         cls = "unknown_model"
+                    tracectx.emit_trace_span(
+                        ctx, "client.request", t_req,
+                        time.perf_counter() - t_req, parent="",
+                        span_id=esid, ok=(err is None),
+                    )
                     with self._lock:
                         self._counts[pi]["sent"] += 1
                         self._counts[pi][cls] += 1
@@ -150,6 +179,10 @@ class CampaignRunner:
                 "queue_depth": int(win.get("queue_depth", 0)),
                 "rejected": int(st.get("rejected", 0)),
                 "degraded": int(st.get("degraded", 0)),
+                # worst traced samples of the window (ISSUE 20): the
+                # rule engine copies these ids onto p99-breach /
+                # backpressure alerts as exemplar_trace_ids
+                "exemplars": win.get("exemplars", []),
                 "models": win.get("models", {}),
             },
         }

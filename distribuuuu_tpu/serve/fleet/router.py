@@ -46,6 +46,7 @@ import time
 from dataclasses import dataclass, field
 
 from distribuuuu_tpu.serve import protocol
+from distribuuuu_tpu.telemetry import tracectx
 from distribuuuu_tpu.telemetry.registry import Registry, percentile
 
 _ERROR_PREFIX = b'{"error"'
@@ -188,8 +189,11 @@ class Router:
         self.request_timeout_s = float(request_timeout_s)
         self.registry = Registry()
         self._lat = self.registry.histogram("fleet.latency_s")
-        # (t_done, latency_s) ring for the autoscaler's windowed p99
-        self._recent: list[tuple[float, float]] = []
+        # (t_done, latency_s, trace_id|None) ring: the autoscaler's
+        # windowed p99 source AND the exemplar store — traced samples
+        # keep their trace id so a p99 breach can name its worst
+        # offenders (window_stats "exemplars", ISSUE 20)
+        self._recent: list[tuple[float, float, str | None]] = []
         self._recent_cap = recent_window
         self._t0 = time.perf_counter()
         # multi-model multiplexing (serve/campaign): model id -> SLO class
@@ -349,7 +353,8 @@ class Router:
 
     def _observe(self, rep: Replica, lat_s: float,
                  model: str | None = None,
-                 length_class: str | None = None) -> None:
+                 length_class: str | None = None,
+                 trace: str | None = None) -> None:
         now = time.perf_counter()
         with self._lock:
             rep.requests += 1
@@ -358,13 +363,13 @@ class Router:
                 else (1 - self.EWMA_ALPHA) * rep.ewma_ms
                 + self.EWMA_ALPHA * lat_s * 1e3
             )
-            self._recent.append((now, lat_s))
+            self._recent.append((now, lat_s, trace))
             if len(self._recent) > self._recent_cap:
                 del self._recent[: self._recent_cap // 4]
             if model:
                 ms = self._mstats.setdefault(model, self._fresh_mstat())
                 ms["requests"] += 1
-                ms["recent"].append((now, lat_s))
+                ms["recent"].append((now, lat_s, trace))
                 if len(ms["recent"]) > self._recent_cap:
                     del ms["recent"][: self._recent_cap // 4]
             if length_class:
@@ -372,7 +377,7 @@ class Router:
                     length_class, self._fresh_lstat()
                 )
                 ls["requests"] += 1
-                ls["recent"].append((now, lat_s))
+                ls["recent"].append((now, lat_s, trace))
                 if len(ls["recent"]) > self._recent_cap:
                     del ls["recent"][: self._recent_cap // 4]
         self._lat.observe(lat_s)
@@ -382,27 +387,40 @@ class Router:
         self.registry.counter("fleet.requests").inc(1)
 
     def _try_dispatch(
-        self, payload: bytes, model: str | None, t0: float
+        self, payload: bytes, model: str | None, t0: float,
+        trace: tracectx.TraceContext | None = None, parent: str = "",
     ) -> tuple[bytes | None, bytes | None]:
         """The retry loop over one model's (or, with None, every)
         replica set: ``(response, last_busy)``. ``response`` is None when
         every candidate was busy, failed, or unroutable — the caller
         decides between overflow, verbatim rejection, and the router
-        error."""
+        error. A traced request (``trace``) is re-enveloped per attempt
+        with ``parent`` (the router's dispatch span) so the replica's
+        spans attach under it, and every failed attempt lands a
+        ``router.reroute`` span in the tree."""
         tried: set[int] = set()
         last_busy: bytes | None = None
+        wire = payload if trace is None else tracectx.wrap_payload(
+            trace.child(parent), payload
+        )
         while True:
             rep = self._pick(tried, model=model)
             if rep is None:
                 return None, last_busy
             with self._lock:
                 rep.inflight += 1
+            t_at = time.perf_counter()
             try:
-                resp = rep.roundtrip(payload, self.request_timeout_s)
+                resp = rep.roundtrip(wire, self.request_timeout_s)
             except (OSError, ValueError):
                 self._note_failure(rep)
                 self.registry.counter("fleet.rerouted").inc(1)
                 tried.add(rep.id)
+                tracectx.emit_trace_span(
+                    trace, "router.reroute", t_at,
+                    time.perf_counter() - t_at, parent=parent,
+                    replica=rep.id,
+                )
                 continue
             finally:
                 with self._lock:
@@ -418,7 +436,10 @@ class Router:
                     last_busy = resp
                     tried.add(rep.id)
                     continue
-            self._observe(rep, time.perf_counter() - t0, model=model)
+            self._observe(
+                rep, time.perf_counter() - t0, model=model,
+                trace=None if trace is None else trace.trace_id,
+            )
             return resp, last_busy
 
     def _count_rejected(self, model: str | None,
@@ -448,8 +469,37 @@ class Router:
         Transport failures reroute (idempotent requests); fleet-wide
         saturation returns the last replica's retry-after rejection
         VERBATIM; a fleet with nothing routable returns a router-level
-        error record in the same JSON shape."""
+        error record in the same JSON shape.
+
+        Traced payloads (tracectx.TRACE_MAGIC, outermost) are stripped
+        here; the routed attempt re-envelopes with the router's dispatch
+        span as the new parent, and one ``router.dispatch`` span (plus a
+        ``router.reroute`` per failed attempt) lands in this rank's
+        sink. Untraced payloads take the exact pre-tracing path."""
         t0 = time.perf_counter()
+        try:
+            trace, payload = tracectx.split_payload(payload)
+        except ValueError:
+            return json.dumps({"error": "bad_trace_envelope"}).encode()
+        dsid = "" if trace is None else tracectx.new_span_id()
+        resp = self._dispatch_routed(payload, t0, trace, dsid)
+        if trace is not None:
+            err = None
+            if resp.startswith(_ERROR_PREFIX):
+                try:
+                    err = json.loads(resp).get("error")
+                except (ValueError, AttributeError):
+                    err = "unparseable_error"
+            tracectx.emit_trace_span(
+                trace, "router.dispatch", t0, time.perf_counter() - t0,
+                span_id=dsid, ok=(err is None),
+                **({} if err is None else {"error": err}),
+            )
+        return resp
+
+    def _dispatch_routed(self, payload: bytes, t0: float,
+                         trace: tracectx.TraceContext | None,
+                         dsid: str) -> bytes:
         model, inner = protocol.split_model_envelope(payload)
         if model is not None:
             known = self.registered_models()
@@ -460,7 +510,9 @@ class Router:
                     "model": model,
                     "models": known,
                 }).encode()
-        resp, last_busy = self._try_dispatch(inner, model, t0)
+        resp, last_busy = self._try_dispatch(
+            inner, model, t0, trace=trace, parent=dsid
+        )
         if resp is not None:
             return resp
         if model is not None:
@@ -468,7 +520,9 @@ class Router:
                 mrec = self._models.get(model)
                 spill = mrec.get("overflow_to") if mrec else None
             if spill:
-                resp, spill_busy = self._try_dispatch(inner, spill, t0)
+                resp, spill_busy = self._try_dispatch(
+                    inner, spill, t0, trace=trace, parent=dsid
+                )
                 if resp is not None:
                     # the cheap model absorbed the overflow: a degraded
                     # answer beats a rejected one, and both sides count it
@@ -512,8 +566,30 @@ class Router:
         reached the client — still idempotent); after a partial stream
         the client gets a done frame carrying the error (re-running the
         prefix would emit duplicate tokens). Busy rejections pass through
-        verbatim when every replica rejects, the admission contract."""
+        verbatim when every replica rejects, the admission contract.
+
+        A traced generate frame (``"trace"`` in the ctrl JSON) has its
+        context re-pointed at the router's dispatch span before
+        forwarding, so the replica engine's spans attach under this hop;
+        the router lands ``router.pick`` per attempt, ``router.reroute``
+        per transport failure, and one ``router.dispatch`` covering the
+        whole relay. Untraced frames forward byte-identically."""
         t0 = time.perf_counter()
+        trace = None
+        if payload.startswith(protocol.CTRL_MAGIC):
+            try:
+                ctrl = protocol.parse_ctrl(payload)
+                trace = tracectx.from_fields((ctrl or {}).get("trace"))
+            except (ValueError, UnicodeDecodeError):
+                trace = None
+        dsid = "" if trace is None else tracectx.new_span_id()
+        if trace is not None:
+            # downstream spans parent onto the router's dispatch span —
+            # only TRACED frames are re-encoded; untraced bytes forward
+            # exactly as received
+            ctrl["trace"] = {"id": trace.trace_id, "parent": dsid,
+                             "origin": trace.origin}
+            payload = protocol.CTRL_MAGIC + json.dumps(ctrl).encode()
         if model is not None and model not in self.registered_models():
             self.registry.counter("fleet.unknown_model").inc(1)
             protocol.send_frame(client, json.dumps({
@@ -526,9 +602,15 @@ class Router:
         tried: set[int] = set()
         last_busy: bytes | None = None
         while True:
+            t_pick = time.perf_counter()
             rep = self._pick(tried, model=model)
             if rep is None:
                 break
+            tracectx.emit_trace_span(
+                trace, "router.pick", t_pick,
+                time.perf_counter() - t_pick, parent=dsid,
+                replica=rep.id,
+            )
             with self._lock:
                 rep.inflight += 1
             conn = None
@@ -568,8 +650,16 @@ class Router:
                         self._observe(
                             rep, time.perf_counter() - t0, model=model,
                             length_class=length_class,
+                            trace=None if trace is None
+                            else trace.trace_id,
                         )
                         self.registry.counter("fleet.streams").inc(1)
+                        tracectx.emit_trace_span(
+                            trace, "router.dispatch", t0,
+                            time.perf_counter() - t0, span_id=dsid,
+                            replica=rep.id, frames=streamed + 1,
+                            ok=not frame.startswith(_ERROR_PREFIX),
+                        )
                     protocol.send_frame(client, frame)
                     streamed += 1
                     if done:
@@ -580,9 +670,20 @@ class Router:
                 self._note_failure(rep)
                 self.registry.counter("fleet.rerouted").inc(1)
                 tried.add(rep.id)
+                tracectx.emit_trace_span(
+                    trace, "router.reroute", t_pick,
+                    time.perf_counter() - t_pick, parent=dsid,
+                    replica=rep.id, streamed=streamed,
+                )
                 if streamed:
                     # tokens already reached the client — re-running the
                     # request would duplicate them; fail THIS stream
+                    tracectx.emit_trace_span(
+                        trace, "router.dispatch", t0,
+                        time.perf_counter() - t0, span_id=dsid,
+                        replica=rep.id, frames=streamed, ok=False,
+                        error="replica_failed_mid_stream",
+                    )
                     try:
                         protocol.send_frame(client, json.dumps({
                             "stream": "done",
@@ -601,9 +702,17 @@ class Router:
                     conn.close()
         if last_busy is not None:
             self._count_rejected(model, length_class=length_class)
+            tracectx.emit_trace_span(
+                trace, "router.dispatch", t0, time.perf_counter() - t0,
+                span_id=dsid, ok=False, error="busy",
+            )
             protocol.send_frame(client, last_busy)
             return
         self.registry.counter("fleet.unroutable").inc(1)
+        tracectx.emit_trace_span(
+            trace, "router.dispatch", t0, time.perf_counter() - t0,
+            span_id=dsid, ok=False, error="no_routable_replicas",
+        )
         protocol.send_frame(client, json.dumps(
             {"error": "no_routable_replicas", "retry_after_ms": 1000.0}
         ).encode())
@@ -655,7 +764,17 @@ class Router:
         queued work — the autoscaler's observation."""
         cut = time.perf_counter() - window_s
         with self._lock:
-            lats = sorted(lat for (t, lat) in self._recent if t >= cut)
+            lats = sorted(
+                lat for (t, lat, _tr) in self._recent if t >= cut
+            )
+            # exemplar attribution (ISSUE 20): the worst <= 3 TRACED
+            # samples in the window, so a p99 breach names concrete
+            # trace ids instead of a bare percentile
+            exemplars = sorted(
+                ((lat, tr) for (t, lat, tr) in self._recent
+                 if t >= cut and tr),
+                reverse=True,
+            )[:3]
             queue_depth = sum(
                 r.inflight + int(r.stats.get("queue_depth", 0))
                 for r in self._replicas.values()
@@ -664,7 +783,7 @@ class Router:
             models = {}
             for name, ms in self._mstats.items():
                 mlats = sorted(
-                    lat for (t, lat) in ms["recent"] if t >= cut
+                    lat for (t, lat, _tr) in ms["recent"] if t >= cut
                 )
                 mrec = self._models.get(name) or {}
                 models[name] = {
@@ -678,7 +797,7 @@ class Router:
             # for targeted rows — referees per-class p99 unchanged
             for name, ls in self._lstats.items():
                 llats = sorted(
-                    lat for (t, lat) in ls["recent"] if t >= cut
+                    lat for (t, lat, _tr) in ls["recent"] if t >= cut
                 )
                 models[f"length:{name}"] = {
                     "samples": len(llats),
@@ -692,6 +811,11 @@ class Router:
             "p99_ms": round(percentile(lats, 0.99) * 1e3, 3),
             "queue_depth": queue_depth,
         }
+        if exemplars:
+            out["exemplars"] = [
+                {"trace": tr, "latency_ms": round(lat * 1e3, 3)}
+                for (lat, tr) in exemplars
+            ]
         if models:
             # per-model windowed p99 against its SLO target — what the
             # slo-breach rule reads (telemetry/live.py)
@@ -732,7 +856,7 @@ class Router:
             for name in sorted(names):
                 mrec = self._models.get(name) or {}
                 ms = self._mstats.get(name) or self._fresh_mstat()
-                mlats = [lat for (_t, lat) in ms["recent"]]
+                mlats = [lat for (_t, lat, _tr) in ms["recent"]]
                 models[name] = {
                     "slo_class": mrec.get("slo_class", "standard"),
                     "p99_slo_ms": mrec.get("p99_slo_ms"),
@@ -752,7 +876,7 @@ class Router:
                     "rejected": ls["rejected"],
                     "p99_ms": round(
                         percentile(
-                            [lat for (_t, lat) in ls["recent"]], 0.99
+                            [lat for (_t, lat, _tr) in ls["recent"]], 0.99
                         ) * 1e3, 3,
                     ),
                 }

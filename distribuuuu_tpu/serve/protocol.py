@@ -40,6 +40,7 @@ import numpy as np
 
 from distribuuuu_tpu.config import cfg
 from distribuuuu_tpu.serve.admission import EngineClosedError, QueueFullError
+from distribuuuu_tpu.telemetry import tracectx
 
 _NPY_MAGIC = b"\x93NUMPY"
 MAX_FRAME = 64 << 20  # refuse absurd frames before allocating for them
@@ -60,6 +61,14 @@ CTRL_MAGIC = b"\x00DTPUCTL1"
 # The router strips the envelope before forwarding — replicas serve the
 # same bytes they always did.
 MODEL_MAGIC = b"\x00DTPUMDL1"
+
+# Request-trace envelope (ISSUE 20): binary data payloads of TRACED
+# requests ride ``tracectx.TRACE_MAGIC + u16 len + ctx JSON + payload``,
+# OUTERMOST (a traced multi-model request is TRACE(MODEL(payload))).
+# Same NUL-lead disambiguation as the other two magics; untraced
+# payloads are byte-identical to the pre-tracing wire format. Traced
+# ``op="generate"`` ctrl frames instead embed ``"trace": {...}`` in the
+# ctrl JSON — peers that predate tracing ignore the extra key.
 
 
 def ctrl_request(op: str, **fields) -> bytes:
@@ -195,6 +204,21 @@ def _handle_conn(engine, conn: socket.socket, transform, topk: int) -> None:
                 return
             if payload is None:
                 return
+            trace = None
+            if payload.startswith(tracectx.TRACE_MAGIC):
+                # traced binary payload: strip the context so the inner
+                # bytes the engine sees are exactly the untraced bytes; a
+                # torn envelope gets a clean refusal, never a half-parse
+                try:
+                    trace, payload = tracectx.split_payload(payload)
+                except ValueError:
+                    try:
+                        send_frame(conn, json.dumps(
+                            {"error": "bad_trace_envelope"}
+                        ).encode())
+                    except OSError:
+                        return
+                    continue
             if payload.startswith(MODEL_MAGIC):
                 # a fleet router already routed this here; a direct client
                 # may also send enveloped requests — either way the replica
@@ -244,6 +268,7 @@ def _handle_conn(engine, conn: socket.socket, transform, topk: int) -> None:
                 except OSError:
                     return
                 continue
+            t_req = time.perf_counter()
             try:
                 fut = engine.submit(transform(payload))
                 logits = fut.result()
@@ -262,6 +287,11 @@ def _handle_conn(engine, conn: socket.socket, transform, topk: int) -> None:
                 resp = {"error": "draining"}
             except Exception as e:  # noqa: BLE001 — per-request fault isolation
                 resp = {"error": f"{type(e).__name__}: {e}"}
+            tracectx.emit_trace_span(
+                trace, "replica.handle", t_req,
+                time.perf_counter() - t_req,
+                ok=("error" not in resp),
+            )
             try:
                 send_frame(conn, json.dumps(resp).encode())
             except OSError:

@@ -21,6 +21,13 @@ Event mapping (trace-event format, JSON flavor):
 * compile          → ``ph:"X"`` on the ``jit`` track (ends at ``mono``)
 * stall/data_error/nonfinite → ``ph:"i"`` instants at their unix ``t``
 * rank/track names → ``ph:"M"`` process_name / thread_name metadata
+* trace.span       → ``ph:"X"`` on a synthetic per-REQUEST process
+  (ISSUE 20): spans for one trace id land under one ``trace <id>`` pid
+  regardless of which rank file they came from — each span's ``t0`` is
+  mapped through ITS OWN file's clock anchor, so a request's waterfall
+  (client edge, router hops, replica engine stages) reads left-to-right
+  on the shared unix timebase even though the stages ran in different
+  processes. The emitting rank rides along in ``args``.
 """
 
 from __future__ import annotations
@@ -52,6 +59,28 @@ def rank_files(run_dir: str) -> dict[int, str]:
         m = re.fullmatch(r"rank(\d+)\.jsonl", os.path.basename(p))
         if m:
             out[int(m.group(1))] = p
+    return out
+
+
+def fleet_rank_files(run_dir: str) -> list[tuple[int, str, str]]:
+    """[(pid, label, path)] for every per-rank telemetry file under
+    ``run_dir``, INCLUDING the serving fleet's nested per-model dirs
+    (``model_*/telemetry/rank*.jsonl`` — each replica process inherits a
+    dumped cfg whose OUT_DIR is the model dir, so its sink lands there,
+    not in the top-level telemetry dir). Top-level ranks keep
+    ``pid == rank``; nested replica files take pids from 100 up so a
+    fleet's replicas never collide with trainer ranks (synthetic
+    per-request trace pids start at 1000)."""
+    out = [(r, str(r), p) for r, p in sorted(rank_files(run_dir).items())]
+    pid = 100
+    for mdir in sorted(glob.glob(os.path.join(run_dir, "model_*"))):
+        model = os.path.basename(mdir)[len("model_"):]
+        pat = os.path.join(mdir, "telemetry", "rank*.jsonl")
+        for p in sorted(glob.glob(pat)):
+            m = re.fullmatch(r"rank(\d+)\.jsonl", os.path.basename(p))
+            if m:
+                out.append((pid, f"{model}/{m.group(1).lstrip('0') or '0'}", p))
+                pid += 1
     return out
 
 
@@ -105,7 +134,7 @@ def merge_trace(run_dir: str) -> dict:
     """Chrome-trace dict for a finished run directory. Raises
     FileNotFoundError when neither telemetry files nor metrics.jsonl
     exist — there is nothing to trace."""
-    files = rank_files(run_dir)
+    files = fleet_rank_files(run_dir)
     metrics_path = os.path.join(run_dir, "metrics.jsonl")
     if not files and not os.path.exists(metrics_path):
         raise FileNotFoundError(
@@ -115,15 +144,18 @@ def merge_trace(run_dir: str) -> dict:
     tracks = _Tracks()
     events: list[dict] = []
     anchors: dict[int, tuple[float, float]] = {}
+    # trace id -> anchor-mapped request spans (pids assigned at the end,
+    # above the rank pid range, in first-seen order)
+    trace_spans: dict[str, list[dict]] = {}
 
-    for rank, path in sorted(files.items()):
+    for rank, label, path in files:
         recs = read_jsonl(path)
         anc = _anchor(recs)
         if anc is not None:
             anchors[rank] = anc
         events.append({
             "name": "process_name", "ph": "M", "pid": rank,
-            "args": {"name": f"rank {rank}"},
+            "args": {"name": f"rank {label}"},
         })
 
         def to_us(mono: float) -> float:
@@ -150,6 +182,16 @@ def merge_trace(run_dir: str) -> dict:
                     "dur": round(dur_us, 3),
                     "pid": rank, "tid": tracks.tid(rank, "jit"),
                     "args": {"event": r.get("event", "")},
+                })
+            elif kind == "trace.span":
+                tid_ = str(r.get("trace", ""))
+                args = _span_args(r)
+                args["rank"] = label
+                trace_spans.setdefault(tid_, []).append({
+                    "name": r.get("name", "?"), "ph": "X", "cat": "trace",
+                    "ts": round(to_us(float(r["t0"])), 3),
+                    "dur": round(float(r["dur"]) * 1e6, 3),
+                    "args": args,
                 })
             elif kind in _INSTANT_KINDS:
                 events.append({
@@ -184,11 +226,27 @@ def merge_trace(run_dir: str) -> dict:
                              "batch": r.get("batch"), "n": r.get("n")},
                 })
 
+    # one synthetic process per traced request, pids above the rank
+    # range (ranks are small ints; 1000+ never collides)
+    for i, (tid_, evs) in enumerate(sorted(trace_spans.items())):
+        pid = 1000 + i
+        events.append({
+            "name": "process_name", "ph": "M", "pid": pid,
+            "args": {"name": f"trace {tid_}"},
+        })
+        tid = tracks.tid(pid, "request")
+        for ev in sorted(evs, key=lambda e: e["ts"]):
+            ev["pid"] = pid
+            ev["tid"] = tid
+            events.append(ev)
+
     return {
         "traceEvents": tracks.meta + events,
         "displayTimeUnit": "ms",
         "otherData": {"source": "distribuuuu_tpu telemetry/export.py",
-                      "ranks": sorted(set(files) | ({0} if os.path.exists(metrics_path) else set()))},
+                      "ranks": sorted({pid for pid, _, _ in files}
+                                      | ({0} if os.path.exists(metrics_path)
+                                         else set()))},
     }
 
 

@@ -539,6 +539,10 @@ def probe_serve(addr: tuple[str, int], window_s: float = 0.0,
         "degraded": int(stats.get("degraded", 0)),
         "replicas": int(stats.get("replicas", 1)),
         "routable": int(stats.get("routable", stats.get("replicas", 1) or 1)),
+        # worst traced requests in the window (router exemplar ring,
+        # ISSUE 20) — what p99-breach/backpressure alerts name as
+        # exemplar_trace_ids; empty against untraced peers
+        "exemplars": win.get("exemplars") or [],
         "models": win.get("models") or {
             # cumulative fallback when the peer has no windowed view:
             # normalize the router's stats() model rows to the shape the
@@ -802,14 +806,36 @@ class RuleEngine:
                     # clear_windows calm evaluations pass
                     rule.active = True
                     rule.fired += 1
-                    fired.append({
+                    alert = {
                         "rule": rule.kind,
                         "value": round(value, 4),
                         "threshold": self._limit(rule),
                         "window_s": rule.window_s or self.interval_s,
                         "breach_windows": rule.breach_windows,
                         "message": self._message(rule, value),
-                    })
+                    }
+                    if rule.kind in ("p99-breach", "backpressure"):
+                        # exemplar attribution (ISSUE 20): name the
+                        # worst <= 3 traced requests of the breaching
+                        # window so the alert points at concrete trace
+                        # ids (tools/trace_request.py renders them);
+                        # also land one trace.exemplar record per id in
+                        # the per-rank sink (no-op, telemetry off)
+                        exs = ((snap.get("serve") or {})
+                               .get("exemplars") or [])[:3]
+                        if exs:
+                            alert["exemplar_trace_ids"] = [
+                                e["trace"] for e in exs
+                            ]
+                            from distribuuuu_tpu.telemetry import spans
+
+                            for e in exs:
+                                spans.emit_event(
+                                    "trace.exemplar", v=1,
+                                    rule=rule.kind, trace=e["trace"],
+                                    latency_ms=e["latency_ms"],
+                                )
+                    fired.append(alert)
             else:
                 rule.breaches = 0
                 if rule.active:

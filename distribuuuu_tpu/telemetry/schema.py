@@ -189,6 +189,21 @@ KINDS: dict[str, frozenset] = {
     "serve.quantized": frozenset(
         {"arch", "mode", "bytes_before", "bytes_after", "leaves"}
     ),
+    # -- request-scoped tracing plane (telemetry/tracectx.py, ISSUE 20) --
+    # one stage of one traced request's span tree: `trace` is the fleet-
+    # wide trace id opened at the client edge, `span` this stage's id,
+    # `parent` the parent span id ("" at the root) — together the records
+    # from N rank files reassemble into one connected tree per request
+    # (export.py renders one track per request; tools/trace_request.py
+    # renders the waterfall). `t0` is THIS rank's mono clock (anchor-
+    # mapped like kind="span"); free-form extras carry stage detail
+    # (replica, tokens, chunk, reason, ...).
+    "trace.span": frozenset({"v", "trace", "span", "parent", "name",
+                             "t0", "dur"}),
+    # one per exemplar a fired alert names (ISSUE 20 satellite): the
+    # worst-latency trace ids inside the breaching window, so a p99
+    # breach points at concrete requests instead of a percentile
+    "trace.exemplar": frozenset({"v", "rule", "trace", "latency_ms"}),
 }
 
 

@@ -1,0 +1,211 @@
+"""Request-scoped trace context (ISSUE 20): the compact identity one
+request carries through the whole serving fleet — client edge → router →
+replica → engine — so every stage it touches can emit a ``trace.span``
+record into its OWN rank's telemetry sink and the per-rank files later
+reassemble into one connected span tree per request.
+
+The context is three fields:
+
+* ``trace_id``    — 16 hex chars minted once at the client/bench edge;
+                    this is the request's fleet-wide name (and, traced,
+                    the engine's ``request_id`` — one identity from the
+                    first frame to the done frame);
+* ``parent_span`` — span id of the sender's enclosing stage ("" at the
+                    root), so a hop's spans attach under the hop that
+                    dispatched it;
+* ``origin``      — unix stamp at trace open; lets consumers order
+                    traces without any rank file in hand.
+
+Carriage (serve/protocol.py):
+
+* ``op="generate"`` ctrl frames embed ``"trace": {...}`` directly in the
+  ctrl JSON (``to_fields``/``from_fields``) — peers that predate tracing
+  ignore unknown ctrl keys, so missing-context fallback is automatic;
+* binary data payloads (images, .npy batches) ride a NUL-lead envelope
+  ``TRACE_MAGIC + u16 length + ctx JSON + payload`` (``wrap_payload`` /
+  ``split_payload``), the same disambiguation trick as the model-routing
+  envelope: real payloads never start NUL, and the two magics differ
+  before the length byte. A torn envelope raises — callers answer with a
+  clean ``bad_trace_envelope`` error frame instead of guessing;
+* stream frames (token/done) echo ``trace_id`` so the client edge can
+  join its own latency observations to the server-side tree.
+
+Sampling is head-based and deterministic (``should_sample``): the
+decision is a pure function of the trace id, made ONCE where the trace
+is opened; downstream hops never re-decide, they only honor presence of
+the context. ``SERVE.TRACE_SAMPLE = 0.0`` (the default) keeps every
+frame byte-identical to the pre-tracing wire format — the trajectory-
+neutrality pin (traced run ≡ untraced, server math bit-identical) holds
+because tracing only ever ADDS ctrl keys and telemetry records, never
+touches RNG, jitted code, or scheduling decisions.
+"""
+
+from __future__ import annotations
+
+import itertools
+import json
+import os
+import struct
+import threading
+import zlib
+
+from distribuuuu_tpu.telemetry import spans
+
+TRACE_SCHEMA = 1
+
+# NUL-lead envelope magic for binary payloads; differs from the model
+# envelope (b"\x00DTPUMDL1") before the length field so a stripper for
+# one never half-parses the other.
+TRACE_MAGIC = b"\x00DTPUTRC1"
+
+_counter = itertools.count(1)
+_counter_lock = threading.Lock()
+
+
+class TraceContext:
+    """One request's trace identity. Immutable by convention — hops make
+    children via ``child()`` rather than mutating the parent."""
+
+    __slots__ = ("trace_id", "parent_span", "origin")
+
+    def __init__(self, trace_id: str, parent_span: str = "",
+                 origin: float = 0.0):
+        self.trace_id = str(trace_id)
+        self.parent_span = str(parent_span)
+        self.origin = float(origin)
+
+    def child(self, parent_span: str) -> "TraceContext":
+        """The context a downstream hop receives: same trace, the
+        caller's stage as the new parent."""
+        return TraceContext(self.trace_id, parent_span, self.origin)
+
+    def __repr__(self) -> str:  # pragma: no cover — debug aid
+        return (f"TraceContext({self.trace_id!r}, "
+                f"parent={self.parent_span!r})")
+
+
+def new_trace_id() -> str:
+    """16 hex chars of OS entropy — mint once at the client edge."""
+    return os.urandom(8).hex()
+
+
+def new_span_id() -> str:
+    """Process-unique span id (pid-tagged counter): cheap, ordered, and
+    collision-free across the fleet's processes."""
+    with _counter_lock:
+        n = next(_counter)
+    return f"{os.getpid():x}-{n:x}"
+
+
+def should_sample(trace_id: str, rate: float) -> bool:
+    """Head-based deterministic sampling: a pure function of the trace
+    id, so every edge that sees the same id makes the same decision.
+    ``rate`` is a probability in [0, 1]; 0 disables tracing entirely."""
+    if rate <= 0.0:
+        return False
+    if rate >= 1.0:
+        return True
+    return (zlib.crc32(trace_id.encode("ascii")) & 0xFFFFFFFF) \
+        < rate * 4294967296.0
+
+
+def open_trace(rate: float = 1.0, origin: float | None = None):
+    """Client-edge trace opener: mint an id, apply head-based sampling,
+    return a root ``TraceContext`` or None (unsampled ⇒ the request goes
+    on the wire byte-identical to an untraced one)."""
+    import time
+
+    tid = new_trace_id()
+    if not should_sample(tid, rate):
+        return None
+    return TraceContext(
+        tid, "", round(time.time() if origin is None else origin, 6)
+    )
+
+
+# -- ctrl-frame carriage (JSON-embedded) ---------------------------------
+
+def to_fields(ctx: TraceContext | None) -> dict:
+    """The ``"trace"`` value embedded in an ``op="generate"`` ctrl frame
+    (empty dict ⇒ caller should omit the key entirely)."""
+    if ctx is None:
+        return {}
+    return {"trace": {"id": ctx.trace_id, "parent": ctx.parent_span,
+                      "origin": ctx.origin}}
+
+
+def from_fields(obj) -> TraceContext | None:
+    """Tolerant decode of a ctrl frame's ``"trace"`` value: anything
+    that is not a dict with a string id is treated as absent — an
+    untraced (or garbled) peer degrades to the untraced path instead of
+    failing the request."""
+    if not isinstance(obj, dict):
+        return None
+    tid = obj.get("id")
+    if not isinstance(tid, str) or not tid:
+        return None
+    try:
+        origin = float(obj.get("origin", 0.0))
+    except (TypeError, ValueError):
+        origin = 0.0
+    parent = obj.get("parent", "")
+    return TraceContext(tid, parent if isinstance(parent, str) else "",
+                        origin)
+
+
+# -- binary-payload carriage (NUL-lead envelope) -------------------------
+
+def wrap_payload(ctx: TraceContext | None, payload: bytes) -> bytes:
+    """Prefix a binary payload with the trace envelope; None passes the
+    payload through untouched (the byte-identical untraced path)."""
+    if ctx is None:
+        return payload
+    blob = json.dumps(to_fields(ctx)["trace"],
+                      separators=(",", ":")).encode("utf-8")
+    if len(blob) > 0xFFFF:  # pragma: no cover — ids are 16 chars
+        raise ValueError("trace context too large for envelope")
+    return TRACE_MAGIC + struct.pack(">H", len(blob)) + blob + payload
+
+
+def split_payload(payload: bytes):
+    """``(ctx_or_None, inner_payload)``. A payload without the magic is
+    untraced and returned verbatim; a payload WITH the magic but torn
+    (truncated length/JSON) raises ValueError — the server answers with
+    an explicit error frame rather than feeding garbage to the engine."""
+    if not payload.startswith(TRACE_MAGIC):
+        return None, payload
+    off = len(TRACE_MAGIC)
+    if len(payload) < off + 2:
+        raise ValueError("torn trace envelope (no length)")
+    (n,) = struct.unpack_from(">H", payload, off)
+    off += 2
+    if len(payload) < off + n:
+        raise ValueError("torn trace envelope (truncated context)")
+    try:
+        ctx = from_fields(json.loads(payload[off:off + n]))
+    except (ValueError, UnicodeDecodeError) as e:
+        raise ValueError(f"torn trace envelope (bad context: {e})") from e
+    return ctx, payload[off + n:]
+
+
+# -- span emission -------------------------------------------------------
+
+def emit_trace_span(ctx, name: str, t0: float, dur: float,
+                    parent: str | None = None, span_id: str | None = None,
+                    **attrs) -> str:
+    """Emit one ``trace.span`` record into THIS rank's sink and return
+    its span id (callers thread it to children as ``parent``; a caller
+    that handed the id out to children BEFORE finishing passes it back
+    as ``span_id``). ``t0`` is this rank's ``time.perf_counter()`` stamp
+    — the exporter maps it through the file's clock anchor exactly like
+    ``kind="span"``. No-op (returns "") when the context is None or
+    telemetry is off: the untraced path stays free."""
+    if ctx is None or not spans.enabled():
+        return ""
+    sid = span_id or new_span_id()
+    spans.emit_event(
+        "trace.span", v=TRACE_SCHEMA, trace=ctx.trace_id, span=sid,
+        parent=ctx.parent_span if parent is None else parent,
+        name=name, t0=round(t0, 6), dur=round(dur, 6), **attrs,
+    )
+    return sid

@@ -463,6 +463,53 @@ def test_backpressure_rule_fires_on_rejected_growth():
     assert fired[0]["value"] == 20.0
 
 
+def test_p99_breach_alert_names_exemplar_traces():
+    """Exemplar attribution (ISSUE 20): a breaching window that carries
+    traced samples lands their ids on the alert as exemplar_trace_ids
+    (worst ≤ 3); an untraced window fires the same alert WITHOUT the
+    key — tracing never changes scoring, only attribution."""
+    base = {"window_samples": 9, "queue_depth": 0, "rejected": 0}
+    exs = [
+        {"trace": f"{i:016x}", "latency_ms": 900.0 - i} for i in range(3)
+    ]
+    engine = live.RuleEngine(
+        [live.AlertRule({"kind": "p99-breach", "threshold": 50.0})],
+        interval_s=1.0,
+    )
+    fired = engine.evaluate(
+        _snap(1, {**base, "p99_ms": 500.0, "exemplars": exs})
+    )
+    assert [f["rule"] for f in fired] == ["p99-breach"]
+    assert fired[0]["exemplar_trace_ids"] == [e["trace"] for e in exs]
+    # the alert record stays schema-valid with the free-form extra
+    schema.check_fields(
+        "alert", {"kind", "rank", "t", *fired[0].keys()}
+    )
+    # untraced window: same verdict, no attribution key
+    engine = live.RuleEngine(
+        [live.AlertRule({"kind": "p99-breach", "threshold": 50.0})],
+        interval_s=1.0,
+    )
+    fired = engine.evaluate(_snap(1, {**base, "p99_ms": 500.0}))
+    assert [f["rule"] for f in fired] == ["p99-breach"]
+    assert "exemplar_trace_ids" not in fired[0]
+
+
+def test_backpressure_alert_names_exemplar_traces():
+    engine = live.RuleEngine(
+        [live.AlertRule({"kind": "backpressure", "threshold": 10,
+                         "window_s": 2})], interval_s=1.0,
+    )
+    base = {"p99_ms": 5.0, "window_samples": 9, "queue_depth": 0}
+    exs = [{"trace": "ee" * 8, "latency_ms": 750.0}]
+    assert engine.evaluate(_snap(1, {**base, "rejected": 0})) == []
+    fired = engine.evaluate(
+        _snap(2, {**base, "rejected": 20, "exemplars": exs})
+    )
+    assert [f["rule"] for f in fired] == ["backpressure"]
+    assert fired[0]["exemplar_trace_ids"] == ["ee" * 8]
+
+
 def test_degrade_spill_rule_fires_on_degraded_growth():
     engine = live.RuleEngine(
         [live.AlertRule({"kind": "degrade-spill", "threshold": 5,

@@ -482,7 +482,7 @@ def test_autoscaler_step_acts_through_pool():
     router = Router()
     now = time.perf_counter()
     with router._lock:
-        router._recent = [(now, 0.5)] * 50  # 500 ms latencies, fresh
+        router._recent = [(now, 0.5, None)] * 50  # 500 ms, fresh, untraced
     pool = FakePool()
     scaler = Autoscaler(
         router, pool,

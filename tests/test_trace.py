@@ -1,0 +1,415 @@
+"""Request-scoped distributed tracing (ISSUE 20): deterministic
+head-based sampling, the trace envelope and ctrl-frame carriage, span
+propagation client edge → router → replica engine (including a reroute
+hop), the traced ≡ untraced bit-identity pin, and the committed
+TRACE_r01.json artifact."""
+
+import glob
+import json
+import os
+import socket
+import sys
+import threading
+
+import jax
+import numpy as np
+import pytest
+
+from distribuuuu_tpu.config import cfg
+from distribuuuu_tpu.telemetry import schema, tracectx
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _tools():
+    tools = os.path.join(REPO, "tools")
+    if tools not in sys.path:
+        sys.path.insert(0, tools)
+    return tools
+
+
+@pytest.fixture()
+def f32(monkeypatch):
+    cfg.DEVICE.COMPUTE_DTYPE = "float32"
+    yield
+
+
+# ------------------------------------------------------------- the context
+
+
+def test_sampling_is_deterministic_and_proportional():
+    """The head-based decision is a pure function of the trace id (every
+    edge that sees the same id agrees) and hits the requested rate over
+    many ids; 0 disables, 1 keeps everything."""
+    ids = [f"{i:016x}" for i in range(2000)]
+    assert not any(tracectx.should_sample(t, 0.0) for t in ids)
+    assert all(tracectx.should_sample(t, 1.0) for t in ids)
+    kept = [t for t in ids if tracectx.should_sample(t, 0.5)]
+    assert kept == [t for t in ids if tracectx.should_sample(t, 0.5)]
+    assert 0.40 < len(kept) / len(ids) < 0.60
+    assert tracectx.open_trace(0.0) is None  # rate 0: nothing opens
+
+
+def test_envelope_roundtrip_torn_and_model_passthrough():
+    from distribuuuu_tpu.serve import protocol
+
+    ctx = tracectx.TraceContext("aa" * 8, "span-1", 123.5)
+    wire = tracectx.wrap_payload(ctx, b"payload-bytes")
+    back, inner = tracectx.split_payload(wire)
+    assert inner == b"payload-bytes"
+    assert (back.trace_id, back.parent_span, back.origin) == \
+        (ctx.trace_id, ctx.parent_span, ctx.origin)
+    # untraced passthrough is byte-identical in both directions
+    assert tracectx.wrap_payload(None, b"x") == b"x"
+    assert tracectx.split_payload(b"x") == (None, b"x")
+    # the model envelope's magic is NOT a trace envelope
+    menv = protocol.model_envelope("m", b"img")
+    assert tracectx.split_payload(menv) == (None, menv)
+    # torn envelopes refuse loudly instead of feeding garbage onward
+    for torn in (wire[:10], wire[:12], tracectx.TRACE_MAGIC + b"\xff\xff"):
+        with pytest.raises(ValueError, match="torn trace envelope"):
+            tracectx.split_payload(torn)
+
+
+def test_from_fields_tolerates_garbled_peers():
+    assert tracectx.from_fields(None) is None
+    assert tracectx.from_fields("nope") is None
+    assert tracectx.from_fields({}) is None
+    assert tracectx.from_fields({"id": 3}) is None
+    ctx = tracectx.from_fields(
+        {"id": "ab" * 8, "parent": 7, "origin": "bad"}
+    )
+    assert ctx is not None
+    assert ctx.parent_span == "" and ctx.origin == 0.0
+
+
+def test_trace_kinds_declared_and_span_record_validates(tmp_path):
+    from distribuuuu_tpu.telemetry import close_telemetry, setup_telemetry
+
+    assert "trace.span" in schema.KINDS
+    assert "trace.exemplar" in schema.KINDS
+    ctx = tracectx.TraceContext(tracectx.new_trace_id())
+    setup_telemetry(str(tmp_path / "telemetry"), rank=0)
+    try:
+        sid = tracectx.emit_trace_span(ctx, "unit", 1.0, 0.5, slot=3)
+        assert sid
+        assert tracectx.emit_trace_span(None, "unit", 1.0, 0.5) == ""
+    finally:
+        close_telemetry()
+    recs = [
+        json.loads(line)
+        for p in glob.glob(str(tmp_path / "telemetry" / "rank*.jsonl"))
+        for line in open(p)
+    ]
+    spans_ = [r for r in recs if r.get("kind") == "trace.span"]
+    assert len(spans_) == 1 and spans_[0]["span"] == sid
+    assert spans_[0]["slot"] == 3
+    for r in recs:
+        schema.validate_record(r)
+
+
+# ------------------------------------------- propagation over real sockets
+
+
+def _tiny_engine():
+    import jax.numpy as jnp
+
+    from distribuuuu_tpu.lm.generate import GenerateEngine
+    from distribuuuu_tpu.models.gpt import GPT
+
+    model = GPT(vocab_size=320, seq_len=32, dim=32, depth=2, num_heads=2,
+                dtype=jnp.float32)
+    params = model.init(
+        jax.random.key(0), model.dummy_input(), train=False
+    )["params"]
+    return GenerateEngine(
+        model, {"params": params}, prompt_len=8, max_new_tokens=6,
+        batch_tiles=[2], cache_tiles=[16],
+    )
+
+
+def test_traced_fleet_stream_builds_connected_tree(f32, tmp_path):
+    """One traced generate stream over a 2-port fleet behind the real
+    router (framed sockets end to end) with a dead replica forced into
+    the pick order: the per-rank sink ends up holding ONE connected span
+    tree containing the client edge, the router's pick/reroute/dispatch
+    hops, and the engine's queue/prefill/decode spans — and the traced
+    stream's tokens equal the untraced control's (the bit-identity
+    pin)."""
+    from distribuuuu_tpu.lm import service as lm_service
+    from distribuuuu_tpu.serve import protocol
+    from distribuuuu_tpu.serve.fleet.router import Router
+    from distribuuuu_tpu.telemetry import close_telemetry, setup_telemetry
+
+    eng = _tiny_engine().start()
+    listeners = [protocol.open_listener("127.0.0.1", 0) for _ in range(2)]
+    stop = threading.Event()
+    for ln in listeners:
+        threading.Thread(
+            target=protocol.serve_forever, args=(eng, ln, stop.is_set),
+            daemon=True,
+        ).start()
+    # a dead replica: a closed listener's port refuses connections
+    dead = protocol.open_listener("127.0.0.1", 0)
+    dead_port = dead.getsockname()[1]
+    dead.close()
+
+    router = Router(request_timeout_s=30.0)
+    dead_rep = router.add_replica("127.0.0.1", dead_port)
+    router.mark_routable(dead_rep.id)
+    live_ids = []
+    for ln in listeners:
+        rep = router.add_replica("127.0.0.1", ln.getsockname()[1])
+        router.mark_routable(rep.id)
+        live_ids.append(rep.id)
+    with router._lock:
+        # bias the pick order: the dead replica looks least loaded, so
+        # the traced stream MUST take a reroute hop before landing
+        for rep_id in live_ids:
+            router._replicas[rep_id].inflight += 4
+
+    client_listener = protocol.open_listener("127.0.0.1", 0)
+    client_port = client_listener.getsockname()[1]
+    threading.Thread(
+        target=router.serve, args=(client_listener, stop.is_set),
+        daemon=True,
+    ).start()
+
+    prompt = [5, 7, 11]
+    setup_telemetry(str(tmp_path / "telemetry"), rank=0)
+    try:
+        ctx = tracectx.TraceContext(tracectx.new_trace_id())
+        frames = list(lm_service.generate_request(
+            "127.0.0.1", client_port, tokens=prompt, max_new_tokens=4,
+            trace=ctx,
+        ))
+        done = frames[-1]
+        assert done["stream"] == "done" and "error" not in done
+        # identity unification (satellite 1): every stream frame echoes
+        # the trace id — the engine request and the wire share one name
+        assert done["trace_id"] == ctx.trace_id
+        assert all(f["trace_id"] == ctx.trace_id for f in frames)
+        # untraced control: same prompt, byte-identical greedy tokens
+        control = list(lm_service.generate_request(
+            "127.0.0.1", client_port, tokens=prompt, max_new_tokens=4,
+        ))
+        assert control[-1]["tokens"] == done["tokens"]
+        assert "trace_id" not in control[-1]
+    finally:
+        stop.set()
+        close_telemetry()
+        eng.drain()
+        client_listener.close()
+
+    _tools()
+    import trace_request
+
+    traces = trace_request.collect_traces(str(tmp_path))
+    assert set(traces) == {ctx.trace_id}  # the control left no spans
+    spans_ = traces[ctx.trace_id]
+    names = {s["name"] for s in spans_}
+    assert {"client.request", "router.pick", "router.reroute",
+            "router.dispatch", "engine.request", "queue_wait",
+            "prefill", "decode_step"} <= names
+    assert trace_request.is_connected(spans_)
+    # exactly one reroute hop (the dead replica), parented on dispatch
+    reroutes = [s for s in spans_ if s["name"] == "router.reroute"]
+    dispatch = next(s for s in spans_ if s["name"] == "router.dispatch")
+    assert len(reroutes) == 1
+    assert reroutes[0]["parent"] == dispatch["span"]
+    assert reroutes[0]["replica"] == dead_rep.id
+    # the engine hop hangs under the router hop, the router hop under
+    # the client edge — a connected tree across all three layers
+    engine_span = next(s for s in spans_ if s["name"] == "engine.request")
+    client_span = next(s for s in spans_ if s["name"] == "client.request")
+    assert engine_span["parent"] == dispatch["span"]
+    assert dispatch["parent"] == client_span["span"]
+    assert client_span["parent"] == ""
+    sh = trace_request.stage_shares(spans_)
+    assert sh["total_source"] == "router.dispatch"
+    assert sh["shares"] and sh["stage_sum_ms"] > 0
+    # the waterfall renders without error and names every stage
+    text = trace_request.render_waterfall(ctx.trace_id, spans_)
+    assert "client.request" in text and "stage shares" in text
+    # exemplar plumbing: the router's ring kept the trace id and the
+    # windowed stats name it
+    win = router.window_stats(60.0)
+    assert [e["trace"] for e in win["exemplars"]] == [ctx.trace_id]
+    assert win["exemplars"][0]["latency_ms"] > 0
+
+
+def test_untraced_frames_forward_byte_identically(f32):
+    """The trajectory-neutrality pin at the wire level: with tracing off
+    nothing re-encodes — the router forwards the EXACT ctrl bytes it
+    received, and a traced client against an old (trace-ignorant)
+    replica still streams fine (missing-context fallback)."""
+    from distribuuuu_tpu.lm import service as lm_service
+    from distribuuuu_tpu.serve import protocol
+    from distribuuuu_tpu.serve.fleet.router import Router
+
+    seen: list[bytes] = []
+    rep_listener = protocol.open_listener("127.0.0.1", 0)
+
+    def fake_replica():
+        # a pre-tracing replica: ignores unknown ctrl keys, never echoes
+        for _ in range(2):
+            conn, _ = rep_listener.accept()
+            with conn:
+                payload = protocol.recv_frame(conn)
+                seen.append(payload)
+                protocol.send_frame(conn, json.dumps(
+                    {"stream": "token", "token": 9, "i": 0}
+                ).encode())
+                protocol.send_frame(conn, json.dumps({
+                    "stream": "done", "tokens": [9], "n": 1,
+                    "reason": "max_new_tokens",
+                }).encode())
+
+    threading.Thread(target=fake_replica, daemon=True).start()
+    router = Router(request_timeout_s=10.0)
+    rep = router.add_replica("127.0.0.1", rep_listener.getsockname()[1])
+    router.mark_routable(rep.id)
+    client_listener = protocol.open_listener("127.0.0.1", 0)
+    port = client_listener.getsockname()[1]
+    stop = threading.Event()
+    threading.Thread(
+        target=router.serve, args=(client_listener, stop.is_set),
+        daemon=True,
+    ).start()
+    try:
+        # untraced: the replica receives the client's bytes verbatim
+        sent = protocol.ctrl_request("generate", tokens=[1],
+                                     max_new_tokens=1)
+        with socket.create_connection(("127.0.0.1", port), 10) as c:
+            protocol.send_frame(c, sent)
+            while True:
+                frame = protocol.recv_frame(c)
+                if b'"stream": "done"' in frame[:64]:
+                    break
+        assert seen[0] == sent
+        # traced against a trace-ignorant replica: stream still works,
+        # the done frame just lacks the echo
+        frames = list(lm_service.generate_request(
+            "127.0.0.1", port, tokens=[1], max_new_tokens=1,
+            trace=tracectx.TraceContext("ff" * 8),
+        ))
+        assert frames[-1]["stream"] == "done"
+        assert "trace_id" not in frames[-1]
+        ctrl = protocol.parse_ctrl(seen[1])
+        assert ctrl["trace"]["id"] == "ff" * 8  # context DID travel
+    finally:
+        stop.set()
+        rep_listener.close()
+        client_listener.close()
+
+
+def test_torn_trace_envelope_refused_cleanly():
+    """A torn binary-payload envelope gets an explicit error frame from
+    both the router and a replica server — never half-parsed bytes."""
+    from distribuuuu_tpu.serve.fleet.router import Router
+
+    router = Router()
+    resp = json.loads(router.dispatch(tracectx.TRACE_MAGIC + b"\xff\xff"))
+    assert resp["error"] == "bad_trace_envelope"
+
+
+# --------------------------------------------------- engine-side span tree
+
+
+def test_engine_spans_attribute_residency_and_unify_request_id(f32,
+                                                               tmp_path):
+    """Traced engine submissions: the trace id IS the request id
+    (satellite 1); queue/prefill/decode spans parent onto the
+    engine.request span; wall-clock residency makes the stage sum track
+    the request's engine latency; untraced co-residents emit nothing."""
+    from distribuuuu_tpu.telemetry import close_telemetry, setup_telemetry
+
+    eng = _tiny_engine().start()
+    rng = np.random.default_rng(7)
+    setup_telemetry(str(tmp_path / "telemetry"), rank=0)
+    try:
+        ctx = tracectx.TraceContext(tracectx.new_trace_id(), "edge-span")
+        traced = eng.submit(
+            rng.integers(0, 256, (4,)).astype(np.int32),
+            max_new_tokens=4, trace=ctx,
+        )
+        plain = eng.submit(
+            rng.integers(0, 256, (4,)).astype(np.int32), max_new_tokens=4
+        )
+        traced.result(timeout=120.0)
+        plain.result(timeout=120.0)
+        assert traced.request_id == ctx.trace_id
+        assert plain.request_id != ctx.trace_id
+        eng.drain()
+    finally:
+        close_telemetry()
+
+    _tools()
+    import trace_request
+
+    traces = trace_request.collect_traces(str(tmp_path))
+    assert set(traces) == {ctx.trace_id}  # untraced neighbor: silent
+    spans_ = traces[ctx.trace_id]
+    root = next(s for s in spans_ if s["name"] == "engine.request")
+    assert root["parent"] == "edge-span"
+    assert root["new_tokens"] == 4 and root["length_class"]
+    for s in spans_:
+        if s["name"] in ("queue_wait", "prefill", "decode_step"):
+            assert s["parent"] == root["span"]
+    sh = trace_request.stage_shares(spans_)
+    assert sh["total_source"] == "engine.request"
+    # residency attribution: stages cover most of the engine wall
+    assert 0.2 <= sh["stage_sum_ms"] / sh["total_ms"] <= 1.2
+    assert sh["length_class"] == root["length_class"]
+    bd = trace_request.breakdown_by_class(traces)
+    assert bd[root["length_class"]]["requests"] == 1
+
+
+# ------------------------------------------------- the committed artifact
+
+
+def _artifact():
+    path = os.path.join(REPO, "TRACE_r01.json")
+    with open(path) as f:
+        return json.load(f)
+
+
+def test_committed_trace_artifact_names_exemplars():
+    """TRACE_r01.json: a real 2-replica fleet under campaign traffic
+    raised at least one p99 breach that NAMES its worst traced requests,
+    and every named exemplar resolves to a captured trace."""
+    art = _artifact()
+    assert art["ok"] is True
+    assert art["fleet"]["replicas"] == 2
+    breaches = [
+        a for a in art["alerts"]
+        if a["rule"] in ("p99-breach", "backpressure")
+        and a.get("exemplar_trace_ids")
+    ]
+    assert breaches, "no exemplar-named breach in the artifact"
+    captured = set(art["traces"])
+    for a in breaches:
+        assert 1 <= len(a["exemplar_trace_ids"]) <= 3
+        assert set(a["exemplar_trace_ids"]) <= captured
+
+
+def test_committed_trace_artifact_waterfall_is_complete():
+    """The exemplar trace renders as a complete waterfall: a connected
+    tree whose stage spans sum to the router-observed latency within the
+    pinned tolerance, and the traced run served outputs bit-identical
+    to the untraced control."""
+    art = _artifact()
+    ex = art["exemplar"]
+    assert ex["connected"] is True
+    assert ex["shares"]["total_source"] == "router.dispatch"
+    ratio = ex["shares"]["stage_sum_ms"] / ex["shares"]["total_ms"]
+    assert art["stage_sum_tolerance"][0] <= ratio \
+        <= art["stage_sum_tolerance"][1]
+    assert set(ex["span_names"]) >= {
+        "client.request", "router.dispatch", "engine.request",
+        "queue_wait", "decode_step",
+    }
+    assert art["identity"]["traced_equals_untraced"] is True
+    assert art["identity"]["requests_compared"] >= 1
+    # per-span overhead stays under the 500µs ceiling (PERF.md pin)
+    assert 0 < art["overhead"]["per_span_us"] < 500.0

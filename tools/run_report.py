@@ -307,6 +307,39 @@ def _lm_section(ranks: dict[int, list[dict]]) -> dict | None:
     return out
 
 
+def _trace_section(run_dir: str, ranks: dict[int, list[dict]]) -> dict | None:
+    """The request-tracing plane (ISSUE 20): per-length-class p50/p99 of
+    total latency and of each stage's SHARE of it (queue wait, prefill,
+    decode residency, speculation), computed from the ``trace.span``
+    records tools/trace_request.py reassembles. The share percentiles
+    answer "where do slow requests spend their time" without opening a
+    single waterfall. None when the run was untraced."""
+    if not any(
+        r.get("kind") == "trace.span" for recs in ranks.values()
+        for r in recs
+    ):
+        return None
+    import trace_request
+
+    traces = trace_request.collect_traces(run_dir)
+    breakdown = trace_request.breakdown_by_class(traces)
+    exemplars = sorted(
+        {
+            str(r.get("trace")) for recs in ranks.values() for r in recs
+            if r.get("kind") == "trace.exemplar"
+        }
+    )
+    return {
+        "requests": len(traces),
+        "connected": sum(
+            1 for spans in traces.values()
+            if trace_request.is_connected(spans)
+        ),
+        "by_length_class": breakdown,
+        "exemplar_trace_ids": exemplars or None,
+    }
+
+
 def _campaign_section(ranks: dict[int, list[dict]]) -> dict | None:
     """The traffic-campaign plane (serve/campaign/): per-campaign verdicts
     (``campaign.verdict``), per-phase expected-vs-raised alert gates
@@ -637,6 +670,7 @@ def build_report(run_dir: str, phase: str = "train") -> dict:
         "lm": _lm_section(ranks),
         "kernels": _kernels_section(ranks),
         "campaign": _campaign_section(ranks),
+        "trace": _trace_section(run_dir, ranks),
     }
     return report
 
@@ -894,6 +928,22 @@ def _print_report(rep: dict) -> None:
                       f"max {row['max_wait_s']}s, "
                       f"{row['deadline_misses']} deadline miss(es)"
                       + flags)
+    tr = rep.get("trace")
+    if tr:
+        print(f"request tracing: {tr['requests']} traced request(s), "
+              f"{tr['connected']} with connected span trees"
+              + (f", exemplars: {', '.join(tr['exemplar_trace_ids'])}"
+                 if tr.get("exemplar_trace_ids") else ""))
+        for lc, row in (tr.get("by_length_class") or {}).items():
+            sh = row["shares"]
+            mix = "  ".join(
+                f"{k} p50 {sh[k]['p50'] * 100:.0f}%/p99 "
+                f"{sh[k]['p99'] * 100:.0f}%"
+                for k in ("queue", "prefill", "decode", "speculation")
+            )
+            print(f"  class {lc:<8} n={row['requests']:<4} total p50 "
+                  f"{row['total_ms_p50']}ms p99 {row['total_ms_p99']}ms  "
+                  f"{mix}")
     camp = rep.get("campaign")
     if camp:
         verdict = {True: "PASS", False: "FAIL", None: "n/a"}[camp["ok"]]

@@ -242,7 +242,8 @@ def run_campaign(path: str, work: str, log) -> dict:
 
     try:
         runner = CampaignRunner(
-            spec, fleet.router, payload_for=payload_for, fleet=fleet
+            spec, fleet.router, payload_for=payload_for, fleet=fleet,
+            trace_sample=cfg.SERVE.TRACE_SAMPLE,
         )
         verdict = runner.run()
     finally:

@@ -1,0 +1,319 @@
+"""Render one traced request as a waterfall (ISSUE 20).
+
+The serving fleet's ``trace.span`` records are scattered across the
+per-rank telemetry files — the client edge, the router, and each replica
+engine all write into their OWN rank's sink. This tool reassembles them:
+every span's ``t0`` (a rank-local ``perf_counter`` stamp) is mapped
+through its file's ``kind="clock"`` anchor onto the shared unix
+timebase, spans are grouped by trace id, and the parent links rebuild
+the request's span tree.
+
+    # which traced requests does this run hold? (slowest first)
+    python tools/trace_request.py out/ --list
+
+    # the waterfall an alert's exemplar_trace_ids points at:
+    python tools/trace_request.py out/ 1f00c0ffee42dead
+
+    # machine-readable (tests, artifact generation):
+    python tools/trace_request.py out/ 1f00c0ffee42dead --json
+
+The waterfall shows, per span, its offset bar on the request's wall,
+duration, emitting rank, and attributes; long runs of sibling
+``decode_step`` spans are collapsed to a summary line (``--full`` shows
+every one). The header prints the stage SHARES — what fraction of the
+request's total latency went to admission-queue wait, prefill (dense or
+chunked), decode residency, and speculation rounds — the four numbers
+that tell you which knob to turn (docs/RUNBOOK.md, "Tracing a slow
+request").
+
+The share/grouping functions are a library too: ``run_report.py``
+imports them for the per-request latency-breakdown section, and
+``tests/test_trace.py`` pins them against the committed TRACE_r01.json.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+
+import _path  # noqa: F401  (repo root onto sys.path)
+
+from distribuuuu_tpu.telemetry import export
+from distribuuuu_tpu.telemetry.registry import percentile
+
+# span name -> stage bucket (wall-clock residency attribution: a traced
+# request resident in a batched decode step owns that step's full
+# duration, so per-request stage sums approximate the router-observed
+# latency — the TRACE_r01.json tolerance check)
+STAGE_BUCKETS = ("queue", "prefill", "decode", "speculation")
+_STAGE_OF = {
+    "queue_wait": "queue",
+    "prefill": "prefill",
+    "chunk_prefill": "prefill",
+    "decode_step": "decode",
+    "spec_round": "speculation",
+}
+# total-latency source, most authoritative first: the router saw the
+# whole hop; the client edge includes its own socket; the engine span
+# excludes router queueing
+_TOTAL_PREFERENCE = ("router.dispatch", "client.request", "engine.request")
+
+_META_KEYS = frozenset({
+    "kind", "rank", "t", "v", "trace", "span", "parent", "name",
+    "t0", "dur", "t0_unix",
+})
+
+
+def collect_traces(run_dir: str) -> dict[str, list[dict]]:
+    """{trace_id: [span records]} across ALL rank files — the top-level
+    telemetry dir AND the fleet's nested per-model replica dirs
+    (``model_*/telemetry``) — each span annotated with its emitting
+    ``rank`` label and anchor-mapped ``t0_unix`` (spans per trace sorted
+    by wall-clock start)."""
+    traces: dict[str, list[dict]] = {}
+    for _pid, label, path in export.fleet_rank_files(run_dir):
+        recs = export.read_jsonl(path)
+        anc = export._anchor(recs)
+        for r in recs:
+            if r.get("kind") != "trace.span":
+                continue
+            s = dict(r)
+            s["rank"] = label
+            t0 = float(r["t0"])
+            s["t0_unix"] = (anc[0] + (t0 - anc[1])) if anc else t0
+            traces.setdefault(str(r["trace"]), []).append(s)
+    for spans in traces.values():
+        spans.sort(key=lambda s: s["t0_unix"])
+    return traces
+
+
+def is_connected(spans: list[dict]) -> bool:
+    """Every span's parent is either "" (a root) or another span of the
+    SAME trace — i.e. the cross-process tree reassembled with no orphans
+    (the propagation pin tests/test_trace.py asserts on a real fleet)."""
+    ids = {s["span"] for s in spans}
+    return all((s.get("parent") or "") in ids or not s.get("parent")
+               for s in spans)
+
+
+def stage_shares(spans: list[dict]) -> dict:
+    """Per-stage seconds and shares-of-total for one trace. ``total_ms``
+    comes from the most authoritative root span present (router >
+    client edge > engine); shares are empty when no root was captured
+    (e.g. a trace torn mid-run)."""
+    sums = dict.fromkeys(STAGE_BUCKETS, 0.0)
+    for s in spans:
+        b = _STAGE_OF.get(str(s.get("name")))
+        if b:
+            sums[b] += float(s["dur"])
+    total_s = None
+    src = None
+    for name in _TOTAL_PREFERENCE:
+        root = next((s for s in spans if s["name"] == name), None)
+        if root is not None:
+            total_s, src = float(root["dur"]), name
+            break
+    eng = next((s for s in spans if s["name"] == "engine.request"), None)
+    return {
+        "total_ms": None if total_s is None else round(total_s * 1e3, 3),
+        "total_source": src,
+        "stage_ms": {k: round(v * 1e3, 3) for k, v in sums.items()},
+        "stage_sum_ms": round(sum(sums.values()) * 1e3, 3),
+        "shares": (
+            {k: round(v / total_s, 4) for k, v in sums.items()}
+            if total_s else {}
+        ),
+        "length_class": None if eng is None else eng.get("length_class"),
+        "new_tokens": None if eng is None else eng.get("new_tokens"),
+        "spans": len(spans),
+    }
+
+
+def breakdown_by_class(traces: dict[str, list[dict]]) -> dict | None:
+    """p50/p99 of total latency and of each stage's share, per length
+    class — run_report.py's per-request latency-breakdown section.
+    None when the run holds no complete traces."""
+    shares: dict[str, dict[str, list[float]]] = {}
+    totals: dict[str, list[float]] = {}
+    for spans in traces.values():
+        sh = stage_shares(spans)
+        if sh["total_ms"] is None:
+            continue
+        lc = str(sh["length_class"] or "unknown")
+        cls = shares.setdefault(lc, {k: [] for k in STAGE_BUCKETS})
+        for k in STAGE_BUCKETS:
+            cls[k].append(sh["shares"].get(k, 0.0))
+        totals.setdefault(lc, []).append(sh["total_ms"])
+    if not totals:
+        return None
+    out = {}
+    for lc in sorted(totals):
+        t = sorted(totals[lc])
+        row = {
+            "requests": len(t),
+            "total_ms_p50": round(percentile(t, 0.50), 3),
+            "total_ms_p99": round(percentile(t, 0.99), 3),
+            "shares": {},
+        }
+        for k in STAGE_BUCKETS:
+            vals = sorted(shares[lc][k])
+            row["shares"][k] = {
+                "p50": round(percentile(vals, 0.50), 4),
+                "p99": round(percentile(vals, 0.99), 4),
+            }
+        out[lc] = row
+    return out
+
+
+# ------------------------------------------------------------- rendering
+def _tree(spans: list[dict]):
+    """(roots, {span_id: sorted children}) — a parent outside the trace
+    (lost rank file) demotes its children to roots rather than dropping
+    them."""
+    ids = {s["span"] for s in spans}
+    children: dict[str, list[dict]] = {}
+    roots: list[dict] = []
+    for s in spans:
+        p = s.get("parent") or ""
+        if p and p in ids:
+            children.setdefault(p, []).append(s)
+        else:
+            roots.append(s)
+    for v in children.values():
+        v.sort(key=lambda s: s["t0_unix"])
+    roots.sort(key=lambda s: s["t0_unix"])
+    return roots, children
+
+
+def _collapse(sibs: list[dict], full: bool):
+    """Collapse long runs of same-name siblings (decode steps) to
+    first-3 + summary; ``full`` disables."""
+    if full or len(sibs) <= 8:
+        return sibs, None
+    runs: dict[str, list[dict]] = {}
+    for s in sibs:
+        runs.setdefault(str(s["name"]), []).append(s)
+    name, run = max(runs.items(), key=lambda kv: len(kv[1]))
+    if len(run) <= 8:
+        return sibs, None
+    hidden = run[3:]
+    keep = [s for s in sibs if s not in hidden]
+    note = (name, len(hidden), sum(float(s["dur"]) for s in hidden))
+    return keep, note
+
+
+def render_waterfall(trace_id: str, spans: list[dict], width: int = 40,
+                     full: bool = False) -> str:
+    t_open = min(s["t0_unix"] for s in spans)
+    t_close = max(s["t0_unix"] + float(s["dur"]) for s in spans)
+    wall = max(t_close - t_open, 1e-9)
+    roots, children = _tree(spans)
+    sh = stage_shares(spans)
+    lines = [
+        f"trace {trace_id}  total "
+        + ("n/a" if sh["total_ms"] is None
+           else f"{sh['total_ms']}ms ({sh['total_source']})")
+        + f"  spans {len(spans)}"
+        + ("" if is_connected(spans) else "  [DISCONNECTED]")
+    ]
+    if sh["shares"]:
+        lines.append(
+            "  stage shares: "
+            + "  ".join(f"{k} {sh['shares'][k] * 100:.1f}%"
+                        for k in STAGE_BUCKETS)
+            + f"  (stage sum {sh['stage_sum_ms']}ms)"
+        )
+    if sh["length_class"]:
+        lines.append(f"  length class: {sh['length_class']}  "
+                     f"new tokens: {sh['new_tokens']}")
+
+    def emit(s: dict, depth: int) -> None:
+        off = s["t0_unix"] - t_open
+        dur = float(s["dur"])
+        a = min(int(off / wall * width), width - 1)
+        b = max(1, min(int(round(dur / wall * width)), width - a))
+        bar = " " * a + "#" * b
+        extras = " ".join(
+            f"{k}={v}" for k, v in sorted(s.items()) if k not in _META_KEYS
+        )
+        lines.append(
+            f"  [{bar:<{width}}] {'  ' * depth}{s['name']:<16} "
+            f"{dur * 1e3:9.3f}ms  rank {s['rank']}"
+            + (f"  {extras}" if extras else "")
+        )
+        kids, note = _collapse(children.get(s["span"], []), full)
+        for c in kids:
+            emit(c, depth + 1)
+        if note is not None:
+            name, n, tot = note
+            lines.append(
+                f"  [{'':<{width}}] {'  ' * (depth + 1)}... +{n} more "
+                f"{name} spans ({tot * 1e3:.3f}ms; --full shows all)"
+            )
+
+    for r in roots:
+        emit(r, 0)
+    return "\n".join(lines)
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(
+        description=__doc__,
+        formatter_class=argparse.RawDescriptionHelpFormatter,
+    )
+    ap.add_argument("run_dir", help="run OUT_DIR (telemetry/rank*.jsonl)")
+    ap.add_argument("trace_id", nargs="?", default=None,
+                    help="trace id to render (e.g. from an alert's "
+                         "exemplar_trace_ids)")
+    ap.add_argument("--list", action="store_true",
+                    help="list traced requests, slowest first")
+    ap.add_argument("--full", action="store_true",
+                    help="show every decode/spec span (no collapsing)")
+    ap.add_argument("--json", action="store_true",
+                    help="emit the span tree + stage shares as JSON")
+    args = ap.parse_args(argv)
+
+    traces = collect_traces(args.run_dir)
+    if not traces:
+        raise SystemExit(
+            f"no trace.span records under {args.run_dir} — was the run "
+            "traced? (SERVE.TRACE_SAMPLE > 0 and TELEMETRY.ENABLED)"
+        )
+    if args.list or args.trace_id is None:
+        rows = sorted(
+            ((tid, stage_shares(spans)) for tid, spans in traces.items()),
+            key=lambda kv: -(kv[1]["total_ms"] or 0.0),
+        )
+        print(f"{'trace':<18}{'total_ms':>10}{'spans':>7}  "
+              f"{'class':<8} shares")
+        for tid, sh in rows:
+            shares = "  ".join(
+                f"{k[:4]} {sh['shares'][k] * 100:.0f}%"
+                for k in STAGE_BUCKETS
+            ) if sh["shares"] else "(no root span)"
+            print(f"{tid:<18}{sh['total_ms'] or 0.0:>10.3f}"
+                  f"{sh['spans']:>7}  {sh['length_class'] or '-':<8} "
+                  f"{shares}")
+        return 0
+    spans = traces.get(args.trace_id)
+    if spans is None:
+        near = ", ".join(sorted(traces)[:8])
+        raise SystemExit(
+            f"trace {args.trace_id!r} not in {args.run_dir} "
+            f"(have: {near}{'...' if len(traces) > 8 else ''})"
+        )
+    if args.json:
+        print(json.dumps(
+            {"trace": args.trace_id, "spans": spans,
+             "shares": stage_shares(spans),
+             "connected": is_connected(spans)},
+            indent=1,
+        ))
+        return 0
+    print(render_waterfall(args.trace_id, spans, full=args.full))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
